@@ -1,0 +1,5 @@
+"""paddle_tpu (bootstrap init — full surface restored as modules land)."""
+from paddle_tpu import platform
+from paddle_tpu.platform.device import init, device_count, default_mesh, is_initialized
+from paddle_tpu.platform.flags import FLAGS
+__version__ = "0.1.0"

@@ -1,0 +1,131 @@
+"""Activation descriptors — the 14-activation inventory.
+
+Reference: paddle/gserver/activations/ActivationFunction.cpp
+(BEGIN_DEFINE_ACTIVATION list: sigmoid, softmax, sequence_softmax, relu, brelu,
+tanh, stanh, softrelu, abs, square, exponential, reciprocal, sqrt, log) and
+python/paddle/trainer_config_helpers/activations.py. Each descriptor carries a
+pure jax fn; sequence_softmax needs segment metadata and is resolved inside the
+sequence ops (ops/sequence_ops.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class BaseActivation:
+    name = "base"
+    fn = None  # staticmethod (x) -> x
+
+    def __repr__(self):
+        return f"{type(self).__name__}()"
+
+
+class LinearActivation(BaseActivation):
+    name = "linear"
+    fn = staticmethod(lambda x: x)
+
+
+class SigmoidActivation(BaseActivation):
+    name = "sigmoid"
+    fn = staticmethod(jax.nn.sigmoid)
+
+
+class TanhActivation(BaseActivation):
+    name = "tanh"
+    fn = staticmethod(jnp.tanh)
+
+
+class STanhActivation(BaseActivation):
+    """Scaled tanh: 1.7159 * tanh(2x/3) (reference STanhActivation)."""
+
+    name = "stanh"
+    fn = staticmethod(lambda x: 1.7159 * jnp.tanh(2.0 * x / 3.0))
+
+
+class ReluActivation(BaseActivation):
+    name = "relu"
+    fn = staticmethod(jax.nn.relu)
+
+
+class BReluActivation(BaseActivation):
+    """Bounded relu: min(max(x, 0), 24) (reference BReluActivation)."""
+
+    name = "brelu"
+    fn = staticmethod(lambda x: jnp.clip(x, 0.0, 24.0))
+
+
+class SoftReluActivation(BaseActivation):
+    """log(1 + e^x), input clipped to ±40 like the reference."""
+
+    name = "softrelu"
+    fn = staticmethod(lambda x: jnp.log1p(jnp.exp(jnp.clip(x, -40.0, 40.0))))
+
+
+class SoftmaxActivation(BaseActivation):
+    name = "softmax"
+    fn = staticmethod(lambda x: jax.nn.softmax(x, axis=-1))
+
+
+class SequenceSoftmaxActivation(BaseActivation):
+    """Softmax over each variable-length sequence (resolved by sequence ops)."""
+
+    name = "sequence_softmax"
+    fn = None  # needs segment ids; see ops.sequence_ops.sequence_softmax
+
+
+class AbsActivation(BaseActivation):
+    name = "abs"
+    fn = staticmethod(jnp.abs)
+
+
+class SquareActivation(BaseActivation):
+    name = "square"
+    fn = staticmethod(jnp.square)
+
+
+class ExpActivation(BaseActivation):
+    name = "exponential"
+    fn = staticmethod(jnp.exp)
+
+
+class ReciprocalActivation(BaseActivation):
+    name = "reciprocal"
+    fn = staticmethod(jnp.reciprocal)
+
+
+class SqrtActivation(BaseActivation):
+    name = "sqrt"
+    fn = staticmethod(jnp.sqrt)
+
+
+class LogActivation(BaseActivation):
+    name = "log"
+    fn = staticmethod(jnp.log)
+
+
+_REGISTRY = {
+    cls.name: cls
+    for cls in [
+        LinearActivation, SigmoidActivation, TanhActivation, STanhActivation,
+        ReluActivation, BReluActivation, SoftReluActivation, SoftmaxActivation,
+        SequenceSoftmaxActivation, AbsActivation, SquareActivation, ExpActivation,
+        ReciprocalActivation, SqrtActivation, LogActivation,
+    ]
+}
+
+
+def get(name_or_act):
+    """Resolve an activation descriptor from a name, class, or instance."""
+    if name_or_act is None:
+        return LinearActivation()
+    if isinstance(name_or_act, BaseActivation):
+        return name_or_act
+    if isinstance(name_or_act, type) and issubclass(name_or_act, BaseActivation):
+        return name_or_act()
+    if isinstance(name_or_act, str):
+        if name_or_act not in _REGISTRY:
+            raise KeyError(f"unknown activation {name_or_act!r}")
+        return _REGISTRY[name_or_act]()
+    raise TypeError(f"cannot resolve activation from {name_or_act!r}")

@@ -1,0 +1,70 @@
+"""Parameter / layer extra attributes.
+
+Reference: python/paddle/trainer_config_helpers/attrs.py (ParameterAttribute
+with lr mult, l2 decay, sparse flags; ExtraLayerAttribute with drop_rate,
+device placement). Device placement becomes a sharding annotation here.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+
+@dataclass
+class ParamAttr:
+    """Per-parameter attributes.
+
+    ``sharding`` is the TPU-native replacement for the reference's
+    device/sparse-remote placement: a PartitionSpec-like tuple naming mesh axes
+    per dim (None = replicated).
+    """
+
+    name: Optional[str] = None
+    initializer: Any = None          # paddle_tpu.initializer.* or callable
+    learning_rate: float = 1.0       # per-parameter LR multiplier
+    l1_decay: float = 0.0
+    l2_decay: float = 0.0
+    is_static: bool = False          # frozen parameter (no update)
+    sparse_update: bool = False      # row-sparse gradient (embedding tables)
+    gradient_clipping_threshold: float = 0.0
+    sharding: Optional[Sequence[Optional[str]]] = None
+    dtype: Any = None                # parameter dtype override
+
+    @staticmethod
+    def to_attr(arg) -> "ParamAttr":
+        if arg is None:
+            return ParamAttr()
+        if isinstance(arg, ParamAttr):
+            return arg
+        if isinstance(arg, str):
+            return ParamAttr(name=arg)
+        if isinstance(arg, dict):
+            return ParamAttr(**arg)
+        raise TypeError(f"cannot convert {arg!r} to ParamAttr")
+
+
+# The reference's name for the same concept.
+ParameterAttribute = ParamAttr
+
+
+@dataclass
+class ExtraAttr:
+    """Extra layer attributes (reference ExtraLayerAttribute): dropout etc."""
+
+    drop_rate: float = 0.0
+    sharding: Optional[Sequence[Optional[str]]] = None   # output sharding hint
+    error_clipping_threshold: float = 0.0                # clip activations' grad
+
+    @staticmethod
+    def to_attr(arg) -> "ExtraAttr":
+        if arg is None:
+            return ExtraAttr()
+        if isinstance(arg, ExtraAttr):
+            return arg
+        if isinstance(arg, dict):
+            return ExtraAttr(**arg)
+        raise TypeError(f"cannot convert {arg!r} to ExtraAttr")
+
+
+ExtraLayerAttribute = ExtraAttr

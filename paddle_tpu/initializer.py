@@ -1,0 +1,102 @@
+"""Parameter initializers.
+
+Reference: parameter init in paddle/parameter/Parameter.cpp (randomize per
+initial_strategy/initial_mean/initial_std/initial_smart in ParameterConfig.proto:34)
+— uniform, normal, and the "smart" fan-in scaled uniform default. Expressed here
+as pure functions ``(key, shape, dtype) -> array`` so layers stay functional.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def _fan_in_out(shape: Sequence[int]):
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels [h, w, cin, cout] (HWIO layout used throughout ops/conv.py)
+    receptive = math.prod(shape[:-2])
+    return shape[-2] * receptive, shape[-1] * receptive
+
+
+class Initializer:
+    def __call__(self, key, shape, dtype=jnp.float32):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        return jnp.full(shape, self.value, dtype=dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype=jnp.float32, minval=self.low,
+                                  maxval=self.high).astype(dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        return (self.mean + self.std * jax.random.normal(key, shape)).astype(dtype)
+
+
+class XavierUniform(Initializer):
+    """The reference's 'smart' default: scale by fan-in (Parameter.cpp randomize)."""
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        fan_in, fan_out = _fan_in_out(shape)
+        limit = math.sqrt(6.0 / max(1, fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype=jnp.float32, minval=-limit,
+                                  maxval=limit).astype(dtype)
+
+
+class FanInNormal(Initializer):
+    """std = 1/sqrt(fan_in) normal — matches initial_smart for std-based init."""
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        fan_in, _ = _fan_in_out(shape)
+        std = 1.0 / math.sqrt(max(1, fan_in))
+        return (std * jax.random.normal(key, shape)).astype(dtype)
+
+
+def default_weight_init() -> Initializer:
+    return XavierUniform()
+
+
+def default_bias_init() -> Initializer:
+    return Constant(0.0)
+
+
+def to_initializer(arg) -> Initializer:
+    if arg is None:
+        return default_weight_init()
+    if isinstance(arg, Initializer):
+        return arg
+    if callable(arg):
+        wrapped = arg
+
+        class _Wrapped(Initializer):
+            def __call__(self, key, shape, dtype=jnp.float32):
+                return wrapped(key, shape, dtype)
+
+        return _Wrapped()
+    if isinstance(arg, (int, float)):
+        return Constant(float(arg))
+    raise TypeError(f"cannot convert {arg!r} to Initializer")

@@ -1,0 +1,17 @@
+"""Functional kernel library — the paddle/math + paddle/function + paddle/cuda analog.
+
+Everything here is a pure jax function designed to fuse under jit and tile onto
+the MXU: matmuls/convs run in bfloat16 with float32 accumulation when
+FLAGS.use_bf16 (the TPU-native replacement for the reference's float32 cuBLAS
+path), elementwise ops are left to XLA fusion, and segment/sequence ops use the
+segment-ids formulation from paddle_tpu.sequence.
+"""
+
+from paddle_tpu.ops import math as pmath
+from paddle_tpu.ops import conv as pconv
+from paddle_tpu.ops import pool as ppool
+from paddle_tpu.ops import norm as pnorm
+from paddle_tpu.ops import losses
+from paddle_tpu.ops import sequence_ops
+from paddle_tpu.ops import rnn
+from paddle_tpu.ops.math import matmul, fc

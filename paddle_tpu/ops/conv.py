@@ -1,0 +1,124 @@
+"""Convolution kernels — the ExpandConvLayer/CudnnConvLayer/hl_cnn analog.
+
+Reference: paddle/gserver/layers/ExpandConvLayer.cpp (im2col+gemm),
+CudnnConvBaseLayer.cpp, paddle/function/GemmConvOp.cpp, DepthwiseConvOp.cpp,
+Conv3D; Gen-2 paddle/operators/conv_op.cc / conv_transpose.
+
+TPU-native: ``lax.conv_general_dilated`` in NHWC/HWIO layout (the layout XLA
+tiles best onto the MXU) with bf16 inputs + f32 accumulation. No im2col — XLA
+lowers convs directly to MXU matmuls.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.platform.flags import FLAGS
+
+IntOr2 = Union[int, Tuple[int, int]]
+
+
+def _pair(v: IntOr2) -> Tuple[int, int]:
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _conv_dtype(x):
+    if FLAGS.use_bf16 and x.dtype in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
+        return jnp.dtype(jnp.bfloat16)
+    return x.dtype
+
+
+def conv2d(x: jax.Array, w: jax.Array, *, stride: IntOr2 = 1,
+           padding: Union[str, IntOr2] = 0, dilation: IntOr2 = 1,
+           groups: int = 1, out_dtype=jnp.float32) -> jax.Array:
+    """x: [N,H,W,C], w: [kh,kw,Cin/groups,Cout] -> [N,H',W',Cout]."""
+    s = _pair(stride)
+    d = _pair(dilation)
+    if isinstance(padding, str):
+        pad = padding
+    else:
+        ph, pw = _pair(padding)
+        pad = ((ph, ph), (pw, pw))
+    ct = _conv_dtype(x)
+    return lax.conv_general_dilated(
+        x.astype(ct), w.astype(ct), window_strides=s, padding=pad,
+        rhs_dilation=d, feature_group_count=groups,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.dtype(out_dtype))
+
+
+def conv2d_transpose(x: jax.Array, w: jax.Array, *, stride: IntOr2 = 1,
+                     padding: IntOr2 = 0, out_dtype=jnp.float32) -> jax.Array:
+    """Transposed conv (reference: ConvTransLayer / conv2dtranspose op)."""
+    s = _pair(stride)
+    ph, pw = _pair(padding)
+    kh, kw = w.shape[0], w.shape[1]
+    ct = _conv_dtype(x)
+    # w layout: [kh, kw, Cin, Cout] with Cin = x's channels. lhs_dilation
+    # implements the fractional stride; padding converts to the equivalent
+    # forward-conv padding: k - 1 - p on each side.
+    return lax.conv_general_dilated(
+        x.astype(ct), jnp.flip(w, (0, 1)).astype(ct),
+        window_strides=(1, 1),
+        padding=((kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw)),
+        lhs_dilation=s, dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.dtype(out_dtype))
+
+
+def depthwise_conv2d(x: jax.Array, w: jax.Array, *, stride: IntOr2 = 1,
+                     padding: Union[str, IntOr2] = 0) -> jax.Array:
+    """Depthwise conv (reference: paddle/function/DepthwiseConvOp.cpp).
+
+    w: [kh, kw, C, channel_multiplier] — grouped conv with groups=C.
+    """
+    c = x.shape[-1]
+    kh, kw, _, m = w.shape
+    return conv2d(x, w.reshape(kh, kw, 1, c * m), stride=stride,
+                  padding=padding, groups=c)
+
+
+def conv3d(x: jax.Array, w: jax.Array, *, stride=1, padding=0) -> jax.Array:
+    """3-D conv, NDHWC/DHWIO (reference: gserver/layers/Conv3DLayer.cpp)."""
+    s = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+    if isinstance(padding, str):
+        pad = padding
+    else:
+        p = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+        pad = tuple((pi, pi) for pi in p)
+    ct = _conv_dtype(x)
+    return lax.conv_general_dilated(
+        x.astype(ct), w.astype(ct), window_strides=s, padding=pad,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+        preferred_element_type=jnp.float32)
+
+
+def row_conv(x: jax.Array, w: jax.Array) -> jax.Array:
+    """Row (lookahead) convolution over time (reference: function/RowConvOp.cpp).
+
+    x: [B, T, D], w: [future_context, D]. y[t] = sum_k x[t+k] * w[k].
+    """
+    k = w.shape[0]
+    xp = jnp.pad(x, ((0, 0), (0, k - 1), (0, 0)))
+    stacked = jnp.stack([xp[:, i:i + x.shape[1]] for i in range(k)], axis=0)
+    return jnp.einsum("kbtd,kd->btd", stacked, w)
+
+
+def block_expand(x: jax.Array, block: Tuple[int, int], stride: Tuple[int, int],
+                 padding: Tuple[int, int] = (0, 0)) -> jax.Array:
+    """im2col-as-a-layer (reference: BlockExpandLayer / function/BlockExpandOp).
+
+    x: [N,H,W,C] -> [N, num_blocks_h*num_blocks_w, bh*bw*C]
+    """
+    bh, bw = block
+    sh, sw = stride
+    ph, pw = padding
+    xp = jnp.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+    patches = lax.conv_general_dilated_patches(
+        xp, filter_shape=(bh, bw), window_strides=(sh, sw), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    n, oh, ow, f = patches.shape
+    return patches.reshape(n, oh * ow, f)

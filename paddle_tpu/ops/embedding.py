@@ -1,0 +1,30 @@
+"""Embedding / table lookup — the TableProjection / lookup_table analog.
+
+Reference: paddle/gserver/layers/TableProjection.cpp, cuda hl_table_apply.cu,
+Gen-2 operators/lookup_table_op.cc (with SelectedRows sparse gradient).
+
+The sparse-gradient capability (SelectedRows) is realized by the optimizer
+treating embedding grads row-wise; the distributed row-sharded table lives in
+paddle_tpu/parallel/embedding_sharded.py (all_to_all row exchange — the
+GET_PARAM_SPARSE prefetch analog).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def embedding_lookup(table: jax.Array, ids: jax.Array,
+                     padding_idx: int | None = None) -> jax.Array:
+    """table: [V, D], ids: int [...]. Out-of-range ids clamp (reference pads)."""
+    ids = ids.astype(jnp.int32)
+    clipped = jnp.clip(ids, 0, table.shape[0] - 1)
+    out = jnp.take(table, clipped, axis=0)
+    if padding_idx is not None:
+        out = jnp.where((ids == padding_idx)[..., None], 0.0, out)
+    return out
+
+
+def one_hot(ids: jax.Array, depth: int, dtype=jnp.float32) -> jax.Array:
+    return jax.nn.one_hot(ids, depth, dtype=dtype)

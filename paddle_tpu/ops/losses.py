@@ -1,0 +1,133 @@
+"""Loss kernels — the cost-layer family.
+
+Reference: paddle/gserver/layers/CostLayer.cpp (MultiClassCrossEntropy,
+SoftBinaryClassCrossEntropy, SumOfSquaresCostLayer, RankingCost,
+LambdaCost, MultiBinaryLabelCrossEntropy, HuberRegressionLoss,
+HuberTwoClassification), CrossEntropyOverBeam, and Gen-2 operators
+(softmax_with_cross_entropy, sigmoid_cross_entropy_with_logits, rank_loss,
+margin_rank_loss, smooth_l1, squared_l2_distance).
+
+All losses return per-example values [N]; trainers reduce with masks so
+variable-length batches weight correctly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Integer labels; fused log-softmax (reference: classification_cost)."""
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, labels[..., None].astype(jnp.int32),
+                                 axis=-1)[..., 0]
+    return logz - picked
+
+
+def soft_cross_entropy(probs_or_logits: jax.Array, soft_labels: jax.Array,
+                       *, from_logits: bool = True) -> jax.Array:
+    if from_logits:
+        logp = jax.nn.log_softmax(probs_or_logits, axis=-1)
+    else:
+        logp = jnp.log(jnp.clip(probs_or_logits, 1e-10, 1.0))
+    return -jnp.sum(soft_labels * logp, axis=-1)
+
+
+def sigmoid_cross_entropy_with_logits(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Elementwise then summed over the last dim (reference:
+    operators/sigmoid_cross_entropy_with_logits_op.cc)."""
+    zeros = jnp.zeros_like(logits)
+    loss = jnp.maximum(logits, zeros) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    return jnp.sum(loss, axis=-1)
+
+
+def multi_binary_label_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Reference: MultiBinaryLabelCrossEntropy (CostLayer.cpp)."""
+    return sigmoid_cross_entropy_with_logits(logits, labels)
+
+
+def square_error(pred: jax.Array, target: jax.Array) -> jax.Array:
+    """Sum-of-squares cost, 0.5*||p-t||^2 (reference: SumOfSquaresCostLayer)."""
+    d = pred - target
+    return 0.5 * jnp.sum(jnp.square(d), axis=tuple(range(1, d.ndim)))
+
+
+def squared_l2_distance(a: jax.Array, b: jax.Array) -> jax.Array:
+    d = a - b
+    return jnp.sum(jnp.square(d), axis=-1)
+
+
+def huber_regression(pred: jax.Array, target: jax.Array, delta: float = 1.0) -> jax.Array:
+    """Reference: HuberRegressionLoss (CostLayer.cpp)."""
+    d = jnp.abs(pred - target)
+    quad = 0.5 * jnp.square(d)
+    lin = delta * (d - 0.5 * delta)
+    return jnp.sum(jnp.where(d <= delta, quad, lin), axis=-1)
+
+
+def huber_classification(pred: jax.Array, label01: jax.Array) -> jax.Array:
+    """Two-class huber on y∈{-1,1} (reference: HuberTwoClassification)."""
+    y = 2.0 * label01.astype(pred.dtype) - 1.0
+    z = y * pred[..., 0] if pred.ndim > label01.ndim else y * pred
+    loss = jnp.where(z < -1.0, -4.0 * z, jnp.where(z < 1.0, jnp.square(1.0 - z), 0.0))
+    return loss
+
+
+def smooth_l1(pred: jax.Array, target: jax.Array, sigma: float = 1.0) -> jax.Array:
+    """Reference: operators/smooth_l1_loss_op.cc."""
+    s2 = sigma * sigma
+    d = jnp.abs(pred - target)
+    loss = jnp.where(d < 1.0 / s2, 0.5 * s2 * jnp.square(d), d - 0.5 / s2)
+    return jnp.sum(loss, axis=tuple(range(1, loss.ndim)))
+
+
+def rank_cost(left: jax.Array, right: jax.Array, label: jax.Array,
+              weight: Optional[jax.Array] = None) -> jax.Array:
+    """Pairwise ranking cost (reference: RankingCost, CostLayer.cpp):
+    C = log(1 + e^{o}) - t*o with o = left - right, t in [0,1]."""
+    o = (left - right).reshape(left.shape[0])
+    t = label.reshape(label.shape[0]).astype(o.dtype)
+    c = jnp.log1p(jnp.exp(-jnp.abs(o))) + jnp.maximum(o, 0.0) - t * o
+    if weight is not None:
+        c = c * weight.reshape(weight.shape[0])
+    return c
+
+
+def margin_rank_loss(left: jax.Array, right: jax.Array, label: jax.Array,
+                     margin: float = 0.0) -> jax.Array:
+    """Reference: operators/margin_rank_loss_op.cc: max(0, -l*(x1-x2)+margin)."""
+    y = label.reshape(label.shape[0]).astype(left.dtype)
+    o = (left - right).reshape(left.shape[0])
+    return jnp.maximum(0.0, -y * o + margin)
+
+
+def cosine_similarity(a: jax.Array, b: jax.Array, scale: float = 1.0,
+                      eps: float = 1e-8) -> jax.Array:
+    """Reference: CosSimLayer / function/CosSimOp.cpp."""
+    num = jnp.sum(a * b, axis=-1)
+    den = jnp.sqrt(jnp.sum(a * a, -1) * jnp.sum(b * b, -1) + eps)
+    return scale * num / den
+
+
+def classification_error(logits_or_probs: jax.Array, labels: jax.Array,
+                         top_k: int = 1) -> jax.Array:
+    """0/1 error per example (reference: ClassificationErrorLayer /
+    classification_error_evaluator)."""
+    if top_k == 1:
+        pred = jnp.argmax(logits_or_probs, axis=-1)
+        return (pred != labels.astype(pred.dtype)).astype(jnp.float32)
+    _, idx = jax.lax.top_k(logits_or_probs, top_k)
+    hit = jnp.any(idx == labels[..., None].astype(idx.dtype), axis=-1)
+    return (~hit).astype(jnp.float32)
+
+
+def cross_entropy_with_selfnorm(logits: jax.Array, labels: jax.Array,
+                                alpha: float = 0.1) -> jax.Array:
+    """Reference: CrossEntropyWithSelfNorm (CostLayer.cpp): xent + alpha*logZ^2."""
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, labels[..., None].astype(jnp.int32),
+                                 axis=-1)[..., 0]
+    return (logz - picked) + alpha * jnp.square(logz)

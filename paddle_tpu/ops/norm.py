@@ -1,0 +1,74 @@
+"""Normalization kernels — BatchNorm/CrossMapNorm analogs.
+
+Reference: paddle/gserver/layers/BatchNormalizationLayer.cpp,
+CudnnBatchNormLayer.cpp (moving mean/var, use_global_stats),
+CMRProjectionNormLayer + paddle/function/CrossMapNormalOp.cpp (LRN),
+SumToOneNormLayer, RowL2NormLayer; Gen-2 paddle/operators/batch_norm_op.cc.
+
+Batch norm is functional: ``batch_norm`` returns (y, new_moving_mean,
+new_moving_var) in train mode so the trainer threads running statistics through
+its state pytree — the TPU-native replacement for in-place moving buffers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def batch_norm(x: jax.Array, gamma: jax.Array, beta: jax.Array,
+               moving_mean: jax.Array, moving_var: jax.Array, *,
+               train: bool, momentum: float = 0.9, eps: float = 1e-5,
+               use_global_stats: Optional[bool] = None
+               ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Normalize over all axes but the last (channel) axis.
+
+    Works for [N, C] and [N, H, W, C]. Returns (y, new_mean, new_var).
+    """
+    reduce_axes = tuple(range(x.ndim - 1))
+    use_batch_stats = train and not (use_global_stats or False)
+    if use_batch_stats:
+        mean = jnp.mean(x, axis=reduce_axes)
+        var = jnp.var(x, axis=reduce_axes)
+        n = x.size // x.shape[-1]
+        unbiased = var * (n / max(1, n - 1))
+        new_mean = momentum * moving_mean + (1.0 - momentum) * mean
+        new_var = momentum * moving_var + (1.0 - momentum) * unbiased
+    else:
+        mean, var = moving_mean, moving_var
+        new_mean, new_var = moving_mean, moving_var
+    inv = jax.lax.rsqrt(var + eps)
+    y = (x - mean) * inv * gamma + beta
+    return y.astype(x.dtype), new_mean, new_var
+
+
+def layer_norm(x: jax.Array, gamma: jax.Array, beta: jax.Array,
+               eps: float = 1e-5) -> jax.Array:
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + eps) * gamma + beta
+
+
+def cross_map_norm(x: jax.Array, size: int = 5, scale: float = 1e-4,
+                   power: float = 0.75) -> jax.Array:
+    """Local response normalization across channels (reference:
+    function/CrossMapNormalOp.cpp). x: [N,H,W,C]."""
+    sq = jnp.square(x)
+    half = size // 2
+    padded = jnp.pad(sq, ((0, 0), (0, 0), (0, 0), (half, size - 1 - half)))
+    acc = jax.lax.reduce_window(padded, 0.0, jax.lax.add,
+                                (1, 1, 1, size), (1, 1, 1, 1), "VALID")
+    denom = jnp.power(1.0 + scale * acc, power)
+    return x / denom
+
+
+def sum_to_one_norm(x: jax.Array, eps: float = 1e-12) -> jax.Array:
+    """Normalize rows to sum 1 (reference: SumToOneNormLayer.cpp)."""
+    return x / (jnp.sum(x, axis=-1, keepdims=True) + eps)
+
+
+def row_l2_norm(x: jax.Array, eps: float = 1e-12) -> jax.Array:
+    """Row-wise L2 normalization (reference: RowL2NormLayer.cpp)."""
+    return x * jax.lax.rsqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True) + eps)

@@ -1,0 +1,124 @@
+"""Pooling kernels — the PoolLayer/CudnnPoolLayer/hl_cnn pooling analog.
+
+Reference: paddle/gserver/layers/PoolLayer.cpp, SpatialPyramidPoolLayer.cpp,
+MaxOutLayer.cpp, PoolProjection; Gen-2 paddle/operators/pool_op.cc.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+IntOr2 = Union[int, Tuple[int, int]]
+
+
+def _pair(v: IntOr2) -> Tuple[int, int]:
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def max_pool2d(x: jax.Array, window: IntOr2, stride: IntOr2 = None,
+               padding: IntOr2 = 0) -> jax.Array:
+    """x: [N,H,W,C]."""
+    kh, kw = _pair(window)
+    sh, sw = _pair(stride if stride is not None else window)
+    ph, pw = _pair(padding)
+    return lax.reduce_window(
+        x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+        lax.max, (1, kh, kw, 1), (1, sh, sw, 1),
+        ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+
+
+def avg_pool2d(x: jax.Array, window: IntOr2, stride: IntOr2 = None,
+               padding: IntOr2 = 0, *, exclude_padding: bool = True) -> jax.Array:
+    kh, kw = _pair(window)
+    sh, sw = _pair(stride if stride is not None else window)
+    ph, pw = _pair(padding)
+    summed = lax.reduce_window(
+        x, 0.0, lax.add, (1, kh, kw, 1), (1, sh, sw, 1),
+        ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+    if exclude_padding and (ph or pw):
+        ones = jnp.ones(x.shape[:3] + (1,), dtype=x.dtype)
+        counts = lax.reduce_window(
+            ones, 0.0, lax.add, (1, kh, kw, 1), (1, sh, sw, 1),
+            ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+        return summed / counts
+    return summed / float(kh * kw)
+
+
+def max_pool2d_with_index(x: jax.Array, window: IntOr2, stride: IntOr2 = None,
+                          padding: IntOr2 = 0):
+    """Returns (pooled, argmax flat index within each window's source map).
+
+    Reference: paddle/operators/pool_with_index_op (used by unpool).
+    """
+    n, h, w, c = x.shape
+    flat_idx = jnp.broadcast_to(
+        (jnp.arange(h)[:, None] * w + jnp.arange(w)[None, :])[None, :, :, None],
+        x.shape).astype(jnp.int32)
+    kh, kw = _pair(window)
+    sh, sw = _pair(stride if stride is not None else window)
+    ph, pw = _pair(padding)
+
+    def reducer(acc, cur):
+        av, ai = acc
+        cv, ci = cur
+        take = cv > av
+        return jnp.where(take, cv, av), jnp.where(take, ci, ai)
+
+    init = (jnp.array(-jnp.inf, x.dtype), jnp.array(-1, jnp.int32))
+    vals, idxs = lax.reduce_window(
+        (x, flat_idx), init, reducer, (1, kh, kw, 1), (1, sh, sw, 1),
+        ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+    return vals, idxs
+
+
+def spatial_pyramid_pool(x: jax.Array, pyramid_height: int,
+                         pool_type: str = "max") -> jax.Array:
+    """SPP (reference: SpatialPyramidPoolLayer.cpp): concat pooled bins at
+    scales 1,2,4,...  x: [N,H,W,C] -> [N, sum(4^l)*C]."""
+    n, h, w, c = x.shape
+    outs = []
+    for level in range(pyramid_height):
+        bins = 2 ** level
+        # adaptive pooling: split H/W into `bins` regions via reshape-trick on
+        # padded maps (pad up to a multiple of bins).
+        hh = -(-h // bins) * bins
+        ww = -(-w // bins) * bins
+        if pool_type == "max":
+            xp = jnp.pad(x, ((0, 0), (0, hh - h), (0, ww - w), (0, 0)),
+                         constant_values=-jnp.inf)
+            r = xp.reshape(n, bins, hh // bins, bins, ww // bins, c).max((2, 4))
+        else:
+            xp = jnp.pad(x, ((0, 0), (0, hh - h), (0, ww - w), (0, 0)))
+            cnt = jnp.pad(jnp.ones((1, h, w, 1), x.dtype),
+                          ((0, 0), (0, hh - h), (0, ww - w), (0, 0)))
+            s = xp.reshape(n, bins, hh // bins, bins, ww // bins, c).sum((2, 4))
+            d = cnt.reshape(1, bins, hh // bins, bins, ww // bins, 1).sum((2, 4))
+            r = s / d
+        outs.append(r.reshape(n, -1))
+    return jnp.concatenate(outs, axis=-1)
+
+
+def maxout(x: jax.Array, groups: int) -> jax.Array:
+    """Maxout over channel groups (reference: MaxOutLayer.cpp).
+
+    x: [N,H,W,C] with C divisible by groups -> [N,H,W,C/groups].
+    """
+    n, h, w, c = x.shape
+    return x.reshape(n, h, w, c // groups, groups).max(-1)
+
+
+def unpool2d(pooled: jax.Array, indices: jax.Array, out_hw: Tuple[int, int]) -> jax.Array:
+    """Scatter pooled values back to argmax positions (max_pool inverse)."""
+    n, oh, ow, c = pooled.shape
+    h, w = out_hw
+    flat = jnp.zeros((n, h * w, c), pooled.dtype)
+    idx = indices.reshape(n, oh * ow, c)
+    src = pooled.reshape(n, oh * ow, c)
+    ni = jnp.arange(n)[:, None, None]
+    ci = jnp.arange(c)[None, None, :]
+    flat = flat.at[ni, idx, ci].add(src)
+    return flat.reshape(n, h, w, c)

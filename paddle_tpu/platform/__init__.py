@@ -1,0 +1,17 @@
+"""Platform substrate: device/mesh discovery, flags, logging, timers, errors.
+
+TPU-native analog of the reference's ``paddle/platform`` (Place/DeviceContext/
+dynload), ``paddle/utils`` (Flags.cpp, Logging.h, Stat.h) and ``paddle/memory``.
+On TPU, XLA/PJRT owns device memory and streams, so the substrate here is about
+*mesh topology*, configuration, observability and error machinery rather than
+allocators and cuda handles.
+"""
+
+from paddle_tpu.platform import device
+from paddle_tpu.platform import enforce
+from paddle_tpu.platform import flags
+from paddle_tpu.platform import stats
+from paddle_tpu.platform.device import init, default_mesh, device_count
+from paddle_tpu.platform.enforce import EnforceError, enforce_that
+from paddle_tpu.platform.flags import FLAGS
+from paddle_tpu.platform.stats import timer, timer_stats, reset_stats

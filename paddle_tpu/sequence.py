@@ -1,0 +1,135 @@
+"""Ragged / variable-length sequence representation.
+
+Reference: paddle/parameter/Argument.h:84-90 (sequenceStartPositions /
+subSequenceStartPositions — concatenated tokens + offsets, no padding) and its
+Gen-2 formalization LoD (paddle/framework/lod_tensor.h:57-80).
+
+TPU-native design: XLA needs static shapes, so a ``SequenceBatch`` holds a
+*flat* token buffer padded to a static capacity plus ``segment_ids`` mapping
+each slot to its sequence (or -1/num_seqs for padding) — the segment-ids
+formulation keeps the reference's "no per-timestep padding waste" property for
+pooling/softmax/last-token ops, while ``to_padded()`` provides the [B, T, ...]
+view that ``lax.scan`` RNNs want. Nested (sub-)sequences carry a second level
+of segment ids, mirroring subSequenceStartPositions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class SequenceBatch:
+    """A batch of variable-length sequences in flat (LoD-like) form.
+
+    data:        [capacity, ...feature] — concatenated tokens, padded at the end
+    segment_ids: [capacity] int32 — sequence index per slot; >= num_seqs ⇒ pad
+    lengths:     [num_seqs] int32 — true length of each sequence
+    sub_segment_ids: optional [capacity] int32 — inner-sequence index for
+        nested sequences (subSequenceStartPositions analog)
+    """
+
+    data: jax.Array
+    segment_ids: jax.Array
+    lengths: jax.Array
+    sub_segment_ids: Optional[jax.Array] = None
+
+    @property
+    def num_seqs(self) -> int:
+        return self.lengths.shape[0]
+
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def valid_mask(self) -> jax.Array:
+        return self.segment_ids < self.num_seqs
+
+    def with_data(self, data: jax.Array) -> "SequenceBatch":
+        return SequenceBatch(data, self.segment_ids, self.lengths, self.sub_segment_ids)
+
+    # ---- conversions -----------------------------------------------------
+
+    def to_padded(self, max_len: Optional[int] = None) -> Tuple[jax.Array, jax.Array]:
+        """Return ([B, T, ...feature], mask [B, T]) dense view.
+
+        T is static: max_len or capacity. Scatter via position-in-sequence ids.
+        """
+        B = self.num_seqs
+        T = int(max_len) if max_len is not None else self.capacity
+        pos = position_in_sequence(self.segment_ids)
+        valid = self.valid_mask & (pos < T)
+        seg = jnp.where(valid, self.segment_ids, B)
+        p = jnp.where(valid, pos, 0)
+        feat = self.data.shape[1:]
+        out = jnp.zeros((B + 1, T) + feat, dtype=self.data.dtype)
+        out = out.at[seg, p].set(jnp.where(
+            valid.reshape((-1,) + (1,) * len(feat)), self.data, 0))
+        mask = jnp.arange(T)[None, :] < self.lengths[:, None]
+        return out[:B], mask
+
+    @staticmethod
+    def from_padded(padded: jax.Array, lengths: jax.Array,
+                    capacity: Optional[int] = None) -> "SequenceBatch":
+        """Build flat form from [B, T, ...] + lengths. capacity defaults B*T."""
+        B, T = padded.shape[0], padded.shape[1]
+        cap = int(capacity) if capacity is not None else B * T
+        # Flatten row-major; slots beyond each row's length are pads. We pack
+        # compactly so downstream segment ops see contiguous tokens.
+        seg_full = jnp.repeat(jnp.arange(B, dtype=jnp.int32), T)
+        pos_full = jnp.tile(jnp.arange(T, dtype=jnp.int32), B)
+        valid_full = pos_full < lengths[seg_full]
+        order = jnp.argsort(~valid_full, stable=True)  # valid tokens first
+        take = order[:cap]
+        flat = padded.reshape((B * T,) + padded.shape[2:])[take]
+        seg = jnp.where(valid_full[take], seg_full[take], B).astype(jnp.int32)
+        data = jnp.where(
+            (seg < B).reshape((-1,) + (1,) * (flat.ndim - 1)), flat, 0)
+        return SequenceBatch(data=data, segment_ids=seg, lengths=lengths)
+
+    @staticmethod
+    def from_list(seqs, dtype=jnp.float32, capacity: Optional[int] = None) -> "SequenceBatch":
+        """Host-side constructor from a python list of [len_i, ...] arrays."""
+        arrs = [np.asarray(s) for s in seqs]
+        lengths = np.asarray([a.shape[0] for a in arrs], dtype=np.int32)
+        total = int(lengths.sum())
+        cap = capacity if capacity is not None else total
+        feat = arrs[0].shape[1:] if arrs else ()
+        data = np.zeros((cap,) + feat, dtype=np.dtype(jnp.dtype(dtype)))
+        seg = np.full((cap,), len(arrs), dtype=np.int32)
+        off = 0
+        for i, a in enumerate(arrs):
+            n = a.shape[0]
+            data[off:off + n] = a
+            seg[off:off + n] = i
+            off += n
+        return SequenceBatch(data=jnp.asarray(data), segment_ids=jnp.asarray(seg),
+                             lengths=jnp.asarray(lengths))
+
+
+def position_in_sequence(segment_ids: jax.Array) -> jax.Array:
+    """Per-slot position within its segment, assuming contiguous segments."""
+    n = segment_ids.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    # start index of each slot's segment = first occurrence; with sorted
+    # contiguous segments, slot i's position = i - start_of_segment.
+    is_start = jnp.concatenate([
+        jnp.ones((1,), dtype=bool), segment_ids[1:] != segment_ids[:-1]])
+    start_idx = jnp.where(is_start, idx, 0)
+    seg_start = jax.lax.associative_scan(jnp.maximum, start_idx)
+    return idx - seg_start
+
+
+def lengths_to_segment_ids(lengths: jax.Array, capacity: int) -> jax.Array:
+    """[num_seqs] lengths -> [capacity] contiguous segment ids (pads = num_seqs)."""
+    B = lengths.shape[0]
+    ends = jnp.cumsum(lengths)
+    slots = jnp.arange(capacity, dtype=jnp.int32)
+    seg = jnp.searchsorted(ends, slots, side="right").astype(jnp.int32)
+    return jnp.where(slots < ends[-1], seg, B)

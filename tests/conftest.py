@@ -1,0 +1,29 @@
+"""Test configuration: run on a virtual 8-device CPU mesh.
+
+Mirrors the reference's in-process multi-node simulation strategy
+(pserver/test/test_ParameterServer2.cpp spins servers+clients in one process):
+we give XLA 8 virtual CPU devices so every mesh/collective path is exercised
+without TPU hardware.
+
+NOTE: the environment pre-imports jax (sitecustomize), so JAX_PLATFORMS set
+here would be too late — we switch platform via jax.config instead, and set
+XLA_FLAGS before the first backend initialization.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)

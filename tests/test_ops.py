@@ -1,0 +1,188 @@
+"""Op-level parity tests — the paddle/math/tests + function/tests analog.
+
+Strategy mirrors the reference's TensorCheck.h harness: compare framework
+kernels against straightforward numpy formulations.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu
+from paddle_tpu.ops import math as pmath
+from paddle_tpu.ops import conv as pconv
+from paddle_tpu.ops import pool as ppool
+from paddle_tpu.ops import norm as pnorm
+from paddle_tpu.ops import losses, sequence_ops, rnn
+from paddle_tpu.ops.embedding import embedding_lookup
+from paddle_tpu.sequence import SequenceBatch
+from paddle_tpu.platform.flags import FLAGS
+
+
+@pytest.fixture(autouse=True)
+def f32_math():
+    # exact-parity tests run in f32; bf16 policy is benchmarked separately
+    old = FLAGS.use_bf16
+    FLAGS.use_bf16 = False
+    yield
+    FLAGS.use_bf16 = old
+
+
+def test_matmul_fc(rng):
+    x = rng.randn(4, 8).astype(np.float32)
+    w = rng.randn(8, 5).astype(np.float32)
+    b = rng.randn(5).astype(np.float32)
+    np.testing.assert_allclose(pmath.fc(jnp.array(x), jnp.array(w), jnp.array(b)),
+                               x @ w + b, rtol=1e-5, atol=1e-5)
+
+
+def test_conv2d_matches_manual(rng):
+    x = rng.randn(2, 5, 5, 3).astype(np.float32)
+    w = rng.randn(3, 3, 3, 4).astype(np.float32)
+    y = np.asarray(pconv.conv2d(jnp.array(x), jnp.array(w), stride=1, padding=0))
+    assert y.shape == (2, 3, 3, 4)
+    # manual reference at one output position
+    ref = np.sum(x[0, 1:4, 2:5, :, None] * w, axis=(0, 1, 2))
+    np.testing.assert_allclose(y[0, 1, 2], ref, rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_transpose_shape(rng):
+    x = rng.randn(2, 4, 4, 3).astype(np.float32)
+    w = rng.randn(3, 3, 3, 5).astype(np.float32)
+    y = pconv.conv2d_transpose(jnp.array(x), jnp.array(w), stride=2, padding=1)
+    assert y.shape == (2, 7, 7, 5)
+
+
+def test_depthwise(rng):
+    x = rng.randn(1, 6, 6, 4).astype(np.float32)
+    w = rng.randn(3, 3, 4, 1).astype(np.float32)
+    y = pconv.depthwise_conv2d(jnp.array(x), jnp.array(w), padding=1)
+    assert y.shape == (1, 6, 6, 4)
+    ref = np.sum(x[0, 0:3, 0:3, 1] * w[:, :, 1, 0])
+    np.testing.assert_allclose(y[0, 1, 1, 1], ref, rtol=1e-4, atol=1e-4)
+
+
+def test_pools(rng):
+    x = rng.randn(2, 4, 4, 3).astype(np.float32)
+    mx = ppool.max_pool2d(jnp.array(x), 2)
+    av = ppool.avg_pool2d(jnp.array(x), 2)
+    np.testing.assert_allclose(mx[0, 0, 0], x[0, :2, :2].max((0, 1)), rtol=1e-6)
+    np.testing.assert_allclose(av[0, 0, 0], x[0, :2, :2].mean((0, 1)), rtol=1e-5)
+
+
+def test_maxout_spp(rng):
+    x = rng.randn(2, 4, 4, 8).astype(np.float32)
+    mo = ppool.maxout(jnp.array(x), 2)
+    assert mo.shape == (2, 4, 4, 4)
+    spp = ppool.spatial_pyramid_pool(jnp.array(x), 2)
+    assert spp.shape == (2, (1 + 4) * 8)
+
+
+def test_batch_norm_train_and_infer(rng):
+    x = rng.randn(16, 5).astype(np.float32)
+    g = np.ones(5, np.float32); b = np.zeros(5, np.float32)
+    mm = np.zeros(5, np.float32); mv = np.ones(5, np.float32)
+    y, nm, nv = pnorm.batch_norm(jnp.array(x), jnp.array(g), jnp.array(b),
+                                 jnp.array(mm), jnp.array(mv), train=True)
+    np.testing.assert_allclose(np.asarray(y).mean(0), 0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(y).std(0), 1, atol=1e-2)
+    y2, _, _ = pnorm.batch_norm(jnp.array(x), jnp.array(g), jnp.array(b),
+                                jnp.array(mm), jnp.array(mv), train=False)
+    np.testing.assert_allclose(np.asarray(y2), x, atol=1e-4)
+
+
+def test_losses(rng):
+    logits = rng.randn(6, 10).astype(np.float32)
+    labels = rng.randint(0, 10, 6)
+    got = np.asarray(losses.softmax_cross_entropy(jnp.array(logits), jnp.array(labels)))
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = -np.log(p[np.arange(6), labels])
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    err = losses.classification_error(jnp.array(logits), jnp.array(labels))
+    ref_err = (logits.argmax(-1) != labels).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(err), ref_err)
+
+
+def test_sequence_batch_roundtrip():
+    seqs = [np.arange(3 * 2).reshape(3, 2), np.arange(5 * 2).reshape(5, 2) + 10]
+    sb = SequenceBatch.from_list(seqs, capacity=10)
+    padded, mask = sb.to_padded()
+    assert padded.shape[0] == 2
+    np.testing.assert_allclose(np.asarray(padded)[0, :3], seqs[0])
+    np.testing.assert_allclose(np.asarray(padded)[1, :5], seqs[1])
+    assert np.asarray(mask).sum() == 8
+    sb2 = SequenceBatch.from_padded(padded, sb.lengths, capacity=10)
+    np.testing.assert_allclose(np.asarray(sb2.data)[:8], np.asarray(sb.data)[:8])
+
+
+def test_seq_pools():
+    seqs = [np.array([[1.0, 2], [3, 4]]), np.array([[10.0, 20], [30, 40], [50, 60]])]
+    sb = SequenceBatch.from_list(seqs, capacity=8)
+    np.testing.assert_allclose(np.asarray(sequence_ops.seq_pool_sum(sb)),
+                               [[4, 6], [90, 120]])
+    np.testing.assert_allclose(np.asarray(sequence_ops.seq_pool_avg(sb)),
+                               [[2, 3], [30, 40]])
+    np.testing.assert_allclose(np.asarray(sequence_ops.seq_pool_max(sb)),
+                               [[3, 4], [50, 60]])
+    np.testing.assert_allclose(np.asarray(sequence_ops.seq_first(sb)),
+                               [[1, 2], [10, 20]])
+    np.testing.assert_allclose(np.asarray(sequence_ops.seq_last(sb)),
+                               [[3, 4], [50, 60]])
+
+
+def test_sequence_softmax():
+    seqs = [np.array([1.0, 2.0]), np.array([1.0, 1.0, 1.0])]
+    sb = SequenceBatch.from_list(seqs, capacity=6)
+    out = sequence_ops.sequence_softmax(sb)
+    d = np.asarray(out.data)
+    np.testing.assert_allclose(d[0] + d[1], 1.0, rtol=1e-5)
+    np.testing.assert_allclose(d[2:5], [1 / 3] * 3, rtol=1e-5)
+    np.testing.assert_allclose(d[5], 0.0, atol=1e-6)
+
+
+def test_seq_expand():
+    per_seq = jnp.array([[1.0], [2.0]])
+    long = SequenceBatch.from_list([np.zeros((2, 1)), np.zeros((3, 1))], capacity=6)
+    out = sequence_ops.seq_expand(per_seq, long)
+    np.testing.assert_allclose(np.asarray(out.data).ravel()[:5], [1, 1, 2, 2, 2])
+
+
+def test_seq_concat():
+    a = SequenceBatch.from_list([np.array([[1.0]]), np.array([[2.0], [3.0]])], capacity=4)
+    b = SequenceBatch.from_list([np.array([[4.0], [5.0]]), np.array([[6.0]])], capacity=4)
+    out = sequence_ops.seq_concat(a, b)
+    padded, mask = out.to_padded()
+    p = np.asarray(padded)[..., 0]
+    np.testing.assert_allclose(p[0, :3], [1, 4, 5])
+    np.testing.assert_allclose(p[1, :3], [2, 3, 6])
+
+
+def test_lstm_gru_scan_shapes_and_mask(rng):
+    B, T, D, H = 2, 5, 3, 4
+    x = jnp.array(rng.randn(B, T, D).astype(np.float32))
+    mask = jnp.array((np.arange(T)[None, :] < np.array([[3], [5]])).reshape(B, T))
+    w_x = jnp.array(rng.randn(D, 4 * H).astype(np.float32) * 0.1)
+    w_h = jnp.array(rng.randn(H, 4 * H).astype(np.float32) * 0.1)
+    b = jnp.zeros(4 * H)
+    hs, final = rnn.lstm_scan(x, mask, w_x, w_h, b)
+    assert hs.shape == (B, T, H)
+    # masked steps must not change state: h at t=3,4 for seq 0 equals h at t=2
+    np.testing.assert_allclose(np.asarray(hs)[0, 3], np.asarray(hs)[0, 2])
+    np.testing.assert_allclose(np.asarray(final.h)[0], np.asarray(hs)[0, 2])
+
+    w_x3 = jnp.array(rng.randn(D, 3 * H).astype(np.float32) * 0.1)
+    w_h3 = jnp.array(rng.randn(H, 3 * H).astype(np.float32) * 0.1)
+    hs_g, fin_g = rnn.gru_scan(x, mask, w_x3, w_h3, jnp.zeros(3 * H))
+    assert hs_g.shape == (B, T, H)
+    np.testing.assert_allclose(np.asarray(hs_g)[0, 4], np.asarray(hs_g)[0, 2])
+
+
+def test_embedding(rng):
+    table = jnp.array(rng.randn(10, 4).astype(np.float32))
+    ids = jnp.array([[1, 2], [3, 0]])
+    out = embedding_lookup(table, ids)
+    assert out.shape == (2, 2, 4)
+    np.testing.assert_allclose(np.asarray(out)[0, 1], np.asarray(table)[2])
