@@ -1,5 +1,71 @@
-"""paddle_tpu (bootstrap init — full surface restored as modules land)."""
+"""paddle_tpu: a TPU-native deep-learning framework with the capabilities of
+2017-era PaddlePaddle (reference: xiaoyeye1117/Paddle), re-architected for
+JAX/XLA/pallas/pjit.
+
+The user surface mirrors the reference's ``paddle.v2`` API
+(reference: python/paddle/v2/__init__.py) — ``init()``, ``layer``,
+``optimizer``, ``trainer.SGD``, ``reader``, ``dataset``, ``infer`` — while the
+engine underneath is jit-compiled XLA partitioned over an ICI/DCN device mesh
+instead of C++ gradient machines and a parameter-server fleet.
+"""
+
 from paddle_tpu import platform
+from paddle_tpu.platform import enforce
 from paddle_tpu.platform.device import init, device_count, default_mesh, is_initialized
 from paddle_tpu.platform.flags import FLAGS
+
+from paddle_tpu import activation
+from paddle_tpu import attr
+from paddle_tpu import data_type
+from paddle_tpu import initializer
+from paddle_tpu import pooling
+from paddle_tpu import layer
+from paddle_tpu import networks
+from paddle_tpu import optimizer
+from paddle_tpu import evaluator
+from paddle_tpu import trainer
+from paddle_tpu import event
+from paddle_tpu import parameters
+from paddle_tpu import topology
+from paddle_tpu import inference
+from paddle_tpu import reader
+from paddle_tpu import dataset
+from paddle_tpu import minibatch
+from paddle_tpu import parallel
+from paddle_tpu import sequence
+
+from paddle_tpu.minibatch import batch
+from paddle_tpu.parameters import Parameters
+from paddle_tpu.inference import infer, Inference
+from paddle_tpu.data_feeder import DataFeeder
+from paddle_tpu.sequence import SequenceBatch
+
 __version__ = "0.1.0"
+
+__all__ = [
+    "init",
+    "batch",
+    "infer",
+    "layer",
+    "networks",
+    "optimizer",
+    "evaluator",
+    "trainer",
+    "event",
+    "parameters",
+    "topology",
+    "reader",
+    "dataset",
+    "minibatch",
+    "parallel",
+    "activation",
+    "attr",
+    "data_type",
+    "initializer",
+    "pooling",
+    "sequence",
+    "Parameters",
+    "DataFeeder",
+    "SequenceBatch",
+    "FLAGS",
+]

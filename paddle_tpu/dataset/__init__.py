@@ -1,0 +1,24 @@
+"""Datasets (reference: python/paddle/v2/dataset — 13 auto-downloading
+datasets). This build has no network egress in CI; every dataset module
+supports (a) download-if-possible with md5 cache like the reference
+(common.py), and (b) a deterministic ``synthetic`` fallback so tests and
+demos run hermetically.
+"""
+
+from paddle_tpu.dataset import common
+from paddle_tpu.dataset import mnist
+from paddle_tpu.dataset import cifar
+from paddle_tpu.dataset import uci_housing
+from paddle_tpu.dataset import imdb
+from paddle_tpu.dataset import imikolov
+from paddle_tpu.dataset import movielens
+from paddle_tpu.dataset import conll05
+from paddle_tpu.dataset import wmt14
+from paddle_tpu.dataset import flowers
+from paddle_tpu.dataset import voc2012
+from paddle_tpu.dataset import sentiment
+from paddle_tpu.dataset import mq2007
+
+__all__ = ["common", "mnist", "cifar", "uci_housing", "imdb", "imikolov",
+           "movielens", "conll05", "wmt14", "flowers", "voc2012",
+           "sentiment", "mq2007"]

@@ -1,0 +1,33 @@
+"""CoNLL-05 semantic role labeling (reference: v2/dataset/conll05.py).
+Samples: (word_seq, predicate, ctx_n2..ctx_p2 seqs, mark_seq, label_seq)."""
+import numpy as np
+
+WORD_DIM = 4000
+LABEL_DIM = 67  # BIO tags
+PRED_DIM = 300
+
+
+def get_dict():
+    word_dict = {f"w{i}": i for i in range(WORD_DIM)}
+    verb_dict = {f"v{i}": i for i in range(PRED_DIM)}
+    label_dict = {f"l{i}": i for i in range(LABEL_DIM)}
+    return word_dict, verb_dict, label_dict
+
+
+def _synthetic(n, seed):
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        length = int(rng.randint(5, 30))
+        words = [int(w) for w in rng.randint(0, WORD_DIM, length)]
+        pred = int(rng.randint(PRED_DIM))
+        mark = [int(m) for m in (rng.rand(length) < 0.2)]
+        labels = [int(l) for l in rng.randint(0, LABEL_DIM, length)]
+        yield (words, [pred] * length, mark, labels)
+
+
+def train():
+    return lambda: _synthetic(1024, 40)
+
+
+def test():
+    return lambda: _synthetic(128, 41)

@@ -1,0 +1,23 @@
+"""Oxford-102 flowers (reference: v2/dataset/flowers.py). Synthetic fallback."""
+import numpy as np
+
+
+def _synthetic(n, seed):
+    rng = np.random.RandomState(seed)
+    templates = rng.randn(102, 3 * 32 * 32).astype(np.float32)
+    for _ in range(n):
+        lab = int(rng.randint(102))
+        img = np.tanh(templates[lab] * 0.4 + rng.randn(3 * 32 * 32) * 0.4)
+        yield img.astype(np.float32), lab
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True):
+    return lambda: _synthetic(1024, 60)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True):
+    return lambda: _synthetic(128, 61)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True):
+    return lambda: _synthetic(128, 62)

@@ -1,0 +1,18 @@
+"""IMDB sentiment (reference: v2/dataset/imdb.py). Synthetic fallback."""
+from paddle_tpu.dataset import _synth
+
+WORD_DIM = 5147  # reference dict size ballpark
+
+
+def word_dict():
+    return {f"w{i}": i for i in range(WORD_DIM)}
+
+
+def train(word_idx=None):
+    dim = len(word_idx) if word_idx else WORD_DIM
+    return lambda: _synth.seq_classification(2048, dim, 2, seed=10, max_len=100)
+
+
+def test(word_idx=None):
+    dim = len(word_idx) if word_idx else WORD_DIM
+    return lambda: _synth.seq_classification(256, dim, 2, seed=11, max_len=100)

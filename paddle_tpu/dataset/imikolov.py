@@ -1,0 +1,38 @@
+"""PTB language-model n-grams (reference: v2/dataset/imikolov.py)."""
+import numpy as np
+
+
+def build_dict(min_word_freq=50):
+    return {f"w{i}": i for i in range(2000)}
+
+
+def train(word_idx, n):
+    dim = len(word_idx)
+
+    def reader():
+        rng = np.random.RandomState(20)
+        # markov-ish synthetic n-grams
+        trans = rng.randint(0, dim, size=(dim,))
+        for _ in range(4096):
+            start = int(rng.randint(dim))
+            gram = [start]
+            for _ in range(n - 1):
+                gram.append(int((trans[gram[-1]] + rng.randint(3)) % dim))
+            yield tuple(gram)
+
+    return reader
+
+
+def test(word_idx, n):
+    def reader():
+        rng = np.random.RandomState(21)
+        dim = len(word_idx)
+        trans = rng.randint(0, dim, size=(dim,))
+        for _ in range(512):
+            start = int(rng.randint(dim))
+            gram = [start]
+            for _ in range(n - 1):
+                gram.append(int((trans[gram[-1]] + rng.randint(3)) % dim))
+            yield tuple(gram)
+
+    return reader

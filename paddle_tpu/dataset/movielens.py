@@ -1,0 +1,46 @@
+"""MovieLens-1M rating prediction (reference: v2/dataset/movielens.py)."""
+import numpy as np
+
+MAX_USER = 6040
+MAX_MOVIE = 3952
+
+
+def max_user_id():
+    return MAX_USER
+
+
+def max_movie_id():
+    return MAX_MOVIE
+
+
+def max_job_id():
+    return 20
+
+
+def age_table():
+    return [1, 18, 25, 35, 45, 50, 56]
+
+
+def _synthetic(n, seed):
+    rng = np.random.RandomState(seed)
+    user_bias = rng.randn(MAX_USER + 1)
+    movie_bias = rng.randn(MAX_MOVIE + 1)
+    for _ in range(n):
+        u = int(rng.randint(1, MAX_USER + 1))
+        m = int(rng.randint(1, MAX_MOVIE + 1))
+        gender = int(rng.randint(2))
+        age = int(rng.randint(7))
+        job = int(rng.randint(21))
+        category = [int(rng.randint(19))]
+        title = [int(rng.randint(1000)) for _ in range(3)]
+        score = float(np.clip(3 + user_bias[u] + movie_bias[m] +
+                              0.3 * rng.randn(), 1, 5))
+        yield u, gender, age, job, m, category, title, score
+
+
+def train():
+    return lambda: _synthetic(4096, 30)
+
+
+def test():
+    return lambda: _synthetic(512, 31)

@@ -1,0 +1,44 @@
+"""MQ2007 learning-to-rank (reference: v2/dataset/mq2007.py).
+Yields (query_group) lists for listwise, or pairs for pairwise format."""
+import numpy as np
+
+FEATURE_DIM = 46
+
+
+def _synthetic_queries(n_queries, seed):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(FEATURE_DIM)
+    for _ in range(n_queries):
+        n_docs = int(rng.randint(5, 20))
+        feats = rng.randn(n_docs, FEATURE_DIM).astype(np.float32)
+        scores = feats @ w + 0.5 * rng.randn(n_docs)
+        rels = np.digitize(scores, np.percentile(scores, [33, 66]))
+        yield [(float(rels[i]), feats[i]) for i in range(n_docs)]
+
+
+def train(format="listwise"):
+    def reader():
+        for group in _synthetic_queries(512, 90):
+            if format == "listwise":
+                yield group
+            else:
+                for i in range(len(group)):
+                    for j in range(len(group)):
+                        if group[i][0] > group[j][0]:
+                            yield group[i][1], group[j][1], 1.0
+
+    return reader
+
+
+def test(format="listwise"):
+    def reader():
+        for group in _synthetic_queries(64, 91):
+            if format == "listwise":
+                yield group
+            else:
+                for i in range(len(group)):
+                    for j in range(len(group)):
+                        if group[i][0] > group[j][0]:
+                            yield group[i][1], group[j][1], 1.0
+
+    return reader

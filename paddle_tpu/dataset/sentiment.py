@@ -1,0 +1,18 @@
+"""Movie-review sentiment (reference: v2/dataset/sentiment.py)."""
+from paddle_tpu.dataset import _synth
+
+WORD_DIM = 1500
+
+
+def get_word_dict():
+    return {f"w{i}": i for i in range(WORD_DIM)}
+
+
+def train(word_dict=None):
+    dim = len(word_dict) if word_dict else WORD_DIM
+    return lambda: _synth.seq_classification(1024, dim, 2, seed=80)
+
+
+def test(word_dict=None):
+    dim = len(word_dict) if word_dict else WORD_DIM
+    return lambda: _synth.seq_classification(128, dim, 2, seed=81)

@@ -1,0 +1,22 @@
+"""VOC2012 segmentation (reference: v2/dataset/voc2012.py). Synthetic fallback."""
+import numpy as np
+
+
+def _synthetic(n, seed):
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        img = rng.rand(3, 32, 32).astype(np.float32)
+        seg = rng.randint(0, 21, (32, 32)).astype(np.int32)
+        yield img, seg
+
+
+def train():
+    return lambda: _synthetic(256, 70)
+
+
+def test():
+    return lambda: _synthetic(64, 71)
+
+
+def val():
+    return lambda: _synthetic(64, 72)

@@ -1,0 +1,26 @@
+"""WMT-14 fr-en translation pairs (reference: v2/dataset/wmt14.py).
+Samples: (src_ids, trg_ids_with_<s>, trg_ids_next)."""
+import numpy as np
+
+DICT_SIZE = 30000
+START = 0
+END = 1
+UNK = 2
+
+
+def _synthetic(n, seed, dict_size):
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        slen = int(rng.randint(3, 25))
+        src = [int(t) for t in rng.randint(3, dict_size, slen)]
+        # toy "translation": reversed + offset
+        trg = [(t + 7) % (dict_size - 3) + 3 for t in reversed(src)]
+        yield (src, [START] + trg, trg + [END])
+
+
+def train(dict_size=DICT_SIZE):
+    return lambda: _synthetic(2048, 50, dict_size)
+
+
+def test(dict_size=DICT_SIZE):
+    return lambda: _synthetic(256, 51, dict_size)

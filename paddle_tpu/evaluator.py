@@ -1,0 +1,192 @@
+"""Evaluators — in-graph metric layers (the gserver/evaluators analog).
+
+Reference: paddle/gserver/evaluators/Evaluator.h:42-72 + REGISTER_EVALUATOR
+list (classification_error, sum, rankauc, pnpair, precision_recall,
+ctc_edit_distance, chunk, seq_classification_error + printers) and
+python/paddle/trainer_config_helpers/evaluators.py.
+
+Each evaluator returns a metric LayerOutput; the trainer computes it per batch
+in-graph (cheap — fused into the step) and averages across the pass. Pass them
+to ``trainer.SGD(..., extra_layers=[...])`` exactly like the v2 API.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops import losses as ploss
+from paddle_tpu.sequence import SequenceBatch
+from paddle_tpu.topology import LayerOutput, unique_name
+
+__all__ = ["classification_error", "sum", "column_sum", "auc",
+           "precision_recall", "pnpair", "seq_classification_error",
+           "value_printer", "maxid_printer"]
+
+
+def _data_of(v):
+    return v.data if isinstance(v, SequenceBatch) else v
+
+
+def _metric_node(name, ltype, inputs, fn) -> LayerOutput:
+    node = LayerOutput(name=name, layer_type=ltype, inputs=inputs, fn=fn, size=1)
+    node.is_metric = True
+    return node
+
+
+def classification_error(input, label, top_k: int = 1, weight=None,
+                         name: Optional[str] = None) -> LayerOutput:
+    """Top-k error rate (reference: classification_error_evaluator)."""
+    name = name or unique_name("classification_error_evaluator")
+    inputs = [input, label] + ([weight] if weight is not None else [])
+
+    def compute(ctx, p, ins):
+        logits, lab = ins[0], ins[1]
+
+        def f(lg, lb):
+            lb = lb.reshape(lb.shape[0]).astype(jnp.int32)
+            return ploss.classification_error(lg, lb, top_k)
+
+        if isinstance(logits, SequenceBatch):
+            err = f(logits.data, _data_of(lab))
+            return logits.with_data(jnp.where(logits.valid_mask, err, 0.0))
+        err = f(logits, lab)
+        if weight is not None:
+            err = err * _data_of(ins[2]).reshape(-1)
+        return err
+
+    return _metric_node(name, "classification_error_evaluator", inputs, compute)
+
+
+def seq_classification_error(input, label, name: Optional[str] = None) -> LayerOutput:
+    """Per-sequence all-token-correct error (reference:
+    seq_classification_error_evaluator): a sequence counts as wrong if ANY
+    token is wrong."""
+    name = name or unique_name("seq_classification_error_evaluator")
+
+    def compute(ctx, p, ins):
+        sb, lab = ins[0], ins[1]
+        err = ploss.classification_error(sb.data, _data_of(lab).reshape(-1))
+        seg = jnp.where(sb.valid_mask, sb.segment_ids, sb.num_seqs)
+        any_err = jax.ops.segment_max(jnp.where(sb.valid_mask, err, 0.0), seg,
+                                      num_segments=sb.num_seqs + 1)[: sb.num_seqs]
+        return any_err
+
+    return _metric_node(name, "seq_classification_error_evaluator",
+                        [input, label], compute)
+
+
+def sum(input, name: Optional[str] = None) -> LayerOutput:
+    """Sum evaluator (reference: sum_evaluator)."""
+    name = name or unique_name("sum_evaluator")
+
+    def compute(ctx, p, ins):
+        v = ins[0]
+        d = _data_of(v)
+        out = d.reshape(d.shape[0], -1).sum(-1)
+        if isinstance(v, SequenceBatch):
+            return v.with_data(jnp.where(v.valid_mask, out, 0.0))
+        return out
+
+    return _metric_node(name, "sum_evaluator", [input], compute)
+
+
+def column_sum(input, name: Optional[str] = None) -> LayerOutput:
+    """Column-mean evaluator (reference: column_sum_evaluator)."""
+    name = name or unique_name("column_sum_evaluator")
+
+    def compute(ctx, p, ins):
+        return _data_of(ins[0]).mean(-1)
+
+    return _metric_node(name, "column_sum_evaluator", [input], compute)
+
+
+def auc(input, label, name: Optional[str] = None) -> LayerOutput:
+    """Batch AUC via rank statistic (reference: auc_evaluator/AucEvaluator).
+
+    Uses the Mann-Whitney U formulation on the positive-class score.
+    """
+    name = name or unique_name("auc_evaluator")
+
+    def compute(ctx, p, ins):
+        scores = _data_of(ins[0])
+        if scores.ndim > 1 and scores.shape[-1] > 1:
+            scores = scores[..., 1]  # P(class=1)
+        scores = scores.reshape(-1)
+        y = _data_of(ins[1]).reshape(-1).astype(jnp.float32)
+        order = jnp.argsort(scores)
+        ranks = jnp.zeros_like(scores).at[order].set(
+            jnp.arange(1, scores.shape[0] + 1, dtype=scores.dtype))
+        n_pos = jnp.sum(y)
+        n_neg = y.shape[0] - n_pos
+        u = jnp.sum(ranks * y) - n_pos * (n_pos + 1) / 2.0
+        auc_val = jnp.where((n_pos > 0) & (n_neg > 0),
+                            u / jnp.maximum(n_pos * n_neg, 1.0), 0.5)
+        return jnp.broadcast_to(auc_val, (1,))
+
+    return _metric_node(name, "auc_evaluator", [input, label], compute)
+
+
+def pnpair(input, label, query_id, name: Optional[str] = None) -> LayerOutput:
+    """Positive-negative pair ratio within queries (reference:
+    pnpair_evaluator). Simplified: global pos/neg pair ratio per batch."""
+    name = name or unique_name("pnpair_evaluator")
+
+    def compute(ctx, p, ins):
+        s = _data_of(ins[0]).reshape(-1)
+        y = _data_of(ins[1]).reshape(-1).astype(jnp.float32)
+        q = _data_of(ins[2]).reshape(-1)
+        same_q = q[:, None] == q[None, :]
+        better = (y[:, None] > y[None, :]) & same_q
+        correct = jnp.sum(jnp.where(better & (s[:, None] > s[None, :]), 1.0, 0.0))
+        total = jnp.maximum(jnp.sum(jnp.where(better, 1.0, 0.0)), 1.0)
+        return jnp.broadcast_to(correct / total, (1,))
+
+    return _metric_node(name, "pnpair_evaluator", [input, label, query_id], compute)
+
+
+def precision_recall(input, label, name: Optional[str] = None) -> LayerOutput:
+    """Macro F1 proxy (reference: precision_recall_evaluator). Emits the
+    batch F1 for the positive class of binary problems, else accuracy."""
+    name = name or unique_name("precision_recall_evaluator")
+
+    def compute(ctx, p, ins):
+        logits = _data_of(ins[0])
+        y = _data_of(ins[1]).reshape(-1).astype(jnp.int32)
+        pred = jnp.argmax(logits, -1).astype(jnp.int32)
+        tp = jnp.sum(jnp.where((pred == 1) & (y == 1), 1.0, 0.0))
+        fp = jnp.sum(jnp.where((pred == 1) & (y == 0), 1.0, 0.0))
+        fn = jnp.sum(jnp.where((pred == 0) & (y == 1), 1.0, 0.0))
+        prec = tp / jnp.maximum(tp + fp, 1.0)
+        rec = tp / jnp.maximum(tp + fn, 1.0)
+        f1 = 2 * prec * rec / jnp.maximum(prec + rec, 1e-6)
+        return jnp.broadcast_to(f1, (1,))
+
+    return _metric_node(name, "precision_recall_evaluator", [input, label], compute)
+
+
+def value_printer(input, name: Optional[str] = None) -> LayerOutput:
+    """Host-side value printer (reference: value_printer_evaluator) — uses
+    jax.debug.print so it works under jit."""
+    name = name or unique_name("value_printer_evaluator")
+
+    def compute(ctx, p, ins):
+        v = _data_of(ins[0])
+        jax.debug.print(name + ": {}", v)
+        return jnp.zeros((1,))
+
+    return _metric_node(name, "value_printer_evaluator", [input], compute)
+
+
+def maxid_printer(input, name: Optional[str] = None) -> LayerOutput:
+    """Prints argmax ids (reference: maxid_printer_evaluator)."""
+    name = name or unique_name("maxid_printer_evaluator")
+
+    def compute(ctx, p, ins):
+        v = _data_of(ins[0])
+        jax.debug.print(name + ": {}", jnp.argmax(v, -1))
+        return jnp.zeros((1,))
+
+    return _metric_node(name, "maxid_printer_evaluator", [input], compute)

@@ -1,0 +1,47 @@
+"""Trainer events (reference: python/paddle/v2/event.py).
+
+The event handler contract is identical to the reference: the trainer calls a
+user handler with BeginPass/EndPass/BeginIteration/EndIteration/TestResult.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+class WithMetric:
+    def __init__(self, evaluator_result: Optional[Dict[str, float]] = None):
+        self.metrics = evaluator_result or {}
+
+
+class BeginPass:
+    def __init__(self, pass_id: int):
+        self.pass_id = pass_id
+
+
+class EndPass(WithMetric):
+    def __init__(self, pass_id: int, evaluator_result=None, parameters=None):
+        super().__init__(evaluator_result)
+        self.pass_id = pass_id
+        self.parameters = parameters
+
+
+class BeginIteration:
+    def __init__(self, pass_id: int, batch_id: int):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+
+
+class EndIteration(WithMetric):
+    def __init__(self, pass_id: int, batch_id: int, cost: float,
+                 evaluator_result=None):
+        super().__init__(evaluator_result)
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+        self.cost = cost
+
+
+class TestResult(WithMetric):
+    def __init__(self, cost: float, evaluator_result=None):
+        super().__init__(evaluator_result)
+        self.cost = cost

@@ -1,0 +1,69 @@
+"""Inference API (reference: python/paddle/v2/inference.py — Inference/infer).
+
+``Inference`` compiles a test-mode forward of the requested output layers and
+runs it over a reader or feed dict.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from paddle_tpu.data_feeder import DataFeeder
+from paddle_tpu.parameters import Parameters
+from paddle_tpu.sequence import SequenceBatch
+from paddle_tpu.topology import LayerOutput, Topology
+
+
+class Inference:
+    def __init__(self, output_layer, parameters: Parameters,
+                 model_state=None):
+        outputs = [output_layer] if isinstance(output_layer, LayerOutput) else list(output_layer)
+        self.topology = Topology(outputs)
+        self.parameters = parameters
+        self.model_state = model_state if model_state is not None else self.topology.init_state()
+        self._fn = jax.jit(self._forward)
+
+    def _forward(self, params, state, feeds):
+        outs, _ = self.topology.forward(params, state, feeds, train=False)
+        return outs
+
+    def iter_infer(self, input, feeding=None):
+        data_types = [(n.name, n.input_type) for n in self.topology.data_nodes]
+        feeder = DataFeeder(data_types, feeding)
+        params = self.parameters.as_dict()
+        for batch in input:
+            feeds = feeder.feed(batch)
+            yield self._fn(params, self.model_state, feeds)
+
+    def infer(self, input, feeding=None, field: str = "value",
+              batch_size: int = 256):
+        """input: a list of sample tuples (v2 semantics); batched internally."""
+        batches = [input[i:i + batch_size] for i in range(0, len(input), batch_size)]
+        results: List[List[np.ndarray]] = None
+        for outs in self.iter_infer(batches, feeding):
+            arrays = [_to_numpy(o) for o in outs]
+            if results is None:
+                results = [[a] for a in arrays]
+            else:
+                for acc, a in zip(results, arrays):
+                    acc.append(a)
+        if results is None:
+            return None
+        merged = [np.concatenate(parts, axis=0) if parts[0].ndim else np.stack(parts)
+                  for parts in results]
+        return merged[0] if len(merged) == 1 else merged
+
+
+def _to_numpy(o):
+    if isinstance(o, SequenceBatch):
+        return np.asarray(o.data)
+    return np.asarray(o)
+
+
+def infer(output_layer, parameters: Parameters, input, feeding=None,
+          field: str = "value"):
+    return Inference(output_layer, parameters).infer(input, feeding=feeding,
+                                                     field=field)

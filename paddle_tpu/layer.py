@@ -1,0 +1,1827 @@
+"""The layer DSL — the ``paddle.v2.layer`` / trainer_config_helpers analog.
+
+Reference: python/paddle/trainer_config_helpers/layers.py (131 functions → the
+95 registered C++ layer types in paddle/gserver/layers) and
+python/paddle/v2/layer.py. Each function here returns a ``LayerOutput`` graph
+node whose compute fn is pure jax; the whole graph compiles to one XLA program
+(see paddle_tpu/topology.py).
+
+Values flowing through the graph are either dense ``jax.Array`` ([batch, ...])
+or ``SequenceBatch`` (ragged). Cost layers return per-example losses; the
+trainer applies masking/averaging.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import activation as act_mod
+from paddle_tpu import pooling as pooling_mod
+from paddle_tpu.attr import ExtraAttr, ParamAttr
+from paddle_tpu.data_type import InputType, SeqKind, SlotKind
+from paddle_tpu.initializer import Constant
+from paddle_tpu.ops import conv as pconv
+from paddle_tpu.ops import losses as ploss
+from paddle_tpu.ops import math as pmath
+from paddle_tpu.ops import norm as pnorm
+from paddle_tpu.ops import pool as ppool
+from paddle_tpu.ops import rnn as prnn
+from paddle_tpu.ops import sequence_ops as pseq
+from paddle_tpu.ops.embedding import embedding_lookup
+from paddle_tpu.platform.enforce import EnforceError, enforce_that
+from paddle_tpu.sequence import SequenceBatch
+from paddle_tpu.topology import (Context, LayerOutput, ParamSpec, StateSpec,
+                                 unique_name)
+
+__all__: List[str] = []
+
+
+def _export(fn):
+    __all__.append(fn.__name__)
+    return fn
+
+
+def _as_list(x) -> list:
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _resolve_act(act):
+    return act_mod.get(act)
+
+
+def _apply_act(activation, value):
+    """Apply an activation to a dense array or tokenwise to a SequenceBatch."""
+    if isinstance(activation, act_mod.SequenceSoftmaxActivation):
+        enforce_that(isinstance(value, SequenceBatch),
+                     "sequence_softmax needs a sequence input", context="layer")
+        return pseq.sequence_softmax(value)
+    fn = activation.fn
+    if fn is None:
+        return value
+    if isinstance(value, SequenceBatch):
+        return value.with_data(fn(value.data))
+    return fn(value)
+
+
+def _apply_extra(ctx: Context, name: str, value, layer_attr: Optional[ExtraAttr]):
+    attr = ExtraAttr.to_attr(layer_attr)
+    if attr.drop_rate > 0.0:
+        key = ctx.rng_for(name)
+        if isinstance(value, SequenceBatch):
+            value = value.with_data(
+                pmath.dropout(value.data, attr.drop_rate, key, ctx.train))
+        else:
+            value = pmath.dropout(value, attr.drop_rate, key, ctx.train)
+    return value
+
+
+def _data_of(v):
+    return v.data if isinstance(v, SequenceBatch) else v
+
+
+def _like(template, data):
+    if isinstance(template, SequenceBatch):
+        return template.with_data(data)
+    return data
+
+
+# ---------------------------------------------------------------------------
+# data
+# ---------------------------------------------------------------------------
+
+
+@_export
+def data(name: str, type: InputType, height: int = None, width: int = None,
+         **_ignored) -> LayerOutput:
+    """Input placeholder (reference: data_layer, v2 layer.data)."""
+    node = LayerOutput(
+        name=name, layer_type="data", inputs=[], fn=None,
+        size=type.dim, is_sequence=type.seq != SeqKind.NO_SEQUENCE)
+    node.input_type = type
+    node.height, node.width = height, width
+    return node
+
+
+# ---------------------------------------------------------------------------
+# fc / embedding / mixed projections
+# ---------------------------------------------------------------------------
+
+
+@_export
+def fc(input, size: int, act=None, name: Optional[str] = None,
+       param_attr=None, bias_attr=True, layer_attr=None) -> LayerOutput:
+    """Fully connected layer; multiple inputs are projected and summed
+    (reference: fc_layer, gserver/layers/FullyConnectedLayer.cpp:69-139)."""
+    inputs = _as_list(input)
+    name = name or unique_name("fc")
+    activation = _resolve_act(act)
+    attrs = _as_list(param_attr) if isinstance(param_attr, (list, tuple)) else [param_attr] * len(inputs)
+    params: Dict[str, ParamSpec] = {}
+    for i, (inp, pa) in enumerate(zip(inputs, attrs)):
+        enforce_that(inp.size is not None, f"input {inp.name} has no size", context="fc")
+        params[f"w{i}"] = ParamSpec((inp.size, size), ParamAttr.to_attr(pa))
+    has_bias = bool(bias_attr)
+    if has_bias:
+        battr = ParamAttr.to_attr(None if bias_attr is True else bias_attr)
+        params["b"] = ParamSpec((size,), battr)
+
+    def compute(ctx: Context, p, ins):
+        total = None
+        for i, v in enumerate(ins):
+            d = _data_of(v)
+            if not isinstance(v, SequenceBatch) and d.ndim > 2:
+                d = d.reshape(d.shape[0], -1)  # flatten image maps (NHWC)
+            y = pmath.matmul(d, p[f"w{i}"])
+            total = y if total is None else total + y
+        if has_bias:
+            total = total + p["b"]
+        out = _like(ins[0], total) if isinstance(ins[0], SequenceBatch) else total
+        out = _apply_act(activation, out)
+        return _apply_extra(ctx, name, out, layer_attr)
+
+    return LayerOutput(name=name, layer_type="fc", inputs=inputs, fn=compute,
+                       params=params, size=size,
+                       is_sequence=inputs[0].is_sequence)
+
+
+@_export
+def embedding(input, size: int, name: Optional[str] = None,
+              param_attr=None, layer_attr=None) -> LayerOutput:
+    """Table lookup (reference: embedding_layer → TableProjection)."""
+    inp = input
+    name = name or unique_name("embedding")
+    attr = ParamAttr.to_attr(param_attr)
+    params = {"w": ParamSpec((inp.size, size), attr)}
+
+    def compute(ctx, p, ins):
+        v = ins[0]
+        ids = _data_of(v)
+        out = embedding_lookup(p["w"], ids)
+        return _like(v, out)
+
+    return LayerOutput(name=name, layer_type="embedding", inputs=[inp],
+                       fn=compute, params=params, size=size,
+                       is_sequence=inp.is_sequence)
+
+
+# ---- mixed layer & projections (reference: MixedLayer.cpp, Projection.h) ---
+
+
+class Projection:
+    """Projection descriptor for mixed(); computes a [*, size] contribution."""
+
+    def __init__(self, input: LayerOutput, size: Optional[int]):
+        self.input = input
+        self.size = size
+        self.params: Dict[str, ParamSpec] = {}
+
+    def compute(self, p: Dict[str, jax.Array], value):
+        raise NotImplementedError
+
+
+class _FullMatrixProjection(Projection):
+    def __init__(self, input, size, param_attr=None, trans=False):
+        super().__init__(input, size)
+        self.trans = trans
+        shape = (size, input.size) if trans else (input.size, size)
+        self.params["w"] = ParamSpec(shape, ParamAttr.to_attr(param_attr))
+
+    def compute(self, p, value):
+        return pmath.matmul(_data_of(value), p["w"], trans_b=self.trans)
+
+
+@_export
+def full_matrix_projection(input, size: int, param_attr=None) -> Projection:
+    return _FullMatrixProjection(input, size, param_attr)
+
+
+@_export
+def trans_full_matrix_projection(input, size: int, param_attr=None) -> Projection:
+    """Uses W^T (reference: TransposedFullMatrixProjection)."""
+    return _FullMatrixProjection(input, size, param_attr, trans=True)
+
+
+class _IdentityProjection(Projection):
+    def __init__(self, input, offset=0, size=None):
+        out_size = size or input.size
+        super().__init__(input, out_size)
+        self.offset = offset
+
+    def compute(self, p, value):
+        d = _data_of(value)
+        return jax.lax.slice_in_dim(d, self.offset, self.offset + self.size, axis=-1)
+
+
+@_export
+def identity_projection(input, offset: int = 0, size: int = None) -> Projection:
+    return _IdentityProjection(input, offset, size)
+
+
+@_export
+def slice_projection(input, slices: Sequence[Tuple[int, int]], **kw) -> Projection:
+    class _Slice(Projection):
+        def __init__(self):
+            total = sum(e - s for s, e in slices)
+            super().__init__(input, total)
+
+        def compute(self, p, value):
+            d = _data_of(value)
+            parts = [jax.lax.slice_in_dim(d, s, e, axis=-1) for s, e in slices]
+            return jnp.concatenate(parts, axis=-1)
+
+    return _Slice()
+
+
+class _DotMulProjection(Projection):
+    def __init__(self, input, param_attr=None):
+        super().__init__(input, input.size)
+        self.params["w"] = ParamSpec((input.size,), ParamAttr.to_attr(param_attr))
+
+    def compute(self, p, value):
+        return _data_of(value) * p["w"]
+
+
+@_export
+def dotmul_projection(input, param_attr=None) -> Projection:
+    return _DotMulProjection(input, param_attr)
+
+
+class _ScalingProjection(Projection):
+    def __init__(self, input, param_attr=None):
+        super().__init__(input, input.size)
+        self.params["w"] = ParamSpec((1,), ParamAttr.to_attr(param_attr))
+
+    def compute(self, p, value):
+        return _data_of(value) * p["w"][0]
+
+
+@_export
+def scaling_projection(input, param_attr=None) -> Projection:
+    return _ScalingProjection(input, param_attr)
+
+
+class _TableProjection(Projection):
+    def __init__(self, input, size, param_attr=None):
+        super().__init__(input, size)
+        self.params["w"] = ParamSpec((input.size, size), ParamAttr.to_attr(param_attr))
+
+    def compute(self, p, value):
+        return embedding_lookup(p["w"], _data_of(value))
+
+
+@_export
+def table_projection(input, size: int, param_attr=None) -> Projection:
+    return _TableProjection(input, size, param_attr)
+
+
+class _ContextProjection(Projection):
+    """Sliding window concat over sequence tokens (reference:
+    ContextProjection / function/ContextProjectionOp.cpp)."""
+
+    def __init__(self, input, context_len, context_start, param_attr=None,
+                 trainable_padding=False):
+        super().__init__(input, input.size * context_len)
+        self.context_len = context_len
+        self.context_start = context_start
+        self.trainable_padding = trainable_padding
+        if trainable_padding:
+            pad_rows = max(0, -context_start) + max(0, context_start + context_len - 1)
+            self.params["pad"] = ParamSpec((max(1, pad_rows), input.size),
+                                           ParamAttr.to_attr(param_attr))
+
+    def compute(self, p, value):
+        enforce_that(isinstance(value, SequenceBatch),
+                     "context projection needs sequence input", context="mixed")
+        padded, mask = value.to_padded()
+        B, T, D = padded.shape
+        cols = []
+        for k in range(self.context_len):
+            off = self.context_start + k
+            shifted = jnp.roll(padded, -off, axis=1)
+            # zero (or learned pad) outside range
+            t = jnp.arange(T)[None, :]
+            valid = (t + off >= 0) & (t + off < value.lengths[:, None])
+            col = jnp.where(valid[..., None], shifted, 0.0)
+            cols.append(col)
+        out = jnp.concatenate(cols, axis=-1)
+        flat = SequenceBatch.from_padded(out, value.lengths, capacity=value.capacity)
+        return flat.data
+
+
+@_export
+def context_projection(input, context_len: int, context_start: int = None,
+                       padding_attr=False, **kw) -> Projection:
+    start = context_start if context_start is not None else -(context_len // 2)
+    trainable = padding_attr is not False and padding_attr is not None
+    return _ContextProjection(input, context_len, start,
+                              param_attr=None if padding_attr in (False, True, None) else padding_attr,
+                              trainable_padding=trainable)
+
+
+class Operator:
+    """Mixed-layer operator (reference: Operator.h — conv, dot_mul)."""
+
+    def __init__(self, inputs: List[LayerOutput], size: Optional[int]):
+        self.inputs = inputs
+        self.size = size
+
+    def compute(self, values: list):
+        raise NotImplementedError
+
+
+@_export
+def dotmul_operator(a: LayerOutput, b: LayerOutput, scale: float = 1.0) -> Operator:
+    class _DotMul(Operator):
+        def __init__(self):
+            super().__init__([a, b], a.size)
+
+        def compute(self, values):
+            return scale * _data_of(values[0]) * _data_of(values[1])
+
+    return _DotMul()
+
+
+@_export
+def conv_operator(img: LayerOutput, filter: LayerOutput, filter_size: int,
+                  num_filters: int, num_channels: int, stride: int = 1,
+                  padding: int = 0) -> Operator:
+    """Conv with filter coming from a layer (dynamic filter conv)."""
+
+    class _ConvOp(Operator):
+        def __init__(self):
+            super().__init__([img, filter], None)
+
+        def compute(self, values):
+            x, f = _data_of(values[0]), _data_of(values[1])
+            B = x.shape[0]
+            h = int(round((x.shape[-1] // num_channels) ** 0.5)) if x.ndim == 2 else x.shape[1]
+            if x.ndim == 2:
+                x = x.reshape(B, h, h, num_channels)
+            w = f.reshape(B, filter_size, filter_size, num_channels, num_filters)
+
+            def one(xi, wi):
+                return pconv.conv2d(xi[None], wi, stride=stride, padding=padding)[0]
+
+            y = jax.vmap(one)(x, w)
+            return y.reshape(B, -1)
+
+    return _ConvOp()
+
+
+@_export
+def mixed(size: int = None, input=None, name: Optional[str] = None, act=None,
+          bias_attr=False, layer_attr=None) -> LayerOutput:
+    """Sum of projections/operators (reference: mixed_layer, MixedLayer.cpp)."""
+    name = name or unique_name("mixed")
+    comps = _as_list(input)
+    enforce_that(len(comps) > 0, "mixed needs at least one projection", context="mixed")
+    activation = _resolve_act(act)
+    # infer size
+    sizes = [c.size for c in comps if c.size is not None]
+    if size is None:
+        enforce_that(len(sizes) > 0, "mixed size cannot be inferred", context="mixed")
+        size = sizes[0]
+
+    graph_inputs: List[LayerOutput] = []
+    proj_params: Dict[str, ParamSpec] = {}
+    plan = []  # (kind, component, input_indices, param_prefix)
+    for ci, comp in enumerate(comps):
+        if isinstance(comp, Projection):
+            graph_inputs.append(comp.input)
+            prefix = f"p{ci}_"
+            for pn, spec in comp.params.items():
+                proj_params[prefix + pn] = spec
+            plan.append(("proj", comp, [len(graph_inputs) - 1], prefix))
+        elif isinstance(comp, Operator):
+            idxs = []
+            for inp in comp.inputs:
+                graph_inputs.append(inp)
+                idxs.append(len(graph_inputs) - 1)
+            plan.append(("op", comp, idxs, None))
+        elif isinstance(comp, LayerOutput):
+            proj = identity_projection(comp)
+            graph_inputs.append(comp)
+            plan.append(("proj", proj, [len(graph_inputs) - 1], f"p{ci}_"))
+        else:
+            raise EnforceError(f"bad mixed component {comp!r}", context="mixed")
+
+    has_bias = bool(bias_attr)
+    if has_bias:
+        battr = ParamAttr.to_attr(None if bias_attr is True else bias_attr)
+        proj_params["b"] = ParamSpec((size,), battr)
+
+    is_seq = graph_inputs[0].is_sequence
+
+    def compute(ctx, p, ins):
+        total = None
+        template = ins[0]
+        for kind, comp, idxs, prefix in plan:
+            if kind == "proj":
+                local = {k[len(prefix):]: v for k, v in p.items() if k.startswith(prefix)}
+                y = comp.compute(local, ins[idxs[0]])
+            else:
+                y = comp.compute([ins[i] for i in idxs])
+            total = y if total is None else total + y
+        if has_bias:
+            total = total + p["b"]
+        out = _like(template, total) if isinstance(template, SequenceBatch) else total
+        out = _apply_act(activation, out)
+        return _apply_extra(ctx, name, out, layer_attr)
+
+    return LayerOutput(name=name, layer_type="mixed", inputs=graph_inputs,
+                       fn=compute, params=proj_params, size=size,
+                       is_sequence=is_seq)
+
+
+# ---------------------------------------------------------------------------
+# elementwise / math layers
+# ---------------------------------------------------------------------------
+
+
+@_export
+def addto(input, act=None, name: Optional[str] = None, bias_attr=False,
+          layer_attr=None) -> LayerOutput:
+    """Elementwise sum (reference: addto_layer/AddtoLayer.cpp)."""
+    inputs = _as_list(input)
+    name = name or unique_name("addto")
+    activation = _resolve_act(act)
+    params = {}
+    has_bias = bool(bias_attr)
+    if has_bias:
+        params["b"] = ParamSpec((inputs[0].size,), ParamAttr.to_attr(
+            None if bias_attr is True else bias_attr))
+
+    def compute(ctx, p, ins):
+        total = _data_of(ins[0])
+        for v in ins[1:]:
+            total = total + _data_of(v)
+        if has_bias:
+            total = total + p["b"]
+        out = _like(ins[0], total)
+        out = _apply_act(activation, out)
+        return _apply_extra(ctx, name, out, layer_attr)
+
+    return LayerOutput(name=name, layer_type="addto", inputs=inputs, fn=compute,
+                       params=params, size=inputs[0].size,
+                       is_sequence=inputs[0].is_sequence)
+
+
+@_export
+def concat(input, name: Optional[str] = None, act=None, layer_attr=None) -> LayerOutput:
+    """Feature-dim concat (reference: concat_layer/ConcatenateLayer)."""
+    inputs = _as_list(input)
+    name = name or unique_name("concat")
+    activation = _resolve_act(act)
+    size = sum(i.size for i in inputs)
+
+    def compute(ctx, p, ins):
+        out = jnp.concatenate([_data_of(v) for v in ins], axis=-1)
+        out = _like(ins[0], out)
+        out = _apply_act(activation, out)
+        return _apply_extra(ctx, name, out, layer_attr)
+
+    return LayerOutput(name=name, layer_type="concat", inputs=inputs, fn=compute,
+                       size=size, is_sequence=inputs[0].is_sequence)
+
+
+@_export
+def dotmul(a, b, name: Optional[str] = None) -> LayerOutput:
+    """Elementwise product of two layers."""
+    name = name or unique_name("dotmul")
+
+    def compute(ctx, p, ins):
+        return _like(ins[0], _data_of(ins[0]) * _data_of(ins[1]))
+
+    return LayerOutput(name=name, layer_type="dotmul", inputs=[a, b], fn=compute,
+                       size=a.size, is_sequence=a.is_sequence)
+
+
+@_export
+def interpolation(input, weight, name: Optional[str] = None) -> LayerOutput:
+    """out = w*a + (1-w)*b with per-example scalar w (reference:
+    interpolation_layer/InterpolationLayer.cpp). input=[a, b]."""
+    a, b = _as_list(input)
+    name = name or unique_name("interpolation")
+
+    def compute(ctx, p, ins):
+        va, vb, w = _data_of(ins[0]), _data_of(ins[1]), _data_of(ins[2])
+        w = w.reshape(w.shape[0], *([1] * (va.ndim - 1)))
+        return _like(ins[0], w * va + (1.0 - w) * vb)
+
+    return LayerOutput(name=name, layer_type="interpolation", inputs=[a, b, weight],
+                       fn=compute, size=a.size, is_sequence=a.is_sequence)
+
+
+@_export
+def scaling(input, weight, name: Optional[str] = None) -> LayerOutput:
+    """Row-wise scale by a per-example scalar (reference: scaling_layer)."""
+    name = name or unique_name("scaling")
+
+    def compute(ctx, p, ins):
+        v, w = _data_of(ins[0]), _data_of(ins[1])
+        w = w.reshape(w.shape[0], *([1] * (v.ndim - 1)))
+        return _like(ins[0], w * v)
+
+    return LayerOutput(name=name, layer_type="scaling", inputs=[input, weight],
+                       fn=compute, size=input.size, is_sequence=input.is_sequence)
+
+
+@_export
+def power(input, weight, name: Optional[str] = None) -> LayerOutput:
+    """Elementwise x^w with per-example scalar w (reference: power_layer)."""
+    name = name or unique_name("power")
+
+    def compute(ctx, p, ins):
+        v, w = _data_of(ins[0]), _data_of(ins[1])
+        w = w.reshape(w.shape[0], *([1] * (v.ndim - 1)))
+        return _like(ins[0], jnp.power(v, w))
+
+    return LayerOutput(name=name, layer_type="power", inputs=[input, weight],
+                       fn=compute, size=input.size, is_sequence=input.is_sequence)
+
+
+@_export
+def slope_intercept(input, slope: float = 1.0, intercept: float = 0.0,
+                    name: Optional[str] = None) -> LayerOutput:
+    """y = slope*x + intercept (reference: slope_intercept_layer)."""
+    name = name or unique_name("slope_intercept")
+
+    def compute(ctx, p, ins):
+        return _like(ins[0], slope * _data_of(ins[0]) + intercept)
+
+    return LayerOutput(name=name, layer_type="slope_intercept", inputs=[input],
+                       fn=compute, size=input.size, is_sequence=input.is_sequence)
+
+
+@_export
+def sum_to_one_norm(input, name: Optional[str] = None) -> LayerOutput:
+    name = name or unique_name("sum_to_one_norm")
+
+    def compute(ctx, p, ins):
+        return _like(ins[0], pnorm.sum_to_one_norm(_data_of(ins[0])))
+
+    return LayerOutput(name=name, layer_type="sum_to_one_norm", inputs=[input],
+                       fn=compute, size=input.size, is_sequence=input.is_sequence)
+
+
+@_export
+def row_l2_norm(input, name: Optional[str] = None) -> LayerOutput:
+    name = name or unique_name("row_l2_norm")
+
+    def compute(ctx, p, ins):
+        return _like(ins[0], pnorm.row_l2_norm(_data_of(ins[0])))
+
+    return LayerOutput(name=name, layer_type="row_l2_norm", inputs=[input],
+                       fn=compute, size=input.size, is_sequence=input.is_sequence)
+
+
+@_export
+def cos_sim(a, b, scale: float = 1.0, name: Optional[str] = None) -> LayerOutput:
+    """Cosine similarity (reference: cos_sim/CosSimLayer.cpp)."""
+    name = name or unique_name("cos_sim")
+
+    def compute(ctx, p, ins):
+        return ploss.cosine_similarity(_data_of(ins[0]), _data_of(ins[1]), scale)[..., None]
+
+    return LayerOutput(name=name, layer_type="cos_sim", inputs=[a, b], fn=compute,
+                       size=1, is_sequence=a.is_sequence)
+
+
+@_export
+def clip(input, min: float, max: float, name: Optional[str] = None) -> LayerOutput:
+    """Elementwise clip (reference: ClipLayer.cpp)."""
+    name = name or unique_name("clip")
+
+    def compute(ctx, p, ins):
+        return _like(ins[0], jnp.clip(_data_of(ins[0]), min, max))
+
+    return LayerOutput(name=name, layer_type="clip", inputs=[input], fn=compute,
+                       size=input.size, is_sequence=input.is_sequence)
+
+
+@_export
+def resize(input, size: int, name: Optional[str] = None) -> LayerOutput:
+    """Reshape feature dim (reference: ResizeLayer)."""
+    name = name or unique_name("resize")
+
+    def compute(ctx, p, ins):
+        d = _data_of(ins[0])
+        return _like(ins[0], d.reshape(d.shape[0], size) if not isinstance(ins[0], SequenceBatch)
+                     else d.reshape(d.shape[0], size))
+
+    return LayerOutput(name=name, layer_type="resize", inputs=[input], fn=compute,
+                       size=size, is_sequence=input.is_sequence)
+
+
+@_export
+def dropout(input, dropout_rate: float, name: Optional[str] = None) -> LayerOutput:
+    """Standalone dropout (reference: dropout_layer helper)."""
+    name = name or unique_name("dropout")
+
+    def compute(ctx, p, ins):
+        v = ins[0]
+        key = ctx.rng_for(name)
+        if isinstance(v, SequenceBatch):
+            return v.with_data(pmath.dropout(v.data, dropout_rate, key, ctx.train))
+        return pmath.dropout(v, dropout_rate, key, ctx.train)
+
+    return LayerOutput(name=name, layer_type="dropout", inputs=[input], fn=compute,
+                       size=input.size, is_sequence=input.is_sequence)
+
+
+# ---------------------------------------------------------------------------
+# image layers
+# ---------------------------------------------------------------------------
+
+
+def _img_shape_of(node: LayerOutput) -> Optional[Tuple[int, int, int]]:
+    """(H, W, C) metadata threaded through the image stack."""
+    shp = getattr(node, "img_shape", None)
+    if shp is not None:
+        return shp
+    h = getattr(node, "height", None)
+    w = getattr(node, "width", None)
+    if h and w and node.size and node.size % (h * w) == 0:
+        return (h, w, node.size // (h * w))
+    return None
+
+
+def _to_nhwc(v: jax.Array, shape_hwc: Tuple[int, int, int]) -> jax.Array:
+    """Accept [B, H, W, C] passthrough or flat [B, C*H*W] (reference layout is
+    CHW-major, matching PyDataProvider2 dense image slots)."""
+    if v.ndim == 4:
+        return v
+    h, w, c = shape_hwc
+    return v.reshape(v.shape[0], c, h, w).transpose(0, 2, 3, 1)
+
+
+def _conv_out_dim(in_size, k, pad, stride):
+    return (in_size + 2 * pad - k) // stride + 1
+
+
+@_export
+def img_conv(input, filter_size: int, num_filters: int, num_channels: int = None,
+             stride: int = 1, padding: int = 0, groups: int = 1, act=None,
+             name: Optional[str] = None, param_attr=None, bias_attr=True,
+             shared_biases: bool = True, trans: bool = False,
+             dilation: int = 1, layer_attr=None) -> LayerOutput:
+    """2-D convolution (reference: img_conv_layer → ExpandConvLayer /
+    CudnnConvLayer; trans=True → ConvTransLayer).
+
+    Weights are HWIO; compute is NHWC on the MXU (ops/conv.py)."""
+    inp = input
+    name = name or unique_name("conv")
+    activation = _resolve_act(act)
+    in_shape = _img_shape_of(inp)
+    enforce_that(in_shape is not None or num_channels is not None,
+                 "img_conv needs image shape metadata or num_channels", context="img_conv")
+    if in_shape is None:
+        # assume square image
+        import math as _math
+        hw = int(round(_math.sqrt(inp.size // num_channels)))
+        in_shape = (hw, hw, num_channels)
+    h, w, c = in_shape
+    num_channels = num_channels or c
+    if trans:
+        oh = (h - 1) * stride + filter_size - 2 * padding
+        ow = (w - 1) * stride + filter_size - 2 * padding
+        wshape = (filter_size, filter_size, num_channels, num_filters)
+    else:
+        oh = _conv_out_dim(h, filter_size, padding, stride)
+        ow = _conv_out_dim(w, filter_size, padding, stride)
+        wshape = (filter_size, filter_size, num_channels // groups, num_filters)
+    params = {"w": ParamSpec(wshape, ParamAttr.to_attr(param_attr))}
+    has_bias = bool(bias_attr)
+    if has_bias:
+        bshape = (num_filters,) if shared_biases else (num_filters * oh * ow,)
+        params["b"] = ParamSpec(bshape, ParamAttr.to_attr(
+            None if bias_attr is True else bias_attr))
+
+    def compute(ctx, p, ins):
+        x = _to_nhwc(_data_of(ins[0]), in_shape)
+        if trans:
+            y = pconv.conv2d_transpose(x, p["w"], stride=stride, padding=padding)
+        else:
+            y = pconv.conv2d(x, p["w"], stride=stride, padding=padding,
+                             dilation=dilation, groups=groups)
+        if has_bias:
+            if shared_biases:
+                y = y + p["b"]
+            else:
+                y = y + p["b"].reshape(1, oh, ow, num_filters)
+        y = _apply_act(activation, y)
+        return _apply_extra(ctx, name, y, layer_attr)
+
+    node = LayerOutput(name=name, layer_type="conv", inputs=[inp], fn=compute,
+                       params=params, size=oh * ow * num_filters)
+    node.img_shape = (oh, ow, num_filters)
+    return node
+
+
+@_export
+def img_pool(input, pool_size: int, pool_type=None, stride: int = None,
+             padding: int = 0, name: Optional[str] = None,
+             layer_attr=None, **_kw) -> LayerOutput:
+    """Image pooling (reference: img_pool_layer → PoolLayer/CudnnPoolLayer)."""
+    inp = input
+    name = name or unique_name("pool")
+    ptype = pooling_mod.get(pool_type)
+    stride = stride if stride is not None else pool_size
+    in_shape = _img_shape_of(inp)
+    enforce_that(in_shape is not None, "img_pool needs image shape", context="img_pool")
+    h, w, c = in_shape
+    oh = _conv_out_dim(h, pool_size, padding, stride)
+    ow = _conv_out_dim(w, pool_size, padding, stride)
+
+    def compute(ctx, p, ins):
+        x = _to_nhwc(_data_of(ins[0]), in_shape)
+        if isinstance(ptype, pooling_mod.MaxPooling):
+            y = ppool.max_pool2d(x, pool_size, stride, padding)
+        else:
+            y = ppool.avg_pool2d(x, pool_size, stride, padding)
+        return _apply_extra(ctx, name, y, layer_attr)
+
+    node = LayerOutput(name=name, layer_type="pool", inputs=[inp], fn=compute,
+                       size=oh * ow * c)
+    node.img_shape = (oh, ow, c)
+    return node
+
+
+@_export
+def spp(input, pyramid_height: int, num_channels: int = None, pool_type=None,
+        name: Optional[str] = None) -> LayerOutput:
+    """Spatial pyramid pooling (reference: spp_layer)."""
+    inp = input
+    name = name or unique_name("spp")
+    in_shape = _img_shape_of(inp)
+    enforce_that(in_shape is not None, "spp needs image shape", context="spp")
+    c = in_shape[2]
+    ptype = pooling_mod.get(pool_type)
+    out_size = sum(4 ** l for l in range(pyramid_height)) * c
+
+    def compute(ctx, p, ins):
+        x = _to_nhwc(_data_of(ins[0]), in_shape)
+        return ppool.spatial_pyramid_pool(
+            x, pyramid_height,
+            "max" if isinstance(ptype, pooling_mod.MaxPooling) else "avg")
+
+    return LayerOutput(name=name, layer_type="spp", inputs=[inp], fn=compute,
+                       size=out_size)
+
+
+@_export
+def maxout(input, groups: int, num_channels: int = None,
+           name: Optional[str] = None) -> LayerOutput:
+    """Maxout over channel groups (reference: maxout_layer)."""
+    inp = input
+    name = name or unique_name("maxout")
+    in_shape = _img_shape_of(inp)
+    enforce_that(in_shape is not None, "maxout needs image shape", context="maxout")
+    h, w, c = in_shape
+    oc = c // groups
+
+    def compute(ctx, p, ins):
+        x = _to_nhwc(_data_of(ins[0]), in_shape)
+        return ppool.maxout(x, groups)
+
+    node = LayerOutput(name=name, layer_type="maxout", inputs=[inp], fn=compute,
+                       size=h * w * oc)
+    node.img_shape = (h, w, oc)
+    return node
+
+
+@_export
+def batch_norm(input, act=None, name: Optional[str] = None,
+               num_channels: int = None, bias_attr=None, param_attr=None,
+               use_global_stats: bool = None, moving_average_fraction: float = 0.9,
+               layer_attr=None, **_kw) -> LayerOutput:
+    """Batch normalization with moving stats in the state pytree
+    (reference: batch_norm_layer → BatchNormalizationLayer/CudnnBatchNormLayer)."""
+    inp = input
+    name = name or unique_name("batch_norm")
+    activation = _resolve_act(act)
+    in_shape = _img_shape_of(inp)
+    c = in_shape[2] if in_shape is not None else inp.size
+    params = {
+        "gamma": ParamSpec((c,), ParamAttr.to_attr(param_attr) if param_attr
+                           else ParamAttr(initializer=Constant(1.0))),
+        "beta": ParamSpec((c,), ParamAttr.to_attr(bias_attr) if bias_attr
+                          else ParamAttr(initializer=Constant(0.0))),
+    }
+    state = {
+        "moving_mean": StateSpec((c,), 0.0),
+        "moving_var": StateSpec((c,), 1.0),
+    }
+
+    def compute(ctx, p, ins):
+        v = ins[0]
+        x = _data_of(v)
+        if in_shape is not None:
+            x = _to_nhwc(x, in_shape)
+        y, nm, nv = pnorm.batch_norm(
+            x, p["gamma"], p["beta"],
+            ctx.get_state(name, "moving_mean"), ctx.get_state(name, "moving_var"),
+            train=ctx.train, momentum=moving_average_fraction,
+            use_global_stats=use_global_stats)
+        ctx.set_state(name, "moving_mean", nm)
+        ctx.set_state(name, "moving_var", nv)
+        y = _apply_act(activation, y)
+        y = _apply_extra(ctx, name, y, layer_attr)
+        return _like(v, y) if isinstance(v, SequenceBatch) else y
+
+    node = LayerOutput(name=name, layer_type="batch_norm", inputs=[inp],
+                       fn=compute, params=params, state=state, size=inp.size,
+                       is_sequence=inp.is_sequence)
+    if in_shape is not None:
+        node.img_shape = in_shape
+    return node
+
+
+@_export
+def img_cmrnorm(input, size: int = 5, scale: float = 0.0001, power: float = 0.75,
+                name: Optional[str] = None, **_kw) -> LayerOutput:
+    """Local response normalization across maps (reference: img_cmrnorm_layer
+    → CMRProjectionNormLayer, function/CrossMapNormalOp.cpp)."""
+    inp = input
+    name = name or unique_name("cmrnorm")
+    in_shape = _img_shape_of(inp)
+    enforce_that(in_shape is not None, "cmrnorm needs image shape", context="cmrnorm")
+
+    def compute(ctx, p, ins):
+        x = _to_nhwc(_data_of(ins[0]), in_shape)
+        return pnorm.cross_map_norm(x, size, scale, power)
+
+    node = LayerOutput(name=name, layer_type="cmrnorm", inputs=[inp], fn=compute,
+                       size=inp.size)
+    node.img_shape = in_shape
+    return node
+
+
+@_export
+def bilinear_interp(input, out_size_x: int, out_size_y: int,
+                    name: Optional[str] = None) -> LayerOutput:
+    """Bilinear upsampling (reference: bilinear_interp_layer, hl_cnn bilinear)."""
+    inp = input
+    name = name or unique_name("bilinear_interp")
+    in_shape = _img_shape_of(inp)
+    enforce_that(in_shape is not None, "bilinear_interp needs image shape",
+                 context="bilinear_interp")
+    h, w, c = in_shape
+
+    def compute(ctx, p, ins):
+        x = _to_nhwc(_data_of(ins[0]), in_shape)
+        return jax.image.resize(x, (x.shape[0], out_size_y, out_size_x, c),
+                                method="bilinear")
+
+    node = LayerOutput(name=name, layer_type="bilinear_interp", inputs=[inp],
+                       fn=compute, size=out_size_x * out_size_y * c)
+    node.img_shape = (out_size_y, out_size_x, c)
+    return node
+
+
+@_export
+def pad(input, pad_c=(0, 0), pad_h=(0, 0), pad_w=(0, 0),
+        name: Optional[str] = None) -> LayerOutput:
+    """Zero-pad image dims (reference: pad_layer, function/PadOp.cpp)."""
+    inp = input
+    name = name or unique_name("pad")
+    in_shape = _img_shape_of(inp)
+    enforce_that(in_shape is not None, "pad needs image shape", context="pad")
+    h, w, c = in_shape
+    oshape = (h + sum(pad_h), w + sum(pad_w), c + sum(pad_c))
+
+    def compute(ctx, p, ins):
+        x = _to_nhwc(_data_of(ins[0]), in_shape)
+        return jnp.pad(x, ((0, 0), tuple(pad_h), tuple(pad_w), tuple(pad_c)))
+
+    node = LayerOutput(name=name, layer_type="pad", inputs=[inp], fn=compute,
+                       size=oshape[0] * oshape[1] * oshape[2])
+    node.img_shape = oshape
+    return node
+
+
+@_export
+def crop(input, offset_h: int = 0, offset_w: int = 0, crop_h: int = None,
+         crop_w: int = None, name: Optional[str] = None) -> LayerOutput:
+    """Crop image dims (reference: crop_layer, function/CropOp.cpp)."""
+    inp = input
+    name = name or unique_name("crop")
+    in_shape = _img_shape_of(inp)
+    enforce_that(in_shape is not None, "crop needs image shape", context="crop")
+    h, w, c = in_shape
+    ch = crop_h or h - offset_h
+    cw = crop_w or w - offset_w
+
+    def compute(ctx, p, ins):
+        x = _to_nhwc(_data_of(ins[0]), in_shape)
+        return x[:, offset_h:offset_h + ch, offset_w:offset_w + cw, :]
+
+    node = LayerOutput(name=name, layer_type="crop", inputs=[inp], fn=compute,
+                       size=ch * cw * c)
+    node.img_shape = (ch, cw, c)
+    return node
+
+
+@_export
+def rotate(input, name: Optional[str] = None) -> LayerOutput:
+    """90-degree CCW rotation (reference: rotate_layer/RotateLayer.cpp)."""
+    inp = input
+    name = name or unique_name("rotate")
+    in_shape = _img_shape_of(inp)
+    enforce_that(in_shape is not None, "rotate needs image shape", context="rotate")
+    h, w, c = in_shape
+
+    def compute(ctx, p, ins):
+        x = _to_nhwc(_data_of(ins[0]), in_shape)
+        return jnp.rot90(x, k=1, axes=(1, 2))
+
+    node = LayerOutput(name=name, layer_type="rotate", inputs=[inp], fn=compute,
+                       size=inp.size)
+    node.img_shape = (w, h, c)
+    return node
+
+
+@_export
+def block_expand(input, block_x: int, block_y: int, stride_x: int = 1,
+                 stride_y: int = 1, padding_x: int = 0, padding_y: int = 0,
+                 num_channels: int = None, name: Optional[str] = None) -> LayerOutput:
+    """im2col layer (reference: block_expand_layer/BlockExpandLayer)."""
+    inp = input
+    name = name or unique_name("block_expand")
+    in_shape = _img_shape_of(inp)
+    enforce_that(in_shape is not None, "block_expand needs image shape",
+                 context="block_expand")
+    h, w, c = in_shape
+    oh = (h + 2 * padding_y - block_y) // stride_y + 1
+    ow = (w + 2 * padding_x - block_x) // stride_x + 1
+
+    def compute(ctx, p, ins):
+        x = _to_nhwc(_data_of(ins[0]), in_shape)
+        return pconv.block_expand(x, (block_y, block_x), (stride_y, stride_x),
+                                  (padding_y, padding_x))
+
+    return LayerOutput(name=name, layer_type="block_expand", inputs=[inp],
+                       fn=compute, size=block_x * block_y * c)
+
+
+# ---------------------------------------------------------------------------
+# sequence layers
+# ---------------------------------------------------------------------------
+
+
+def _need_seq(node, ctx_name):
+    enforce_that(node.is_sequence, f"{ctx_name} needs a sequence input",
+                 context=ctx_name)
+
+
+@_export
+def pooling(input, pooling_type=None, name: Optional[str] = None,
+            **_kw) -> LayerOutput:
+    """Sequence pooling to one vector per sequence (reference: pooling_layer
+    → SequencePoolLayer max/avg/sum/sqrtn)."""
+    inp = input
+    _need_seq(inp, "pooling")
+    name = name or unique_name("seq_pool")
+    ptype = pooling_mod.get(pooling_type)
+
+    def compute(ctx, p, ins):
+        sb = ins[0]
+        if isinstance(ptype, pooling_mod.MaxPooling):
+            return pseq.seq_pool_max(sb)
+        if isinstance(ptype, pooling_mod.AvgPooling):
+            return pseq.seq_pool_avg(sb)
+        if isinstance(ptype, pooling_mod.SumPooling):
+            return pseq.seq_pool_sum(sb)
+        return pseq.seq_pool_sqrtn(sb)
+
+    return LayerOutput(name=name, layer_type="seq_pool", inputs=[inp],
+                       fn=compute, size=inp.size, is_sequence=False)
+
+
+@_export
+def last_seq(input, name: Optional[str] = None, **_kw) -> LayerOutput:
+    """Last token of each sequence (reference: last_seq → SequenceLastInstance)."""
+    inp = input
+    _need_seq(inp, "last_seq")
+    name = name or unique_name("last_seq")
+
+    def compute(ctx, p, ins):
+        return pseq.seq_last(ins[0])
+
+    return LayerOutput(name=name, layer_type="last_seq", inputs=[inp], fn=compute,
+                       size=inp.size, is_sequence=False)
+
+
+@_export
+def first_seq(input, name: Optional[str] = None, **_kw) -> LayerOutput:
+    """First token of each sequence (reference: first_seq)."""
+    inp = input
+    _need_seq(inp, "first_seq")
+    name = name or unique_name("first_seq")
+
+    def compute(ctx, p, ins):
+        return pseq.seq_first(ins[0])
+
+    return LayerOutput(name=name, layer_type="first_seq", inputs=[inp], fn=compute,
+                       size=inp.size, is_sequence=False)
+
+
+@_export
+def expand(input, expand_as, name: Optional[str] = None, **_kw) -> LayerOutput:
+    """Broadcast per-sequence rows to token layout (reference: expand_layer)."""
+    name = name or unique_name("expand")
+
+    def compute(ctx, p, ins):
+        return pseq.seq_expand(ins[0], ins[1])
+
+    return LayerOutput(name=name, layer_type="expand", inputs=[input, expand_as],
+                       fn=compute, size=input.size, is_sequence=True)
+
+
+@_export
+def seq_concat(a, b, name: Optional[str] = None, **_kw) -> LayerOutput:
+    """Concat along time (reference: seq_concat_layer)."""
+    name = name or unique_name("seq_concat")
+
+    def compute(ctx, p, ins):
+        return pseq.seq_concat(ins[0], ins[1])
+
+    return LayerOutput(name=name, layer_type="seq_concat", inputs=[a, b],
+                       fn=compute, size=a.size, is_sequence=True)
+
+
+@_export
+def seq_reshape(input, reshape_size: int, name: Optional[str] = None,
+                **_kw) -> LayerOutput:
+    """Reshape token dim (reference: seq_reshape_layer)."""
+    inp = input
+    _need_seq(inp, "seq_reshape")
+    name = name or unique_name("seq_reshape")
+
+    def compute(ctx, p, ins):
+        return pseq.seq_reshape(ins[0], reshape_size)
+
+    return LayerOutput(name=name, layer_type="seq_reshape", inputs=[inp],
+                       fn=compute, size=reshape_size, is_sequence=True)
+
+
+@_export
+def seq_slice(input, starts=None, ends=None, name: Optional[str] = None) -> LayerOutput:
+    """Slice each sequence by per-sequence [start, end) (reference:
+    seq_slice_layer). starts/ends are layers carrying int positions or None."""
+    inp = input
+    _need_seq(inp, "seq_slice")
+    name = name or unique_name("seq_slice")
+    extra = [l for l in (starts, ends) if l is not None]
+
+    def compute(ctx, p, ins):
+        sb = ins[0]
+        idx = 1
+        if starts is not None:
+            s = _data_of(ins[idx]).reshape(-1).astype(jnp.int32)
+            idx += 1
+        else:
+            s = jnp.zeros((sb.num_seqs,), jnp.int32)
+        if ends is not None:
+            e = _data_of(ins[idx]).reshape(-1).astype(jnp.int32)
+        else:
+            e = sb.lengths
+        return pseq.seq_slice(sb, s, e)
+
+    return LayerOutput(name=name, layer_type="seq_slice", inputs=[inp] + extra,
+                       fn=compute, size=inp.size, is_sequence=True)
+
+
+@_export
+def kmax_seq_score(input, beam_size: int, name: Optional[str] = None) -> LayerOutput:
+    """Top-k positions by score in each sequence (reference: kmax_seq_score)."""
+    inp = input
+    _need_seq(inp, "kmax_seq_score")
+    name = name or unique_name("kmax_seq_score")
+
+    def compute(ctx, p, ins):
+        return pseq.kmax_seq_score(ins[0], beam_size)
+
+    return LayerOutput(name=name, layer_type="kmax_seq_score", inputs=[inp],
+                       fn=compute, size=beam_size, is_sequence=False)
+
+
+@_export
+def sub_nested_seq(input, selected_indices, name: Optional[str] = None) -> LayerOutput:
+    """Select inner sequences of a nested sequence (reference: sub_nested_seq)."""
+    name = name or unique_name("sub_nested_seq")
+
+    def compute(ctx, p, ins):
+        return pseq.sub_nested_seq(ins[0], _data_of(ins[1]).astype(jnp.int32))
+
+    return LayerOutput(name=name, layer_type="sub_nested_seq",
+                       inputs=[input, selected_indices], fn=compute,
+                       size=input.size, is_sequence=True)
+
+
+@_export
+def max_id(input, name: Optional[str] = None) -> LayerOutput:
+    """Argmax id (reference: maxid_layer/MaxIdLayer.cpp)."""
+    inp = input
+    name = name or unique_name("max_id")
+
+    def compute(ctx, p, ins):
+        v = ins[0]
+        return _like(v, pseq.max_id(_data_of(v)))
+
+    return LayerOutput(name=name, layer_type="max_id", inputs=[inp], fn=compute,
+                       size=1, is_sequence=inp.is_sequence)
+
+
+@_export
+def sampling_id(input, name: Optional[str] = None) -> LayerOutput:
+    """Sample an id from a row distribution (reference: sampling_id_layer)."""
+    inp = input
+    name = name or unique_name("sampling_id")
+
+    def compute(ctx, p, ins):
+        v = ins[0]
+        probs = _data_of(v)
+        key = ctx.rng_for(name)
+        ids = jax.random.categorical(key, jnp.log(jnp.clip(probs, 1e-20, 1.0)))
+        return _like(v, ids.astype(jnp.int32))
+
+    return LayerOutput(name=name, layer_type="sampling_id", inputs=[inp],
+                       fn=compute, size=1, is_sequence=inp.is_sequence)
+
+
+# ---------------------------------------------------------------------------
+# recurrent layers
+# ---------------------------------------------------------------------------
+
+
+@_export
+def lstmemory(input, size: int = None, reverse: bool = False, act=None,
+              gate_act=None, state_act=None, name: Optional[str] = None,
+              param_attr=None, bias_attr=True, layer_attr=None) -> LayerOutput:
+    """LSTM over a sequence whose input is ALREADY projected to 4*size
+    (reference contract: lstmemory, gserver/layers/LstmLayer.cpp — the input
+    projection lives in the upstream fc/mixed layer; simple_lstm in networks
+    composes both). One lax.scan; gates fused by XLA (hl_cuda_lstm.cu analog).
+    """
+    inp = input
+    _need_seq(inp, "lstmemory")
+    enforce_that(inp.size % 4 == 0, "lstmemory input size must be 4*size",
+                 context="lstmemory")
+    size = size or inp.size // 4
+    name = name or unique_name("lstmemory")
+    out_act = _resolve_act(act or "tanh")
+    g_act = _resolve_act(gate_act or "sigmoid")
+    s_act = _resolve_act(state_act or "tanh")
+    params = {"w": ParamSpec((size, 4 * size), ParamAttr.to_attr(param_attr))}
+    has_bias = bool(bias_attr)
+    if has_bias:
+        params["b"] = ParamSpec((4 * size,), ParamAttr.to_attr(
+            None if bias_attr is True else bias_attr))
+
+    def compute(ctx, p, ins):
+        sb: SequenceBatch = ins[0]
+        padded, mask = sb.to_padded()
+        hs, _ = prnn.lstm_scan(
+            padded, mask, None, p["w"], p.get("b"), reverse=reverse,
+            gate_act=g_act.fn, cell_act=s_act.fn, out_act=out_act.fn)
+        out = SequenceBatch.from_padded(hs, sb.lengths, capacity=sb.capacity)
+        return _apply_extra(ctx, name, out, layer_attr)
+
+    return LayerOutput(name=name, layer_type="lstmemory", inputs=[inp],
+                       fn=compute, params=params, size=size, is_sequence=True)
+
+
+@_export
+def grumemory(input, size: int = None, reverse: bool = False, act=None,
+              gate_act=None, name: Optional[str] = None, param_attr=None,
+              bias_attr=True, layer_attr=None) -> LayerOutput:
+    """GRU over a sequence with input pre-projected to 3*size (reference:
+    grumemory → GatedRecurrentLayer.cpp / hl_gpu_gru.cuh)."""
+    inp = input
+    _need_seq(inp, "grumemory")
+    enforce_that(inp.size % 3 == 0, "grumemory input size must be 3*size",
+                 context="grumemory")
+    size = size or inp.size // 3
+    name = name or unique_name("grumemory")
+    params = {"w": ParamSpec((size, 3 * size), ParamAttr.to_attr(param_attr))}
+    has_bias = bool(bias_attr)
+    if has_bias:
+        params["b"] = ParamSpec((3 * size,), ParamAttr.to_attr(
+            None if bias_attr is True else bias_attr))
+
+    def compute(ctx, p, ins):
+        sb: SequenceBatch = ins[0]
+        padded, mask = sb.to_padded()
+        hs, _ = prnn.gru_scan(padded, mask, None, p["w"], p.get("b"),
+                              reverse=reverse)
+        out = SequenceBatch.from_padded(hs, sb.lengths, capacity=sb.capacity)
+        return _apply_extra(ctx, name, out, layer_attr)
+
+    return LayerOutput(name=name, layer_type="grumemory", inputs=[inp],
+                       fn=compute, params=params, size=size, is_sequence=True)
+
+
+@_export
+def recurrent(input, size: int = None, act=None, reverse: bool = False,
+              name: Optional[str] = None, param_attr=None,
+              bias_attr=True) -> LayerOutput:
+    """Simple (Elman) recurrent layer: h_t = act(x_t + W h_{t-1})
+    (reference: recurrent_layer/RecurrentLayer.cpp)."""
+    inp = input
+    _need_seq(inp, "recurrent")
+    size = size or inp.size
+    name = name or unique_name("recurrent")
+    activation = _resolve_act(act or "tanh")
+    params = {"w": ParamSpec((size, size), ParamAttr.to_attr(param_attr))}
+    has_bias = bool(bias_attr)
+    if has_bias:
+        params["b"] = ParamSpec((size,), ParamAttr.to_attr(
+            None if bias_attr is True else bias_attr))
+
+    def compute(ctx, p, ins):
+        sb: SequenceBatch = ins[0]
+        padded, mask = sb.to_padded()
+        B, T, D = padded.shape
+
+        def step(h, xm):
+            x, m = xm
+            nh = activation.fn(x + pmath.matmul(h, p["w"]) +
+                               (p["b"] if has_bias else 0.0))
+            m = m[:, None].astype(nh.dtype)
+            nh = m * nh + (1 - m) * h
+            return nh, nh
+
+        xs = (jnp.swapaxes(padded, 0, 1), jnp.swapaxes(mask, 0, 1))
+        _, hs = jax.lax.scan(step, jnp.zeros((B, size), padded.dtype), xs,
+                             reverse=reverse)
+        hs = jnp.swapaxes(hs, 0, 1)
+        return SequenceBatch.from_padded(hs, sb.lengths, capacity=sb.capacity)
+
+    return LayerOutput(name=name, layer_type="recurrent", inputs=[inp],
+                       fn=compute, params=params, size=size, is_sequence=True)
+
+
+# ---------------------------------------------------------------------------
+# special layers: selective_fc, nce, hsigmoid, crf, ctc
+# ---------------------------------------------------------------------------
+
+
+@_export
+def selective_fc(input, size: int, select=None, act=None,
+                 name: Optional[str] = None, param_attr=None,
+                 bias_attr=True, **_kw) -> LayerOutput:
+    """FC where only selected output columns matter (reference:
+    selective_fc_layer/SelectiveFullyConnectedLayer.cpp).
+
+    TPU-native: the full matmul runs on the MXU (dense is faster than gather
+    on TPU); unselected columns are masked to -inf/0 — semantics preserved,
+    the 'skip computation' trick is deliberately NOT ported."""
+    inputs = [input] + ([select] if select is not None else [])
+    name = name or unique_name("selective_fc")
+    activation = _resolve_act(act)
+    params = {"w": ParamSpec((input.size, size), ParamAttr.to_attr(param_attr))}
+    has_bias = bool(bias_attr)
+    if has_bias:
+        params["b"] = ParamSpec((size,), ParamAttr.to_attr(
+            None if bias_attr is True else bias_attr))
+
+    def compute(ctx, p, ins):
+        y = pmath.matmul(_data_of(ins[0]), p["w"])
+        if has_bias:
+            y = y + p["b"]
+        if select is not None:
+            sel = _data_of(ins[1])  # [B, size] 0/1 mask (sparse_binary rows)
+            y = jnp.where(sel > 0, y, 0.0)
+        out = _like(ins[0], y)
+        return _apply_act(activation, out)
+
+    return LayerOutput(name=name, layer_type="selective_fc", inputs=inputs,
+                       fn=compute, params=params, size=size,
+                       is_sequence=input.is_sequence)
+
+
+@_export
+def nce(input, label, num_classes: int, num_neg_samples: int = 10,
+        name: Optional[str] = None, param_attr=None, bias_attr=True,
+        neg_distribution=None) -> LayerOutput:
+    """Noise-contrastive estimation cost (reference: nce_layer/NCELayer.cpp).
+
+    Uniform (or given) noise; logistic loss over 1 positive + k sampled
+    negatives per example. Returns per-example cost."""
+    inputs = [input, label]
+    name = name or unique_name("nce")
+    params = {"w": ParamSpec((num_classes, input.size), ParamAttr.to_attr(param_attr))}
+    has_bias = bool(bias_attr)
+    if has_bias:
+        params["b"] = ParamSpec((num_classes,), ParamAttr.to_attr(
+            None if bias_attr is True else bias_attr))
+
+    def compute(ctx, p, ins):
+        x = _data_of(ins[0])            # [B, D]
+        y = _data_of(ins[1]).reshape(-1).astype(jnp.int32)  # [B]
+        B = x.shape[0]
+        key = ctx.rng_for(name)
+        if neg_distribution is not None:
+            dist = jnp.asarray(neg_distribution)
+            logits_dist = jnp.log(jnp.clip(dist, 1e-20, 1.0))
+            neg = jax.random.categorical(key, logits_dist[None, :],
+                                         shape=(B, num_neg_samples))
+        else:
+            neg = jax.random.randint(key, (B, num_neg_samples), 0, num_classes)
+        ids = jnp.concatenate([y[:, None], neg], axis=1)      # [B, 1+k]
+        w_rows = p["w"][ids]                                   # [B, 1+k, D]
+        logits = jnp.einsum("bd,bkd->bk", x, w_rows)
+        if has_bias:
+            logits = logits + p["b"][ids]
+        labels01 = jnp.concatenate(
+            [jnp.ones((B, 1)), jnp.zeros((B, num_neg_samples))], axis=1)
+        return ploss.sigmoid_cross_entropy_with_logits(logits, labels01)
+
+    return LayerOutput(name=name, layer_type="nce", inputs=inputs, fn=compute,
+                       params=params, size=1, is_cost=True)
+
+
+@_export
+def hsigmoid(input, label, num_classes: int, name: Optional[str] = None,
+             param_attr=None, bias_attr=True) -> LayerOutput:
+    """Hierarchical sigmoid cost over a complete binary tree (reference:
+    hsigmoid_layer/HierarchicalSigmoidLayer.cpp)."""
+    inputs = [input, label]
+    name = name or unique_name("hsigmoid")
+    num_nodes = num_classes - 1
+    import math as _math
+    code_len = max(1, int(_math.ceil(_math.log2(max(2, num_classes)))))
+    params = {"w": ParamSpec((num_nodes, input.size), ParamAttr.to_attr(param_attr))}
+    has_bias = bool(bias_attr)
+    if has_bias:
+        params["b"] = ParamSpec((num_nodes,), ParamAttr.to_attr(
+            None if bias_attr is True else bias_attr))
+
+    def compute(ctx, p, ins):
+        x = _data_of(ins[0])
+        y = _data_of(ins[1]).reshape(-1).astype(jnp.int32)
+        # heap path: leaf id = y + num_nodes + 1 (1-based heap); ancestors =
+        # successive >>1; bit = node & 1 gives left/right label.
+        leaf = y + num_nodes + 1
+        losses = 0.0
+        node = leaf
+        for _ in range(code_len):
+            parent = node >> 1
+            bit = (node & 1).astype(jnp.float32)      # 1 = right child
+            valid = parent >= 1
+            idx = jnp.clip(parent - 1, 0, num_nodes - 1)
+            logit = jnp.einsum("bd,bd->b", x, p["w"][idx])
+            if has_bias:
+                logit = logit + p["b"][idx]
+            # label 1 for left (bit==0) as in reference's sign convention
+            t = 1.0 - bit
+            step_loss = jnp.maximum(logit, 0) - logit * t + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+            losses = losses + jnp.where(valid, step_loss, 0.0)
+            node = parent
+        return losses
+
+    return LayerOutput(name=name, layer_type="hsigmoid", inputs=inputs,
+                       fn=compute, params=params, size=1, is_cost=True)
+
+
+def _crf_forward(emissions, mask, transitions, start, stop, labels):
+    """Linear-chain CRF negative log-likelihood per sequence.
+
+    emissions [B,T,K], mask [B,T] bool, labels [B,T] int.
+    """
+    B, T, K = emissions.shape
+    lab = labels.astype(jnp.int32)
+
+    # score of the gold path
+    first_score = start[lab[:, 0]] + emissions[:, 0, :][jnp.arange(B), lab[:, 0]]
+
+    def score_step(carry, t):
+        s, prev = carry
+        e = emissions[:, t, :][jnp.arange(B), lab[:, t]]
+        tr = transitions[prev, lab[:, t]]
+        m = mask[:, t].astype(e.dtype)
+        s = s + m * (e + tr)
+        prev = jnp.where(mask[:, t], lab[:, t], prev)
+        return (s, prev), None
+
+    (gold, last_lab), _ = jax.lax.scan(score_step, (first_score, lab[:, 0]),
+                                       jnp.arange(1, T))
+    gold = gold + stop[last_lab]
+
+    # log partition via forward algorithm
+    alpha0 = start[None, :] + emissions[:, 0, :]
+
+    def fwd_step(alpha, t):
+        e = emissions[:, t, :]
+        scores = alpha[:, :, None] + transitions[None, :, :] + e[:, None, :]
+        new_alpha = jax.nn.logsumexp(scores, axis=1)
+        m = mask[:, t][:, None]
+        alpha = jnp.where(m, new_alpha, alpha)
+        return alpha, None
+
+    alpha, _ = jax.lax.scan(fwd_step, alpha0, jnp.arange(1, T))
+    logz = jax.nn.logsumexp(alpha + stop[None, :], axis=-1)
+    return logz - gold
+
+
+def _crf_viterbi(emissions, mask, transitions, start, stop):
+    B, T, K = emissions.shape
+    alpha0 = start[None, :] + emissions[:, 0, :]
+
+    def vit_step(alpha, t):
+        e = emissions[:, t, :]
+        scores = alpha[:, :, None] + transitions[None, :, :] + e[:, None, :]
+        best_prev = jnp.argmax(scores, axis=1)
+        new_alpha = jnp.max(scores, axis=1)
+        m = mask[:, t][:, None]
+        alpha_out = jnp.where(m, new_alpha, alpha)
+        bp = jnp.where(m, best_prev, jnp.broadcast_to(jnp.arange(K)[None, :], (B, K)))
+        return alpha_out, bp
+
+    alpha, bps = jax.lax.scan(vit_step, alpha0, jnp.arange(1, T))
+    last = jnp.argmax(alpha + stop[None, :], axis=-1)
+
+    def back_step(nxt, bp):
+        cur = bp[jnp.arange(B), nxt]
+        return cur, nxt
+
+    _, path_rev = jax.lax.scan(back_step, last, bps, reverse=True)
+    path = jnp.concatenate([path_rev, last[None, :]], axis=0)  # [T, B]
+    return jnp.swapaxes(path, 0, 1).astype(jnp.int32)
+
+
+@_export
+def crf(input, label, size: int = None, name: Optional[str] = None,
+        param_attr=None, **_kw) -> LayerOutput:
+    """Linear-chain CRF cost (reference: crf_layer/CRFLayer.cpp,
+    LinearChainCRF.cpp — its transition matrix packs start/stop weights; here
+    they are separate parameters)."""
+    inp, lab = input, label
+    _need_seq(inp, "crf")
+    size = size or inp.size
+    name = name or unique_name("crf")
+    attr = ParamAttr.to_attr(param_attr)
+    params = {
+        "transitions": ParamSpec((size, size), attr),
+        "start": ParamSpec((size,), attr),
+        "stop": ParamSpec((size,), attr),
+    }
+
+    def compute(ctx, p, ins):
+        sb, lb = ins[0], ins[1]
+        emissions, mask = sb.to_padded()
+        labels, _ = lb.to_padded() if isinstance(lb, SequenceBatch) else (lb, None)
+        if labels.ndim == 3:
+            labels = labels[..., 0]
+        return _crf_forward(emissions, mask, p["transitions"], p["start"],
+                            p["stop"], labels)
+
+    return LayerOutput(name=name, layer_type="crf", inputs=[inp, lab],
+                       fn=compute, params=params, size=1, is_cost=True)
+
+
+@_export
+def crf_decoding(input, size: int = None, label=None,
+                 name: Optional[str] = None, param_attr=None, **_kw) -> LayerOutput:
+    """Viterbi decode (reference: crf_decoding_layer). With a label input,
+    outputs per-token error like the reference; else the best path ids."""
+    inp = input
+    _need_seq(inp, "crf_decoding")
+    size = size or inp.size
+    name = name or unique_name("crf_decoding")
+    attr = ParamAttr.to_attr(param_attr)
+    params = {
+        "transitions": ParamSpec((size, size), attr),
+        "start": ParamSpec((size,), attr),
+        "stop": ParamSpec((size,), attr),
+    }
+    inputs = [inp] + ([label] if label is not None else [])
+
+    def compute(ctx, p, ins):
+        sb = ins[0]
+        emissions, mask = sb.to_padded()
+        path = _crf_viterbi(emissions, mask, p["transitions"], p["start"], p["stop"])
+        if label is not None:
+            lb = ins[1]
+            labels, _ = lb.to_padded() if isinstance(lb, SequenceBatch) else (lb, None)
+            if labels.ndim == 3:
+                labels = labels[..., 0]
+            err = (path != labels.astype(path.dtype)) & mask
+            flat = SequenceBatch.from_padded(
+                err[..., None].astype(jnp.float32), sb.lengths, capacity=sb.capacity)
+            return flat
+        flat = SequenceBatch.from_padded(path[..., None], sb.lengths,
+                                         capacity=sb.capacity)
+        return flat
+
+    return LayerOutput(name=name, layer_type="crf_decoding", inputs=inputs,
+                       fn=compute, params=params, size=1, is_sequence=True)
+
+
+@_export
+def ctc(input, label, size: int = None, blank: int = 0, norm_by_times: bool = False,
+        name: Optional[str] = None) -> LayerOutput:
+    """CTC cost (reference: ctc_layer/CTCLayer.cpp & warp_ctc_layer; the TPU
+    path uses a jax-native CTC — optax.ctc_loss — instead of warpctc)."""
+    inp, lab = input, label
+    _need_seq(inp, "ctc")
+    name = name or unique_name("ctc")
+
+    def compute(ctx, p, ins):
+        import optax
+
+        sb, lb = ins[0], ins[1]
+        logits, mask = sb.to_padded()
+        labels, lab_mask = lb.to_padded()
+        if labels.ndim == 3:
+            labels = labels[..., 0]
+        logit_pad = 1.0 - mask.astype(jnp.float32)
+        label_pad = 1.0 - lab_mask.astype(jnp.float32)
+        loss = optax.ctc_loss(logits, logit_pad, labels.astype(jnp.int32),
+                              label_pad, blank_id=blank)
+        if norm_by_times:
+            loss = loss / jnp.maximum(sb.lengths.astype(loss.dtype), 1.0)
+        return loss
+
+    return LayerOutput(name=name, layer_type="ctc", inputs=[inp, lab],
+                       fn=compute, size=1, is_cost=True)
+
+
+@_export
+def warp_ctc(input, label, size: int = None, blank: int = 0,
+             norm_by_times: bool = False, name: Optional[str] = None) -> LayerOutput:
+    """Alias of ctc — warpctc was a CUDA-perf variant; XLA needs no second path."""
+    return ctc(input, label, size=size, blank=blank, norm_by_times=norm_by_times,
+               name=name or unique_name("warp_ctc"))
+
+
+# ---------------------------------------------------------------------------
+# cost layers
+# ---------------------------------------------------------------------------
+
+
+def _cost_node(name, ltype, inputs, fn) -> LayerOutput:
+    return LayerOutput(name=name, layer_type=ltype, inputs=inputs, fn=fn,
+                       size=1, is_cost=True)
+
+
+def _per_example(fn_dense, value, *args):
+    """Run a per-row loss on dense or sequence (per-token) input."""
+    if isinstance(value, SequenceBatch):
+        out = fn_dense(value.data, *[_data_of(a) for a in args])
+        masked = jnp.where(value.valid_mask, out, 0.0)
+        return value.with_data(masked)
+    return fn_dense(value, *[_data_of(a) for a in args])
+
+
+@_export
+def classification_cost(input, label, weight=None, name: Optional[str] = None,
+                        **_kw) -> LayerOutput:
+    """Softmax cross-entropy on logits (reference: classification_cost —
+    the fused softmax+xent path, CostLayer.cpp MultiClassCrossEntropy).
+
+    NOTE: `input` should be pre-softmax logits; if the final layer used a
+    softmax activation the reference computed log on probabilities — we fuse
+    for numerical stability either way."""
+    name = name or unique_name("classification_cost")
+    inputs = [input, label] + ([weight] if weight is not None else [])
+
+    def compute(ctx, p, ins):
+        logits, lab = ins[0], ins[1]
+
+        def f(lg, lb):
+            lb = lb.reshape(lb.shape[0]).astype(jnp.int32)
+            return ploss.softmax_cross_entropy(lg, lb)
+
+        out = _per_example(f, logits, lab)
+        if weight is not None:
+            w = _data_of(ins[2]).reshape(-1)
+            out = _like(out, _data_of(out) * w) if isinstance(out, SequenceBatch) else out * w
+        return out
+
+    return _cost_node(name, "classification_cost", inputs, compute)
+
+
+@_export
+def cross_entropy_cost(input, label, name: Optional[str] = None, **_kw) -> LayerOutput:
+    """Cross entropy on probabilities (reference: cross_entropy)."""
+    name = name or unique_name("cross_entropy")
+
+    def compute(ctx, p, ins):
+        def f(pr, lb):
+            lb = lb.reshape(lb.shape[0]).astype(jnp.int32)
+            picked = jnp.take_along_axis(pr, lb[:, None], axis=-1)[:, 0]
+            return -jnp.log(jnp.clip(picked, 1e-10, 1.0))
+
+        return _per_example(f, ins[0], ins[1])
+
+    return _cost_node(name, "cross_entropy", [input, label], compute)
+
+
+@_export
+def cross_entropy_with_selfnorm_cost(input, label, softmax_selfnorm_alpha: float = 0.1,
+                                     name: Optional[str] = None) -> LayerOutput:
+    name = name or unique_name("cross_entropy_with_selfnorm")
+
+    def compute(ctx, p, ins):
+        def f(lg, lb):
+            lb = lb.reshape(lb.shape[0]).astype(jnp.int32)
+            return ploss.cross_entropy_with_selfnorm(lg, lb, softmax_selfnorm_alpha)
+
+        return _per_example(f, ins[0], ins[1])
+
+    return _cost_node(name, "cross_entropy_with_selfnorm", [input, label], compute)
+
+
+@_export
+def square_error_cost(input, label, name: Optional[str] = None, **_kw) -> LayerOutput:
+    """0.5*||p-t||^2 (reference: square_error_cost / regression_cost)."""
+    name = name or unique_name("square_error")
+
+    def compute(ctx, p, ins):
+        def f(a, b):
+            return ploss.square_error(a, b.reshape(a.shape))
+
+        return _per_example(f, ins[0], ins[1])
+
+    return _cost_node(name, "square_error", [input, label], compute)
+
+
+regression_cost = square_error_cost
+__all__.append("regression_cost")
+
+
+@_export
+def multi_binary_label_cross_entropy_cost(input, label,
+                                          name: Optional[str] = None) -> LayerOutput:
+    name = name or unique_name("multi_binary_label_xent")
+
+    def compute(ctx, p, ins):
+        return _per_example(ploss.multi_binary_label_cross_entropy, ins[0], ins[1])
+
+    return _cost_node(name, "multi_binary_label_xent", [input, label], compute)
+
+
+@_export
+def soft_binary_class_cross_entropy_cost(input, label,
+                                         name: Optional[str] = None) -> LayerOutput:
+    """Soft-label binary xent on probabilities (reference:
+    SoftBinaryClassCrossEntropy)."""
+    name = name or unique_name("soft_binary_xent")
+
+    def compute(ctx, p, ins):
+        def f(pr, lb):
+            pr = jnp.clip(pr, 1e-7, 1 - 1e-7)
+            return -jnp.sum(lb * jnp.log(pr) + (1 - lb) * jnp.log(1 - pr), axis=-1)
+
+        return _per_example(f, ins[0], ins[1])
+
+    return _cost_node(name, "soft_binary_xent", [input, label], compute)
+
+
+@_export
+def rank_cost(left, right, label, weight=None, name: Optional[str] = None) -> LayerOutput:
+    name = name or unique_name("rank_cost")
+    inputs = [left, right, label] + ([weight] if weight is not None else [])
+
+    def compute(ctx, p, ins):
+        w = _data_of(ins[3]) if weight is not None else None
+        return ploss.rank_cost(_data_of(ins[0]), _data_of(ins[1]),
+                               _data_of(ins[2]), w)
+
+    return _cost_node(name, "rank_cost", inputs, compute)
+
+
+@_export
+def lambda_cost(input, score, NDCG_num: int = 5, max_sort_size: int = -1,
+                name: Optional[str] = None) -> LayerOutput:
+    """LambdaRank cost over each query's documents (reference: lambda_cost /
+    LambdaCost.cpp). input: sequence of scores, score: sequence of relevance."""
+    name = name or unique_name("lambda_cost")
+    _need_seq(input, "lambda_cost")
+
+    def compute(ctx, p, ins):
+        sb_pred, sb_rel = ins[0], ins[1]
+        pred, mask = sb_pred.to_padded()
+        rel, _ = sb_rel.to_padded()
+        pred = pred[..., 0] if pred.ndim == 3 else pred
+        rel = rel[..., 0] if rel.ndim == 3 else rel
+        B, T = pred.shape
+        # ideal DCG from top-NDCG_num relevances
+        sorted_rel = -jnp.sort(-jnp.where(mask, rel, -jnp.inf), axis=1)
+        k = jnp.arange(T)
+        disc = 1.0 / jnp.log2(k + 2.0)
+        topk_mask = (k < NDCG_num)[None, :]
+        gains = (jnp.power(2.0, jnp.where(jnp.isfinite(sorted_rel), sorted_rel, 0.0)) - 1.0)
+        idcg = jnp.sum(gains * disc * topk_mask * jnp.isfinite(sorted_rel), axis=1)
+        # pairwise lambda loss approximation: logistic on score diffs weighted
+        # by |delta NDCG| of swapping
+        sdiff = pred[:, :, None] - pred[:, None, :]
+        rdiff = rel[:, :, None] - rel[:, None, :]
+        pair_mask = mask[:, :, None] & mask[:, None, :] & (rdiff > 0)
+        logistic = jnp.log1p(jnp.exp(-sdiff))
+        loss = jnp.sum(jnp.where(pair_mask, logistic, 0.0), axis=(1, 2))
+        denom = jnp.maximum(jnp.sum(pair_mask, axis=(1, 2)), 1)
+        return loss / denom / jnp.maximum(idcg, 1.0)
+
+    return _cost_node(name, "lambda_cost", [input, score], compute)
+
+
+@_export
+def huber_regression_cost(input, label, delta: float = 1.0,
+                          name: Optional[str] = None) -> LayerOutput:
+    name = name or unique_name("huber_regression")
+
+    def compute(ctx, p, ins):
+        def f(a, b):
+            return ploss.huber_regression(a, b.reshape(a.shape), delta)
+
+        return _per_example(f, ins[0], ins[1])
+
+    return _cost_node(name, "huber_regression", [input, label], compute)
+
+
+@_export
+def huber_classification_cost(input, label, name: Optional[str] = None) -> LayerOutput:
+    name = name or unique_name("huber_classification")
+
+    def compute(ctx, p, ins):
+        return _per_example(ploss.huber_classification, ins[0], ins[1])
+
+    return _cost_node(name, "huber_classification", [input, label], compute)
+
+
+@_export
+def smooth_l1_cost(input, label, name: Optional[str] = None) -> LayerOutput:
+    name = name or unique_name("smooth_l1")
+
+    def compute(ctx, p, ins):
+        def f(a, b):
+            return ploss.smooth_l1(a, b.reshape(a.shape))
+
+        return _per_example(f, ins[0], ins[1])
+
+    return _cost_node(name, "smooth_l1", [input, label], compute)
+
+
+@_export
+def sum_cost(input, name: Optional[str] = None) -> LayerOutput:
+    """Sum of the input as a cost (reference: sum_cost/SumCostLayer)."""
+    name = name or unique_name("sum_cost")
+
+    def compute(ctx, p, ins):
+        v = ins[0]
+        d = _data_of(v)
+        out = jnp.sum(d, axis=tuple(range(1, d.ndim)))
+        if isinstance(v, SequenceBatch):
+            out = jnp.where(v.valid_mask, out, 0.0)
+            seg = jnp.where(v.valid_mask, v.segment_ids, v.num_seqs)
+            return jax.ops.segment_sum(out, seg, num_segments=v.num_seqs + 1)[:v.num_seqs]
+        return out
+
+    return _cost_node(name, "sum_cost", [input], compute)
+
+
+@_export
+def eos(input, eos_id: int, name: Optional[str] = None) -> LayerOutput:
+    """Truncate sequences at the end-of-sequence id (reference: eos_layer)."""
+    inp = input
+    _need_seq(inp, "eos")
+    name = name or unique_name("eos")
+
+    def compute(ctx, p, ins):
+        sb: SequenceBatch = ins[0]
+        ids, mask = sb.to_padded()
+        tok = ids[..., 0] if ids.ndim == 3 else ids
+        is_eos = (tok == eos_id) & mask
+        # new length = index of first eos (exclusive), else original length
+        T = tok.shape[1]
+        first_eos = jnp.argmax(is_eos, axis=1)
+        has_eos = jnp.any(is_eos, axis=1)
+        new_len = jnp.where(has_eos, first_eos, sb.lengths).astype(jnp.int32)
+        return pseq.seq_slice(sb, jnp.zeros_like(new_len), new_len)
+
+    return LayerOutput(name=name, layer_type="eos", inputs=[inp], fn=compute,
+                       size=inp.size, is_sequence=True)
+
+
+@_export
+def dotmul_bcast(a, b, name: Optional[str] = None) -> LayerOutput:
+    """Tokenwise multiply with broadcasting over the feature dim — used to
+    scale sequence tokens by per-token scalar weights (attention)."""
+    name = name or unique_name("dotmul_bcast")
+
+    def compute(ctx, p, ins):
+        va, vb = _data_of(ins[0]), _data_of(ins[1])
+        if vb.ndim < va.ndim:
+            vb = vb[..., None]
+        return _like(ins[0], va * vb)
+
+    return LayerOutput(name=name, layer_type="dotmul_bcast", inputs=[a, b],
+                       fn=compute, size=a.size, is_sequence=a.is_sequence)

@@ -1,0 +1,22 @@
+"""minibatch.batch — group a sample reader into batches.
+
+Reference: python/paddle/v2/minibatch.py (batch(reader, batch_size)).
+``drop_last`` defaults True here: TPU compilation wants static batch shapes,
+and a ragged final batch would trigger a recompile (documented divergence).
+"""
+
+from __future__ import annotations
+
+
+def batch(reader, batch_size: int, drop_last: bool = True):
+    def batch_reader():
+        b = []
+        for item in reader():
+            b.append(item)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader

@@ -44,11 +44,14 @@ def conv2d(x: jax.Array, w: jax.Array, *, stride: IntOr2 = 1,
         ph, pw = _pair(padding)
         pad = ((ph, ph), (pw, pw))
     ct = _conv_dtype(x)
-    return lax.conv_general_dilated(
+    # NOTE: output dtype == input dtype keeps the VJP's transposed conv
+    # dtype-consistent (bf16 cotangents); the MXU still accumulates bf16
+    # products in f32 internally. Upcast after.
+    y = lax.conv_general_dilated(
         x.astype(ct), w.astype(ct), window_strides=s, padding=pad,
         rhs_dilation=d, feature_group_count=groups,
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        preferred_element_type=jnp.dtype(out_dtype))
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return y.astype(jnp.dtype(out_dtype))
 
 
 def conv2d_transpose(x: jax.Array, w: jax.Array, *, stride: IntOr2 = 1,
@@ -61,12 +64,12 @@ def conv2d_transpose(x: jax.Array, w: jax.Array, *, stride: IntOr2 = 1,
     # w layout: [kh, kw, Cin, Cout] with Cin = x's channels. lhs_dilation
     # implements the fractional stride; padding converts to the equivalent
     # forward-conv padding: k - 1 - p on each side.
-    return lax.conv_general_dilated(
+    y = lax.conv_general_dilated(
         x.astype(ct), jnp.flip(w, (0, 1)).astype(ct),
         window_strides=(1, 1),
         padding=((kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw)),
-        lhs_dilation=s, dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        preferred_element_type=jnp.dtype(out_dtype))
+        lhs_dilation=s, dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return y.astype(jnp.dtype(out_dtype))
 
 
 def depthwise_conv2d(x: jax.Array, w: jax.Array, *, stride: IntOr2 = 1,
@@ -90,10 +93,10 @@ def conv3d(x: jax.Array, w: jax.Array, *, stride=1, padding=0) -> jax.Array:
         p = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
         pad = tuple((pi, pi) for pi in p)
     ct = _conv_dtype(x)
-    return lax.conv_general_dilated(
+    y = lax.conv_general_dilated(
         x.astype(ct), w.astype(ct), window_strides=s, padding=pad,
-        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
-        preferred_element_type=jnp.float32)
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+    return y.astype(jnp.float32)
 
 
 def row_conv(x: jax.Array, w: jax.Array) -> jax.Array:

@@ -65,19 +65,21 @@ def gru_cell(x_proj: jax.Array, h: jax.Array, w_h: jax.Array,
     return (1.0 - z) * h + z * c
 
 
-def lstm_scan(x: jax.Array, mask: jax.Array, w_x: jax.Array, w_h: jax.Array,
-              bias: Optional[jax.Array], *, reverse: bool = False,
-              init: Optional[LSTMState] = None,
+def lstm_scan(x: jax.Array, mask: jax.Array, w_x: Optional[jax.Array],
+              w_h: jax.Array, bias: Optional[jax.Array], *,
+              reverse: bool = False, init: Optional[LSTMState] = None,
               gate_act=jax.nn.sigmoid, cell_act=jnp.tanh, out_act=jnp.tanh
               ) -> Tuple[jax.Array, LSTMState]:
     """Full-sequence LSTM: x [B,T,D], mask [B,T] -> (h_all [B,T,H], final).
 
     The input projection for ALL timesteps is one [B*T, D]x[D, 4H] gemm — the
     big-MXU-matmul formulation; the scan carries only the [H,4H] recurrence.
+    ``w_x=None`` means x is already projected to [B,T,4H] (the reference's
+    ``lstmemory`` contract: projection happens in the upstream mixed/fc layer).
     """
     B, T, _ = x.shape
     H = w_h.shape[0]
-    xp = matmul(x, w_x)  # [B, T, 4H]
+    xp = matmul(x, w_x) if w_x is not None else x  # [B, T, 4H]
     if init is None:
         init = LSTMState(jnp.zeros((B, H), xp.dtype), jnp.zeros((B, H), xp.dtype))
 
@@ -94,13 +96,15 @@ def lstm_scan(x: jax.Array, mask: jax.Array, w_x: jax.Array, w_h: jax.Array,
     return jnp.swapaxes(hs, 0, 1), final
 
 
-def gru_scan(x: jax.Array, mask: jax.Array, w_x: jax.Array, w_h: jax.Array,
-             bias: Optional[jax.Array], *, reverse: bool = False,
+def gru_scan(x: jax.Array, mask: jax.Array, w_x: Optional[jax.Array],
+             w_h: jax.Array, bias: Optional[jax.Array], *,
+             reverse: bool = False,
              init: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
-    """Full-sequence GRU: x [B,T,D] -> (h_all [B,T,H], final_h)."""
+    """Full-sequence GRU: x [B,T,D] -> (h_all [B,T,H], final_h).
+    ``w_x=None`` means x is already [B,T,3H] (grumemory contract)."""
     B, T, _ = x.shape
     H = w_h.shape[0]
-    xp = matmul(x, w_x)  # [B, T, 3H]
+    xp = matmul(x, w_x) if w_x is not None else x  # [B, T, 3H]
     h0 = init if init is not None else jnp.zeros((B, H), xp.dtype)
 
     def step(h, inp):

@@ -129,7 +129,9 @@ def seq_reshape(sb: SequenceBatch, new_dim: int) -> SequenceBatch:
     new_lengths = (sb.lengths * d) // new_dim
     from paddle_tpu.sequence import lengths_to_segment_ids
     seg = lengths_to_segment_ids(new_lengths, cap)
-    return SequenceBatch(data=data, segment_ids=seg, lengths=new_lengths)
+    new_max = None if sb.max_len is None else max(1, sb.max_len * d // new_dim)
+    return SequenceBatch(data=data, segment_ids=seg, lengths=new_lengths,
+                         max_len=new_max)
 
 def seq_slice(sb: SequenceBatch, starts: jax.Array, ends: jax.Array) -> SequenceBatch:
     """Keep tokens with start<=pos<end per sequence (reference: SeqSliceLayer).
@@ -143,7 +145,8 @@ def seq_slice(sb: SequenceBatch, starts: jax.Array, ends: jax.Array) -> Sequence
     seg_ids = jnp.where(keep, sb.segment_ids, sb.num_seqs)
     mask = keep.reshape((-1,) + (1,) * (sb.data.ndim - 1))
     return SequenceBatch(data=jnp.where(mask, sb.data, 0), segment_ids=seg_ids,
-                         lengths=new_lengths.astype(jnp.int32))
+                         lengths=new_lengths.astype(jnp.int32),
+                         max_len=sb.max_len)
 
 
 def kmax_seq_score(sb: SequenceBatch, k: int) -> jax.Array:
@@ -179,4 +182,4 @@ def sub_nested_seq(sb: SequenceBatch, selected: jax.Array) -> SequenceBatch:
                                       num_segments=n)[: sb.num_seqs]
     mask = keep.reshape((-1,) + (1,) * (sb.data.ndim - 1))
     return SequenceBatch(data=jnp.where(mask, sb.data, 0), segment_ids=seg_ids,
-                         lengths=new_lengths)
+                         lengths=new_lengths, max_len=sb.max_len)

@@ -1,0 +1,12 @@
+"""Distributed/parallel machinery over the device mesh.
+
+Reference inventory replaced here (SURVEY.md §2.3): MultiGradientMachine ring
+DP → sharded-batch pjit + psum; ParameterServer2 block sharding → ZeRO-style
+optimizer-state sharding; sparse remote tables → row-sharded embeddings with
+all_to_all; LightNetwork/RDMA → XLA collectives over ICI/DCN.
+"""
+
+from paddle_tpu.parallel.mesh import (make_mesh, data_parallel_mesh,
+                                      mesh_axis_names)
+from paddle_tpu.parallel.api import (shard_batch, replicate, param_sharding,
+                                     DataParallel)

@@ -1,0 +1,97 @@
+"""Sharding helpers and the DataParallel wrapper.
+
+Reference: MultiGradientMachine.h:41-165 (single-node DP with ring grad
+gather / value scatter among trainer threads) and the pserver sync-SGD path
+(ParameterServer2.cpp:362 addGradient). Both collapse here into: shard the
+batch over the 'data' mesh axis, keep params replicated (or sharded for
+ZeRO), and let XLA insert psum on the gradients.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.platform.enforce import enforce_that
+from paddle_tpu.sequence import SequenceBatch
+
+
+def shard_batch(mesh, value, axis: str = "data"):
+    """Place a host batch sharded along its leading dim over ``axis``.
+
+    SequenceBatch: the flat token buffer is sharded over capacity and the
+    per-sequence vectors over num_seqs — both leading dims are sized per
+    DataFeeder bucketing to be divisible by the axis size.
+    """
+    if isinstance(value, SequenceBatch):
+        return SequenceBatch(
+            data=shard_batch(mesh, value.data, axis),
+            segment_ids=shard_batch(mesh, value.segment_ids, axis),
+            lengths=shard_batch(mesh, value.lengths, axis),
+            sub_segment_ids=None if value.sub_segment_ids is None
+            else shard_batch(mesh, value.sub_segment_ids, axis),
+        )
+    spec = P(axis, *([None] * (np.ndim(value) - 1)))
+    return jax.device_put(value, NamedSharding(mesh, spec))
+
+
+def replicate(mesh, tree):
+    return jax.device_put(tree, NamedSharding(mesh, P()))
+
+
+def param_sharding(mesh, params: Dict[str, jax.Array], specs=None,
+                   zero_axis: Optional[str] = None):
+    """Build NamedShardings for a param dict.
+
+    Default: replicated. ``zero_axis``: shard the largest dim of every tensor
+    over that axis when divisible (ZeRO-3-style weight sharding — the
+    pserver block-partitioning analog, ParameterServer2.h:94-120).
+    Per-param ParamAttr.sharding (axis names per dim) takes precedence.
+    """
+    out = {}
+    axis_size = dict(zip(mesh.axis_names, mesh.devices.shape))
+    for name, v in params.items():
+        spec = None
+        attr = None
+        if specs is not None and name in specs:
+            attr = specs[name].attr
+        if attr is not None and attr.sharding is not None:
+            spec = P(*attr.sharding)
+        elif zero_axis is not None:
+            n = axis_size[zero_axis]
+            dims = [None] * v.ndim
+            for d in np.argsort(v.shape)[::-1]:
+                if v.shape[d] % n == 0 and v.shape[d] >= n:
+                    dims[int(d)] = zero_axis
+                    break
+            spec = P(*dims)
+        else:
+            spec = P()
+        out[name] = NamedSharding(mesh, spec)
+    return out
+
+
+class DataParallel:
+    """Convenience: place feeds/params for data-parallel training.
+
+    Used by trainer.SGD when a mesh is passed; exposed for custom loops.
+    """
+
+    def __init__(self, mesh, axis: str = "data"):
+        self.mesh = mesh
+        self.axis = axis
+        enforce_that(axis in mesh.axis_names, f"no axis {axis!r} in mesh",
+                     context="DataParallel")
+
+    def shard_feeds(self, feeds: Dict[str, object]) -> Dict[str, object]:
+        return {k: shard_batch(self.mesh, v, self.axis) for k, v in feeds.items()}
+
+    def replicate_params(self, params):
+        return replicate(self.mesh, params)
+
+    @property
+    def num_devices(self) -> int:
+        return int(np.prod(self.mesh.devices.shape))

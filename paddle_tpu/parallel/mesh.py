@@ -1,0 +1,40 @@
+"""Mesh construction helpers.
+
+The mesh is the TPU-native replacement for the reference's process topology
+(trainer_count threads × num_gradient_servers pservers, Flags.cpp): axes are
+logical ('data', 'model', 'seq', 'expert'), devices come from
+platform.device discovery, ICI within a slice / DCN across slices.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddle_tpu.platform import device as pdevice
+from paddle_tpu.platform.enforce import enforce_that
+
+
+def make_mesh(shape: Sequence[int], axis_names: Sequence[str],
+              devices=None):
+    import jax
+
+    devs = list(devices) if devices is not None else pdevice.devices()
+    n = int(np.prod(shape))
+    enforce_that(n <= len(devs),
+                 f"mesh {tuple(shape)} needs {n} devices, have {len(devs)}",
+                 context="mesh")
+    arr = np.asarray(devs[:n]).reshape(tuple(shape))
+    return jax.sharding.Mesh(arr, tuple(axis_names))
+
+
+def data_parallel_mesh(num: Optional[int] = None):
+    """1-D 'data' mesh over all (or the first ``num``) devices."""
+    devs = pdevice.devices()
+    n = num or len(devs)
+    return make_mesh((n,), ("data",), devs)
+
+
+def mesh_axis_names(mesh) -> Tuple[str, ...]:
+    return tuple(mesh.axis_names)

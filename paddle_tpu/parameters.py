@@ -1,0 +1,145 @@
+"""Parameters: the trainable-state container with tar save/load.
+
+Reference: python/paddle/v2/parameters.py (Parameters dict keyed by name,
+``to_tar``/``from_tar`` checkpoint format) and paddle/parameter/Parameter.h
+(VALUE buffer + config). Optimizer slot buffers (MOMENTUM etc.) live in the
+optimizer state pytree, not here — the functional split TPU training wants.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import tarfile
+from typing import Any, Dict, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.initializer import to_initializer, default_bias_init
+from paddle_tpu.platform.enforce import EnforceError, enforce_that
+from paddle_tpu.topology import ParamSpec, Topology
+
+
+class Parameters:
+    """name -> jax.Array with attached specs. Behaves like a mapping."""
+
+    def __init__(self):
+        self._values: Dict[str, jax.Array] = {}
+        self._specs: Dict[str, ParamSpec] = {}
+
+    # ---- construction ----------------------------------------------------
+
+    @staticmethod
+    def from_topology(topology: Topology, *, seed: int = 0,
+                      dtype=jnp.float32) -> "Parameters":
+        specs = topology.param_specs()
+        params = Parameters()
+        key = jax.random.PRNGKey(seed)
+        for i, (name, spec) in enumerate(sorted(specs.items())):
+            sub = jax.random.fold_in(key, i)
+            is_bias = name.endswith(".b") or name.endswith("bias")
+            if spec.attr.initializer is not None:
+                init = to_initializer(spec.attr.initializer)
+            elif is_bias:
+                init = default_bias_init()
+            else:
+                init = to_initializer(None)
+            pdtype = spec.attr.dtype or spec.dtype or dtype
+            params._values[name] = init(sub, tuple(spec.shape), pdtype)
+            params._specs[name] = spec
+        return params
+
+    # ---- mapping surface -------------------------------------------------
+
+    def __getitem__(self, name: str) -> jax.Array:
+        if name not in self._values:
+            raise EnforceError(f"no parameter named {name!r}", context="parameters")
+        return self._values[name]
+
+    def __setitem__(self, name: str, value) -> None:
+        value = jnp.asarray(value)
+        if name in self._specs:
+            enforce_that(tuple(value.shape) == tuple(self._specs[name].shape),
+                         f"shape mismatch for {name!r}: {value.shape} vs "
+                         f"{self._specs[name].shape}", context="parameters")
+        self._values[name] = value
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._values
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._values)
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+    def keys(self):
+        return self._values.keys()
+
+    def names(self):
+        return list(self._values.keys())
+
+    def items(self):
+        return self._values.items()
+
+    def get_spec(self, name: str) -> Optional[ParamSpec]:
+        return self._specs.get(name)
+
+    # ---- pytree bridge ---------------------------------------------------
+
+    def as_dict(self) -> Dict[str, jax.Array]:
+        """The pytree handed to jit/grad. Shares buffers, cheap."""
+        return dict(self._values)
+
+    def update_from(self, tree: Dict[str, jax.Array]) -> None:
+        self._values.update(tree)
+
+    # ---- checkpoint (to_tar/from_tar analog) -----------------------------
+
+    def to_tar(self, f) -> None:
+        """Write a tar with one .npy member per parameter + a manifest.
+
+        Format intentionally simple and inspectable (the reference wrote
+        raw parameter serialization + proto per member, v2/parameters.py).
+        """
+        with tarfile.open(fileobj=f, mode="w") as tar:
+            manifest = {}
+            for name, value in self._values.items():
+                buf = io.BytesIO()
+                np.save(buf, np.asarray(value), allow_pickle=False)
+                data = buf.getvalue()
+                member = name.replace("/", "__") + ".npy"
+                info = tarfile.TarInfo(name=member)
+                info.size = len(data)
+                tar.addfile(info, io.BytesIO(data))
+                manifest[name] = {"member": member,
+                                  "shape": list(np.shape(value)),
+                                  "dtype": str(np.asarray(value).dtype)}
+            mdata = json.dumps(manifest).encode()
+            info = tarfile.TarInfo(name="manifest.json")
+            info.size = len(mdata)
+            tar.addfile(info, io.BytesIO(mdata))
+
+    @staticmethod
+    def from_tar(f) -> "Parameters":
+        params = Parameters()
+        with tarfile.open(fileobj=f, mode="r") as tar:
+            manifest = json.loads(tar.extractfile("manifest.json").read())
+            for name, meta in manifest.items():
+                arr = np.load(io.BytesIO(tar.extractfile(meta["member"]).read()),
+                              allow_pickle=False)
+                params._values[name] = jnp.asarray(arr)
+        return params
+
+    def init_from_tar(self, f) -> None:
+        """Load values by name into existing parameters (shape-checked)."""
+        loaded = Parameters.from_tar(f)
+        for name, value in loaded.items():
+            if name in self._values:
+                self[name] = value
+
+    def __repr__(self):
+        total = sum(int(np.prod(v.shape)) for v in self._values.values())
+        return f"Parameters({len(self._values)} tensors, {total:,} elements)"

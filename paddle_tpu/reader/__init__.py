@@ -1,0 +1,9 @@
+"""Reader protocol: a reader is a zero-arg callable returning an iterable of
+samples (reference: python/paddle/v2/reader — readers as generators)."""
+
+from paddle_tpu.reader.decorator import (buffered, chain, compose, firstn,
+                                         map_readers, shuffle, xmap_readers)
+from paddle_tpu.reader import creator
+
+__all__ = ["buffered", "chain", "compose", "firstn", "map_readers", "shuffle",
+           "xmap_readers", "creator"]

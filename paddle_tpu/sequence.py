@@ -38,6 +38,10 @@ class SequenceBatch:
     segment_ids: jax.Array
     lengths: jax.Array
     sub_segment_ids: Optional[jax.Array] = None
+    # STATIC metadata (pytree aux, not traced): an upper bound on the longest
+    # sequence, set host-side by the DataFeeder (bucketed). Keeps lax.scan
+    # time loops at ~max_len steps instead of `capacity` steps.
+    max_len: Optional[int] = None
 
     @property
     def num_seqs(self) -> int:
@@ -52,17 +56,19 @@ class SequenceBatch:
         return self.segment_ids < self.num_seqs
 
     def with_data(self, data: jax.Array) -> "SequenceBatch":
-        return SequenceBatch(data, self.segment_ids, self.lengths, self.sub_segment_ids)
+        return SequenceBatch(data, self.segment_ids, self.lengths,
+                             self.sub_segment_ids, self.max_len)
 
     # ---- conversions -----------------------------------------------------
 
     def to_padded(self, max_len: Optional[int] = None) -> Tuple[jax.Array, jax.Array]:
         """Return ([B, T, ...feature], mask [B, T]) dense view.
 
-        T is static: max_len or capacity. Scatter via position-in-sequence ids.
+        T is static: max_len arg, else self.max_len, else capacity.
+        Scatter via position-in-sequence ids.
         """
         B = self.num_seqs
-        T = int(max_len) if max_len is not None else self.capacity
+        T = int(max_len if max_len is not None else (self.max_len or self.capacity))
         pos = position_in_sequence(self.segment_ids)
         valid = self.valid_mask & (pos < T)
         seg = jnp.where(valid, self.segment_ids, B)
@@ -91,7 +97,8 @@ class SequenceBatch:
         seg = jnp.where(valid_full[take], seg_full[take], B).astype(jnp.int32)
         data = jnp.where(
             (seg < B).reshape((-1,) + (1,) * (flat.ndim - 1)), flat, 0)
-        return SequenceBatch(data=data, segment_ids=seg, lengths=lengths)
+        return SequenceBatch(data=data, segment_ids=seg, lengths=lengths,
+                             max_len=T)
 
     @staticmethod
     def from_list(seqs, dtype=jnp.float32, capacity: Optional[int] = None) -> "SequenceBatch":
@@ -110,7 +117,24 @@ class SequenceBatch:
             seg[off:off + n] = i
             off += n
         return SequenceBatch(data=jnp.asarray(data), segment_ids=jnp.asarray(seg),
-                             lengths=jnp.asarray(lengths))
+                             lengths=jnp.asarray(lengths),
+                             max_len=int(lengths.max()) if len(arrs) else 0)
+
+
+def _sb_flatten(sb: SequenceBatch):
+    # max_len is STATIC aux data: it parameterizes compiled shapes (scan
+    # lengths), so two batches with different max_len hash to different jit
+    # cache entries — exactly the bucketed-recompile behavior we want.
+    return (sb.data, sb.segment_ids, sb.lengths, sb.sub_segment_ids), sb.max_len
+
+
+def _sb_unflatten(max_len, children) -> SequenceBatch:
+    return SequenceBatch(*children, max_len=max_len)
+
+
+# Registered as a pytree so SequenceBatch feeds flow through jit/grad/scan
+# boundaries like any array (the LoDTensor-crosses-the-C++-boundary analog).
+jax.tree_util.register_pytree_node(SequenceBatch, _sb_flatten, _sb_unflatten)
 
 
 def position_in_sequence(segment_ids: jax.Array) -> jax.Array:

@@ -1,0 +1,225 @@
+"""Topology: the layer graph and its compilation to a pure jax function.
+
+Reference analog: the ModelConfig protobuf built by config_parser.py plus the
+C++ NeuralNetwork layer-graph executor (gserver/gradientmachines/
+NeuralNetwork.cpp:245-295) and paddle.v2.topology.Topology
+(python/paddle/v2/topology.py:33).
+
+TPU-native design: layer functions build a DAG of ``LayerOutput`` nodes; a
+``Topology`` freezes the transitive closure of requested outputs into a
+topologically-ordered node list and exposes ``forward(params, state, feeds)``
+— a *pure function* executed under ``jax.jit``. There is no interpreter at
+runtime: the whole graph is traced once and compiled by XLA, so "layers" cost
+nothing at step time (the reference pays a C++ virtual call + kernel launch
+per layer; here XLA fuses across layer boundaries).
+
+Backward pass: none is built by hand — ``jax.grad`` of ``forward`` replaces
+the reference's per-layer ``backward()`` methods and Gen-2 AppendBackward
+(framework/backward.cc:434).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.attr import ParamAttr
+from paddle_tpu.platform.enforce import EnforceError, enforce_that
+from paddle_tpu.sequence import SequenceBatch
+
+# ---------------------------------------------------------------------------
+# Graph nodes
+# ---------------------------------------------------------------------------
+
+_name_counters: Dict[str, int] = {}
+
+
+def unique_name(prefix: str) -> str:
+    idx = _name_counters.get(prefix, 0)
+    _name_counters[prefix] = idx + 1
+    return f"{prefix}_{idx}"
+
+
+def reset_name_scope() -> None:
+    _name_counters.clear()
+
+
+@dataclass
+class ParamSpec:
+    """Declared parameter of a layer node."""
+
+    shape: Tuple[int, ...]
+    attr: ParamAttr = field(default_factory=ParamAttr)
+    dtype: Any = jnp.float32
+
+
+@dataclass
+class StateSpec:
+    """Non-trainable state slot (e.g. batch-norm moving stats)."""
+
+    shape: Tuple[int, ...]
+    init_value: float = 0.0
+    dtype: Any = jnp.float32
+
+
+class Context:
+    """Per-forward execution context handed to each node's compute fn."""
+
+    def __init__(self, train: bool, rng: Optional[jax.Array], state: Dict[str, Dict[str, jax.Array]]):
+        self.train = train
+        self._rng = rng
+        self.state_in = state
+        self.state_out: Dict[str, Dict[str, jax.Array]] = {}
+        self._current: Optional[str] = None
+
+    def rng_for(self, node_name: str) -> jax.Array:
+        if self._rng is None:
+            return jax.random.PRNGKey(0)
+        # stable per-node stream derived from the step key
+        h = int.from_bytes(hashlib.md5(node_name.encode()).digest()[:4], "little")
+        return jax.random.fold_in(self._rng, h)
+
+    def get_state(self, node_name: str, key: str) -> jax.Array:
+        return self.state_in[node_name][key]
+
+    def set_state(self, node_name: str, key: str, value: jax.Array) -> None:
+        self.state_out.setdefault(node_name, {})[key] = value
+
+
+@dataclass
+class LayerOutput:
+    """A node in the layer graph; also the user-facing handle (v2 LayerOutput
+    analog, python/paddle/v2/layer.py)."""
+
+    name: str
+    layer_type: str
+    inputs: List["LayerOutput"]
+    # fn(ctx, params: dict, inputs: list of values) -> value
+    fn: Callable[[Context, Dict[str, jax.Array], List[Any]], Any]
+    params: Dict[str, ParamSpec] = field(default_factory=dict)
+    state: Dict[str, StateSpec] = field(default_factory=dict)
+    size: Optional[int] = None          # feature dimension, v2-API compatible
+    is_sequence: bool = False           # value is a SequenceBatch
+    is_cost: bool = False               # per-example loss output
+
+    def __post_init__(self):
+        enforce_that(self.name is not None, "layer needs a name")
+
+    # Graph sugar: l1 + l2 = addto
+    def __add__(self, other: "LayerOutput") -> "LayerOutput":
+        from paddle_tpu import layer as L
+
+        return L.addto(input=[self, other])
+
+    def __repr__(self):
+        return f"LayerOutput({self.name!r}, type={self.layer_type!r}, size={self.size})"
+
+
+def topological_order(outputs: Sequence[LayerOutput]) -> List[LayerOutput]:
+    seen: Dict[str, LayerOutput] = {}
+    order: List[LayerOutput] = []
+
+    def visit(node: LayerOutput, stack: Tuple[int, ...]):
+        if node.name in seen:
+            enforce_that(seen[node.name] is node,
+                         f"two different layers named {node.name!r}", context="topology")
+            return
+        if id(node) in stack:
+            raise EnforceError(f"cycle through layer {node.name!r}", context="topology")
+        for inp in node.inputs:
+            visit(inp, stack + (id(node),))
+        # a transitively-visited input may have claimed this name already
+        enforce_that(seen.get(node.name, node) is node,
+                     f"two different layers named {node.name!r}", context="topology")
+        seen[node.name] = node
+        order.append(node)
+
+    for out in outputs:
+        visit(out, ())
+    return order
+
+
+# ---------------------------------------------------------------------------
+# Topology
+# ---------------------------------------------------------------------------
+
+
+class Topology:
+    """Frozen graph over the transitive closure of ``outputs``.
+
+    ``forward`` is pure: (params, state, feeds, train, rng) -> (outputs, new_state).
+    """
+
+    def __init__(self, outputs: Union[LayerOutput, Sequence[LayerOutput]]):
+        if isinstance(outputs, LayerOutput):
+            outputs = [outputs]
+        self.outputs: List[LayerOutput] = list(outputs)
+        self.nodes: List[LayerOutput] = topological_order(self.outputs)
+        self.by_name: Dict[str, LayerOutput] = {n.name: n for n in self.nodes}
+        self.data_nodes: List[LayerOutput] = [n for n in self.nodes if n.layer_type == "data"]
+
+    # ---- specs -----------------------------------------------------------
+
+    def param_specs(self) -> Dict[str, ParamSpec]:
+        """Flat parameter table: '<layer>.<param>' -> spec. Explicit
+        ParamAttr.name aliases share storage (the reference's parameter
+        sharing via param names)."""
+        specs: Dict[str, ParamSpec] = {}
+        for node in self.nodes:
+            for pname, spec in node.params.items():
+                full = spec.attr.name or f"{node.name}.{pname}"
+                if full in specs:
+                    enforce_that(tuple(specs[full].shape) == tuple(spec.shape),
+                                 f"shared parameter {full!r} shape mismatch "
+                                 f"{specs[full].shape} vs {spec.shape}", context="topology")
+                else:
+                    specs[full] = spec
+        return specs
+
+    def param_key(self, node: LayerOutput, pname: str) -> str:
+        spec = node.params[pname]
+        return spec.attr.name or f"{node.name}.{pname}"
+
+    def state_specs(self) -> Dict[str, Dict[str, StateSpec]]:
+        return {n.name: dict(n.state) for n in self.nodes if n.state}
+
+    def init_state(self) -> Dict[str, Dict[str, jax.Array]]:
+        out: Dict[str, Dict[str, jax.Array]] = {}
+        for lname, slots in self.state_specs().items():
+            out[lname] = {
+                k: jnp.full(s.shape, s.init_value, dtype=s.dtype) for k, s in slots.items()
+            }
+        return out
+
+    # ---- execution -------------------------------------------------------
+
+    def forward(self, params: Dict[str, jax.Array],
+                state: Dict[str, Dict[str, jax.Array]],
+                feeds: Dict[str, Any], *, train: bool = False,
+                rng: Optional[jax.Array] = None,
+                outputs: Optional[Sequence[LayerOutput]] = None
+                ) -> Tuple[List[Any], Dict[str, Dict[str, jax.Array]]]:
+        wanted = list(outputs) if outputs is not None else self.outputs
+        ctx = Context(train=train, rng=rng, state=state)
+        values: Dict[str, Any] = {}
+        for node in topological_order(wanted):
+            if node.layer_type == "data":
+                if node.name not in feeds:
+                    raise EnforceError(f"missing feed for data layer {node.name!r}",
+                                       context="forward")
+                values[node.name] = feeds[node.name]
+                continue
+            node_params = {p: params[self.param_key(node, p)] for p in node.params}
+            ins = [values[i.name] for i in node.inputs]
+            ctx._current = node.name
+            values[node.name] = node.fn(ctx, node_params, ins)
+        new_state = dict(state)
+        new_state.update(ctx.state_out)
+        return [values[w.name] for w in wanted], new_state
+
+    def __repr__(self):
+        return f"Topology({len(self.nodes)} nodes, outputs={[o.name for o in self.outputs]})"

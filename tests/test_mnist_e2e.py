@@ -1,0 +1,105 @@
+"""End-to-end slice test — the reference's test_TrainerOnePass analog:
+train a small model for one pass on (synthetic) MNIST and assert the cost
+drops and accuracy beats chance; checkpoint round-trip; inference."""
+
+import io
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import layer, evaluator, optimizer, trainer, event
+
+
+def _mlp_topology():
+    images = layer.data(name="pixel", type=paddle.data_type.dense_vector(784))
+    label = layer.data(name="label", type=paddle.data_type.integer_value(10))
+    hidden = layer.fc(input=images, size=64, act="relu", name="hidden")
+    logits = layer.fc(input=hidden, size=10, name="logits")
+    cost = layer.classification_cost(input=logits, label=label, name="cost")
+    err = evaluator.classification_error(input=logits, label=label, name="err")
+    return images, label, logits, cost, err
+
+
+def test_mnist_one_pass_converges():
+    paddle.topology.reset_name_scope()
+    _, _, logits, cost, err = _mlp_topology()
+    params = paddle.Parameters.from_topology(paddle.topology.Topology([cost, err]),
+                                             seed=7)
+    opt = optimizer.Momentum(momentum=0.9, learning_rate=0.05)
+    sgd = trainer.SGD(cost=cost, parameters=params, update_equation=opt,
+                      extra_layers=[err])
+
+    train_reader = paddle.batch(
+        paddle.reader.shuffle(paddle.dataset.mnist.train(), buf_size=2048),
+        batch_size=64)
+
+    seen = {"costs": [], "errs": []}
+
+    def handler(ev):
+        if isinstance(ev, event.EndIteration):
+            seen["costs"].append(ev.cost)
+            seen["errs"].append(ev.metrics["err"])
+
+    sgd.train(train_reader, num_passes=1, event_handler=handler)
+
+    first = np.mean(seen["costs"][:10])
+    last = np.mean(seen["costs"][-10:])
+    assert last < first * 0.7, f"cost did not drop: {first} -> {last}"
+    assert np.mean(seen["errs"][-10:]) < 0.5, "error rate stuck at chance"
+
+    # test() path
+    test_reader = paddle.batch(paddle.dataset.mnist.test(), batch_size=64)
+    result = sgd.test(test_reader)
+    assert result.metrics["err"] < 0.5
+
+    # checkpoint round-trip
+    buf = io.BytesIO()
+    params.to_tar(buf)
+    buf.seek(0)
+    loaded = paddle.Parameters.from_tar(buf)
+    for name in params.names():
+        np.testing.assert_allclose(np.asarray(params[name]),
+                                   np.asarray(loaded[name]))
+
+    # inference
+    probs = paddle.infer(output_layer=logits, parameters=params,
+                         input=[(np.zeros(784, np.float32),)])
+    assert probs.shape == (1, 10)
+
+
+def test_lenet_conv_one_batch():
+    """Conv path compiles and trains one batch (LeNet-ish)."""
+    paddle.topology.reset_name_scope()
+    images = layer.data(name="pixel", type=paddle.data_type.dense_vector(784),
+                        height=28, width=28)
+    label = layer.data(name="label", type=paddle.data_type.integer_value(10))
+    conv1 = paddle.networks.simple_img_conv_pool(
+        input=images, filter_size=5, num_filters=8, pool_size=2,
+        num_channel=1, act="relu")
+    conv2 = paddle.networks.simple_img_conv_pool(
+        input=conv1, filter_size=5, num_filters=16, pool_size=2, act="relu")
+    logits = layer.fc(input=conv2, size=10)
+    cost = layer.classification_cost(input=logits, label=label)
+
+    topo = paddle.topology.Topology([cost])
+    params = paddle.Parameters.from_topology(topo, seed=3)
+    opt = optimizer.Adam(learning_rate=1e-3)
+    sgd = trainer.SGD(cost=cost, parameters=params, update_equation=opt)
+
+    data = [(np.random.RandomState(0).randn(784).astype(np.float32), i % 10)
+            for i in range(32)]
+
+    def reader():
+        yield from data
+
+    costs = []
+
+    def handler(ev):
+        if isinstance(ev, event.EndIteration):
+            costs.append(ev.cost)
+
+    sgd.train(paddle.batch(reader, 16), num_passes=8, event_handler=handler)
+    assert len(costs) == 16
+    assert np.isfinite(costs).all()
+    assert np.mean(costs[-4:]) < np.mean(costs[:4])
