@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Benchmark: AlexNet bs=128 train step on one TPU chip vs the reference's
+headline number (PaddlePaddle on K40m: 334 ms/batch — BASELINE.md,
+reference benchmark/README.md:33-38).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "ms/batch", "vs_baseline": N}
+vs_baseline > 1 means faster than the reference by that factor.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer, trainer
+    from paddle_tpu.models import alexnet
+
+    paddle.init()
+    batch_size = 128
+    img_size = 227
+
+    paddle.topology.reset_name_scope()
+    images, label, logits, cost = alexnet.build(img_size=img_size)
+    topo = paddle.topology.Topology([cost])
+    params = paddle.Parameters.from_topology(topo, seed=0)
+    sgd = trainer.SGD(cost=cost, parameters=params,
+                      update_equation=optimizer.Momentum(momentum=0.9,
+                                                         learning_rate=0.01))
+
+    rng = np.random.RandomState(0)
+    feeds_np = [
+        (rng.randn(3 * img_size * img_size).astype(np.float32), int(rng.randint(1000)))
+        for _ in range(batch_size)
+    ]
+    feeder = sgd._make_feeder(None)
+    feeds = feeder.feed(feeds_np)
+
+    step = sgd._build_step()
+    p = params.as_dict()
+    opt_state = sgd.opt_state
+    mstate = sgd.model_state
+    key = jax.random.PRNGKey(0)
+
+    # warmup / compile; a concrete value fetch is the only reliable
+    # completion barrier over the remote-TPU relay (block_until_ready
+    # returns optimistically there)
+    loss, p, opt_state, mstate, _ = step(p, opt_state, mstate, key, feeds)
+    float(loss)
+
+    iters = 50
+    start = time.perf_counter()
+    for i in range(iters):
+        loss, p, opt_state, mstate, _ = step(p, opt_state, mstate, key, feeds)
+    float(loss)  # forces the whole dependent step chain to complete
+    elapsed = time.perf_counter() - start
+    ms_per_batch = elapsed / iters * 1000.0
+
+    baseline_ms = 334.0  # reference Paddle, AlexNet bs=128, K40m
+    print(json.dumps({
+        "metric": "alexnet_bs128_train_ms_per_batch",
+        "value": round(ms_per_batch, 3),
+        "unit": "ms/batch",
+        "vs_baseline": round(baseline_ms / ms_per_batch, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,8 +10,19 @@ from typing import Any, Dict, Optional
 
 
 class WithMetric:
+    """Metric values may arrive as device arrays (the trainer avoids a host
+    sync per batch); the ``metrics`` property converts to floats on first
+    access and caches — handlers see plain floats either way."""
+
     def __init__(self, evaluator_result: Optional[Dict[str, float]] = None):
-        self.metrics = evaluator_result or {}
+        self._metrics_raw = evaluator_result or {}
+        self._metrics: Optional[Dict[str, float]] = None
+
+    @property
+    def metrics(self) -> Dict[str, float]:
+        if self._metrics is None:
+            self._metrics = {k: float(v) for k, v in self._metrics_raw.items()}
+        return self._metrics
 
 
 class BeginPass:
@@ -38,7 +49,15 @@ class EndIteration(WithMetric):
         super().__init__(evaluator_result)
         self.pass_id = pass_id
         self.batch_id = batch_id
-        self.cost = cost
+        self._cost_raw = cost
+        self._cost: Optional[float] = None
+
+    @property
+    def cost(self) -> float:
+        """Plain float; forces the device sync lazily on first access."""
+        if self._cost is None:
+            self._cost = float(self._cost_raw)
+        return self._cost
 
 
 class TestResult(WithMetric):

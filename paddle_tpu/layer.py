@@ -90,6 +90,18 @@ def _like(template, data):
     return data
 
 
+def _propagate_img_shape(node: LayerOutput, *sources) -> LayerOutput:
+    """Copy (H, W, C) metadata through shape-preserving layers so the image
+    stack (conv/pool/bn/addto chains in ResNet etc.) keeps its geometry.
+    Uses _img_shape_of so data(height=, width=) geometry also propagates."""
+    for src in sources:
+        shp = _img_shape_of(src)
+        if shp is not None:
+            node.img_shape = shp
+            break
+    return node
+
+
 # ---------------------------------------------------------------------------
 # data
 # ---------------------------------------------------------------------------
@@ -466,9 +478,10 @@ def addto(input, act=None, name: Optional[str] = None, bias_attr=False,
         out = _apply_act(activation, out)
         return _apply_extra(ctx, name, out, layer_attr)
 
-    return LayerOutput(name=name, layer_type="addto", inputs=inputs, fn=compute,
+    node = LayerOutput(name=name, layer_type="addto", inputs=inputs, fn=compute,
                        params=params, size=inputs[0].size,
                        is_sequence=inputs[0].is_sequence)
+    return _propagate_img_shape(node, *inputs)
 
 
 @_export
@@ -630,8 +643,9 @@ def dropout(input, dropout_rate: float, name: Optional[str] = None) -> LayerOutp
             return v.with_data(pmath.dropout(v.data, dropout_rate, key, ctx.train))
         return pmath.dropout(v, dropout_rate, key, ctx.train)
 
-    return LayerOutput(name=name, layer_type="dropout", inputs=[input], fn=compute,
+    node = LayerOutput(name=name, layer_type="dropout", inputs=[input], fn=compute,
                        size=input.size, is_sequence=input.is_sequence)
+    return _propagate_img_shape(node, input)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,7 @@
+"""Model zoo built on the layer DSL (reference: v1_api_demo/model_zoo,
+benchmark/paddle/image + rnn configs)."""
+
+from paddle_tpu.models import lenet
+from paddle_tpu.models import alexnet
+from paddle_tpu.models import resnet
+from paddle_tpu.models import text_lstm

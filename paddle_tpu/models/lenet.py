@@ -1,0 +1,24 @@
+"""LeNet-5-style MNIST CNN (reference: v1_api_demo/mnist — BASELINE config #1)."""
+
+from __future__ import annotations
+
+import paddle_tpu as paddle
+from paddle_tpu import layer
+from paddle_tpu.networks import simple_img_conv_pool
+
+
+def build(img_size: int = 28, num_classes: int = 10):
+    """Returns (images, label, logits, cost)."""
+    images = layer.data(name="pixel",
+                        type=paddle.data_type.dense_vector(img_size * img_size),
+                        height=img_size, width=img_size)
+    label = layer.data(name="label",
+                       type=paddle.data_type.integer_value(num_classes))
+    conv1 = simple_img_conv_pool(input=images, filter_size=5, num_filters=20,
+                                 pool_size=2, num_channel=1, act="relu")
+    conv2 = simple_img_conv_pool(input=conv1, filter_size=5, num_filters=50,
+                                 pool_size=2, act="relu")
+    fc1 = layer.fc(input=conv2, size=500, act="relu")
+    logits = layer.fc(input=fc1, size=num_classes)
+    cost = layer.classification_cost(input=logits, label=label)
+    return images, label, logits, cost

@@ -109,17 +109,10 @@ class SGD:
             new_params, new_opt = optimizer.apply(params, grads, opt_state)
             return loss, new_params, new_opt, new_mstate, metric_vals
 
-        jit_kwargs = {"donate_argnums": (0, 1, 2)}
-        if self.mesh is not None:
-            # run under the mesh so sharded feeds trigger SPMD partitioning
-            mesh = self.mesh
-
-            def stepm(params, opt_state, model_state, rng, feeds):
-                with jax.sharding.use_mesh(mesh):
-                    return step(params, opt_state, model_state, rng, feeds)
-
-            return jax.jit(stepm, **jit_kwargs)
-        return jax.jit(step, **jit_kwargs)
+        # With mesh-sharded (NamedSharding) inputs, jit partitions the whole
+        # step SPMD automatically — XLA inserts the grad psum (the
+        # MultiGradientMachine ring / pserver addGradient analog).
+        return jax.jit(step, donate_argnums=(0, 1, 2))
 
     def _build_test(self):
         topo = self.topology
@@ -170,8 +163,22 @@ class SGD:
 
         for pass_id in range(num_passes):
             event_handler(v2_event.BeginPass(pass_id))
+            # host-side floats; device scalars buffer in `pending` and flush
+            # with ONE stacked transfer per stream per log window
             pass_costs: List[float] = []
             pass_metrics: Dict[str, List[float]] = {n: [] for n in self.metrics}
+            pending: List = []
+            pending_metrics: Dict[str, List] = {n: [] for n in self.metrics}
+
+            def flush():
+                if pending:
+                    pass_costs.extend(np.asarray(jnp.stack(pending)).tolist())
+                    pending.clear()
+                for k, buf in pending_metrics.items():
+                    if buf:
+                        pass_metrics[k].extend(np.asarray(jnp.stack(buf)).tolist())
+                        buf.clear()
+
             for batch_id, data_batch in enumerate(reader()):
                 event_handler(v2_event.BeginIteration(pass_id, batch_id))
                 feeds = self._shard_feeds(feeder.feed(data_batch))
@@ -179,18 +186,21 @@ class SGD:
                 with stats.timer("trainOneBatch"):
                     loss, params, opt_state, mstate, metric_vals = self._step_fn(
                         params, opt_state, mstate, key, feeds)
-                cost = float(loss)
-                pass_costs.append(cost)
-                mvals = {k: float(v) for k, v in metric_vals.items()}
-                for k, v in mvals.items():
-                    pass_metrics[k].append(v)
-                event_handler(v2_event.EndIteration(pass_id, batch_id, cost, mvals))
+                # no host sync per batch (the device round-trip costs more
+                # than the step); events convert lazily via properties
+                pending.append(loss)
+                for k, v in metric_vals.items():
+                    pending_metrics[k].append(v)
+                event_handler(v2_event.EndIteration(pass_id, batch_id, loss,
+                                                    metric_vals))
                 if FLAGS.log_period and (batch_id + 1) % FLAGS.log_period == 0:
+                    flush()
                     mtxt = " ".join(f"{k}={np.mean(v[-FLAGS.log_period:]):.5f}"
                                     for k, v in pass_metrics.items())
                     log.info("Pass %d, Batch %d, Cost %.5f %s", pass_id,
-                             batch_id, float(np.mean(pass_costs[-FLAGS.log_period:])), mtxt)
+                             batch_id, np.mean(pass_costs[-FLAGS.log_period:]), mtxt)
             # pass end: sync back, fire event (with test if reader given)
+            flush()
             self.parameters.update_from(params)
             self.opt_state = opt_state
             self.model_state = mstate
