@@ -1,0 +1,257 @@
+"""Beam-search sequence generation — the SequenceGenerator analog.
+
+Reference: paddle/api/SequenceGenerator.cpp:38-96 (host loop: forward one
+step, top-k expand, prune to beam, stop at EOS) and the in-graph
+RecurrentGradientMachine::generateSequence/beamSearch
+(RecurrentGradientMachine.cpp:539, .h:307-342) with GeneratedInput
+(trainer_config_helpers layers.py beam_search).
+
+TPU-native: the whole beam loop is ONE ``lax.scan`` over max_length inside
+jit — beams are a batch dimension (B*K flattening), beam reordering is a
+gather, EOS handling is masking. No per-step host round trips (the reference
+paid a full python→C++ forward per token).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.attr import ParamAttr
+from paddle_tpu.ops.embedding import embedding_lookup
+from paddle_tpu.platform.enforce import EnforceError, enforce_that
+from paddle_tpu.recurrent import StaticInput, _MEMORY_STACK
+from paddle_tpu.sequence import SequenceBatch
+from paddle_tpu.topology import (Context, LayerOutput, ParamSpec, Topology,
+                                 unique_name)
+
+__all__ = ["GeneratedInput", "beam_search"]
+
+
+class GeneratedInput:
+    """The token fed back from the previous beam step, embedded (reference:
+    GeneratedInput in trainer_config_helpers)."""
+
+    def __init__(self, size: int, embedding_name: str, embedding_size: int):
+        self.size = size                    # vocabulary size
+        self.embedding_name = embedding_name
+        self.embedding_size = embedding_size
+
+
+def beam_search(step, input, bos_id: int, eos_id: int, beam_size: int = 5,
+                max_length: int = 30, name: Optional[str] = None) -> LayerOutput:
+    """Generate with beam search. ``step(*frame_args)`` must return the
+    per-step *probability* layer ([B*K, vocab], softmax output), exactly like
+    the reference's beam_search step contract.
+
+    The returned node's value is ``(tokens [B, K, max_length] int32,
+    lengths [B, K] int32, scores [B, K] float32)`` — beams sorted best-first.
+    Evaluate it with paddle.infer / Inference.
+    """
+    name = name or unique_name("beam_search")
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+
+    gen: Optional[GeneratedInput] = None
+    static_inputs: List[StaticInput] = []
+    frame_args: List[LayerOutput] = []
+    static_nodes: List[LayerOutput] = []
+    gen_node: Optional[LayerOutput] = None
+
+    for item in inputs:
+        if isinstance(item, GeneratedInput):
+            enforce_that(gen is None, "only one GeneratedInput allowed",
+                         context="beam_search")
+            gen = item
+            gen_node = LayerOutput(name=unique_name(f"{name}_token_emb"),
+                                   layer_type="frame", inputs=[], fn=None,
+                                   size=item.embedding_size, is_sequence=False)
+            frame_args.append(gen_node)
+        elif isinstance(item, StaticInput):
+            node = LayerOutput(name=unique_name(f"{name}_static"),
+                               layer_type="static_frame", inputs=[], fn=None,
+                               size=item.input.size,
+                               is_sequence=item.is_seq)
+            static_inputs.append(item)
+            static_nodes.append(node)
+            frame_args.append(node)
+        else:
+            raise EnforceError(
+                "beam_search inputs must be GeneratedInput or StaticInput",
+                context="beam_search")
+    enforce_that(gen is not None, "beam_search needs a GeneratedInput",
+                 context="beam_search")
+
+    _MEMORY_STACK.append([])
+    try:
+        prob_layer = step(*frame_args)
+    finally:
+        memories = _MEMORY_STACK.pop()
+    enforce_that(not isinstance(prob_layer, (list, tuple)),
+                 "beam_search step must return a single probability layer",
+                 context="beam_search")
+
+    probe = Topology([prob_layer])
+    link_nodes = []
+    for m in memories:
+        target = probe.by_name.get(m.link_name)
+        if target is None:
+            raise EnforceError(f"memory links to {m.link_name!r} not in step graph",
+                               context="beam_search")
+        link_nodes.append(target)
+    sub_topo = Topology([prob_layer] + link_nodes)
+
+    outer_inputs = [s.input for s in static_inputs] + \
+        [m.boot_layer for m in memories if m.boot_layer is not None]
+
+    # pin canonical names so generation shares weights with the training
+    # recurrent_group built from the same step (see recurrent.py)
+    import dataclasses as _dc
+
+    group_params: Dict[str, ParamSpec] = {}
+    for key, spec in sub_topo.param_specs().items():
+        if spec.attr.name is None:
+            spec = _dc.replace(spec, attr=_dc.replace(spec.attr, name=key))
+        group_params[key] = spec
+    emb_key = gen.embedding_name
+    if emb_key not in group_params:
+        group_params[emb_key] = ParamSpec(
+            (gen.size, gen.embedding_size), ParamAttr(name=emb_key))
+
+    n_static = len(static_inputs)
+    K = beam_size
+    V = gen.size
+    NEG = -1e9
+
+    def compute(ctx: Context, p, ins):
+        static_vals = ins[:n_static]
+        boot_vals = ins[n_static:]
+        emb_table = p[emb_key]
+
+        # batch size from the first boot/static input (boots are enforced
+        # non-sequence at memory() creation, so shape[0] is B)
+        if boot_vals:
+            B = boot_vals[0].shape[0]
+        elif static_vals:
+            sv = static_vals[0]
+            B = sv.num_seqs if isinstance(sv, SequenceBatch) else sv.shape[0]
+        else:
+            raise EnforceError("beam_search needs a static or boot input to "
+                               "infer batch size", context="beam_search")
+
+        # tile statics across beams: dense [B,D] -> [B*K,D]; sequences are
+        # beam-tiled by repeating sequence entries
+        tiled_statics = []
+        for sv in static_vals:
+            if isinstance(sv, SequenceBatch):
+                padded, _ = sv.to_padded()
+                D = padded.shape[-1]
+                T = padded.shape[1]
+                rep = jnp.repeat(padded, K, axis=0)  # [B*K, T, D]
+                lens = jnp.repeat(sv.lengths, K, axis=0)
+                tiled_statics.append(SequenceBatch.from_padded(
+                    rep, lens, capacity=B * K * T))
+            else:
+                tiled_statics.append(jnp.repeat(sv, K, axis=0))
+
+        init_mems = {}
+        bi = 0
+        for m in memories:
+            if m.boot_layer is not None:
+                bv = boot_vals[bi]
+                bi += 1
+                init_mems[m.node.name] = jnp.repeat(bv.astype(jnp.float32), K, axis=0)
+            else:
+                init_mems[m.node.name] = jnp.zeros((B * K, m.size), jnp.float32)
+
+        sub_state = sub_topo.init_state()
+        rngkey = ctx.rng_for(ctx._current or name)
+
+        init = {
+            "tokens": jnp.full((B, K), bos_id, jnp.int32),
+            "scores": jnp.where(jnp.arange(K)[None, :] == 0, 0.0, NEG)
+                       * jnp.ones((B, 1)),
+            "finished": jnp.zeros((B, K), bool),
+            "lengths": jnp.zeros((B, K), jnp.int32),
+            "mems": init_mems,
+        }
+
+        def beam_step(state, _):
+            cur = state["tokens"].reshape(B * K)
+            emb = embedding_lookup(emb_table, cur)  # [B*K, E]
+            feeds = {gen_node.name: emb}
+            for node, sv in zip(static_nodes, tiled_statics):
+                feeds[node.name] = sv
+            for m in memories:
+                feeds[m.node.name] = state["mems"][m.node.name]
+            outs, _st = sub_topo.forward(p, sub_state, feeds, train=False,
+                                         rng=rngkey)
+            probs = outs[0]
+            probs = probs.data if isinstance(probs, SequenceBatch) else probs
+            logp = jnp.log(jnp.clip(probs, 1e-20, 1.0)).reshape(B, K, V)
+
+            fin = state["finished"]
+            # finished beams: freeze (only 'eos' continuation at zero cost)
+            cont = jnp.where(fin[..., None],
+                             jnp.where(jnp.arange(V)[None, None, :] == eos_id,
+                                       0.0, NEG),
+                             logp)
+            total = state["scores"][..., None] + cont          # [B, K, V]
+            flat = total.reshape(B, K * V)
+            top_scores, top_idx = jax.lax.top_k(flat, K)        # [B, K]
+            parent = top_idx // V
+            token = (top_idx % V).astype(jnp.int32)
+
+            batch_ix = jnp.arange(B)[:, None]
+            new_fin = fin[batch_ix, parent] | (token == eos_id)
+            new_len = state["lengths"][batch_ix, parent] + \
+                jnp.where(fin[batch_ix, parent], 0, 1)
+            new_mems = {}
+            for mi, m in enumerate(memories):
+                lo = outs[1 + mi]
+                val = (lo.data if isinstance(lo, SequenceBatch) else lo)
+                val = val.reshape(B, K, -1)
+                keep_prev = state["mems"][m.node.name].reshape(B, K, -1)
+                # finished beams keep their memory
+                sel = jnp.where(fin[batch_ix, parent][..., None],
+                                keep_prev[batch_ix, parent],
+                                val[batch_ix, parent])
+                new_mems[m.node.name] = sel.reshape(B * K, -1)
+
+            new_state = {
+                "tokens": token,
+                "scores": top_scores,
+                "finished": new_fin,
+                "lengths": new_len,
+                "mems": new_mems,
+            }
+            return new_state, (token, parent)
+
+        final, (toks, parents) = jax.lax.scan(beam_step, init, None,
+                                              length=max_length)
+
+        # backtrack beam parents to recover full sequences [B, K, T]
+        def back(nxt_beam, tp):
+            tok_t, par_t = tp   # [B, K]
+            batch_ix = jnp.arange(B)[:, None]
+            beam_here = par_t[batch_ix, nxt_beam]
+            tok_here = tok_t[batch_ix, nxt_beam]
+            return beam_here, tok_here
+
+        last_beam = jnp.broadcast_to(jnp.arange(K)[None, :], (B, K))
+        _, seq_rev = jax.lax.scan(back, last_beam, (toks, parents),
+                                  reverse=True)
+        tokens = jnp.moveaxis(seq_rev, 0, 2)   # [B, K, T]
+        # mask tokens after eos with eos
+        t_ix = jnp.arange(max_length)[None, None, :]
+        valid = t_ix < final["lengths"][..., None]
+        tokens = jnp.where(valid, tokens, eos_id)
+        return tokens, final["lengths"], final["scores"]
+
+    node = LayerOutput(name=name, layer_type="beam_search", inputs=outer_inputs,
+                       fn=compute, params=group_params, size=max_length,
+                       is_sequence=False)
+    node.beam_size = beam_size
+    node.max_length = max_length
+    return node

@@ -107,6 +107,9 @@ def _propagate_img_shape(node: LayerOutput, *sources) -> LayerOutput:
 # ---------------------------------------------------------------------------
 
 
+_data_counter = [0]
+
+
 @_export
 def data(name: str, type: InputType, height: int = None, width: int = None,
          **_ignored) -> LayerOutput:
@@ -116,6 +119,10 @@ def data(name: str, type: InputType, height: int = None, width: int = None,
         size=type.dim, is_sequence=type.seq != SeqKind.NO_SEQUENCE)
     node.input_type = type
     node.height, node.width = height, width
+    # declaration order drives the default feeding column order (v2
+    # semantics: sample tuples align with data layers as declared)
+    node.declare_idx = _data_counter[0]
+    _data_counter[0] += 1
     return node
 
 
@@ -1839,3 +1846,100 @@ def dotmul_bcast(a, b, name: Optional[str] = None) -> LayerOutput:
 
     return LayerOutput(name=name, layer_type="dotmul_bcast", inputs=[a, b],
                        fn=compute, size=a.size, is_sequence=a.is_sequence)
+
+
+# ---------------------------------------------------------------------------
+# recurrent group surface (paddle_tpu/recurrent.py) + step cells
+# ---------------------------------------------------------------------------
+
+from paddle_tpu.recurrent import StaticInput, memory, recurrent_group  # noqa: E402
+
+__all__ += ["StaticInput", "memory", "recurrent_group", "gru_step", "lstm_step"]
+
+
+def gru_step(input, output_mem, size: int = None, act=None, gate_act=None,
+             name: Optional[str] = None, param_attr=None,
+             bias_attr=True) -> LayerOutput:
+    """One GRU step for use inside recurrent_group (reference:
+    gru_step_layer/GruStepLayer.cpp). input: [B, 3*size] projected x_t;
+    output_mem: the memory holding h_{t-1}."""
+    size = size or output_mem.size
+    name = name or unique_name("gru_step")
+    params = {"w": ParamSpec((size, 3 * size), ParamAttr.to_attr(param_attr))}
+    has_bias = bool(bias_attr)
+    if has_bias:
+        params["b"] = ParamSpec((3 * size,), ParamAttr.to_attr(
+            None if bias_attr is True else bias_attr))
+    cand = _resolve_act(act or "tanh")
+    gate = _resolve_act(gate_act or "sigmoid")
+
+    def compute(ctx, p, ins):
+        x, h = _data_of(ins[0]), _data_of(ins[1])
+        return prnn.gru_cell(x, h, p["w"], p.get("b"), gate_act=gate.fn,
+                             cand_act=cand.fn)
+
+    return LayerOutput(name=name, layer_type="gru_step",
+                       inputs=[input, output_mem], fn=compute, params=params,
+                       size=size, is_sequence=False)
+
+
+def lstm_step(input, state_mem, output_mem=None, size: int = None, act=None,
+              gate_act=None, state_act=None, name: Optional[str] = None,
+              param_attr=None, bias_attr=True) -> LayerOutput:
+    """One LSTM step (reference: lstm_step_layer). input: [B, 4*size]
+    pre-projected; state_mem: memory of c_{t-1}; output_mem: memory of
+    h_{t-1}. Returns h_t; ``.state`` output is exposed as a second node via
+    lstm_step_state()."""
+    size = size or state_mem.size
+    name = name or unique_name("lstm_step")
+    params = {"w": ParamSpec((size, 4 * size), ParamAttr.to_attr(param_attr))}
+    has_bias = bool(bias_attr)
+    if has_bias:
+        params["b"] = ParamSpec((4 * size,), ParamAttr.to_attr(
+            None if bias_attr is True else bias_attr))
+    o_act = _resolve_act(act or "tanh")
+    g_act = _resolve_act(gate_act or "sigmoid")
+    s_act = _resolve_act(state_act or "tanh")
+    inputs = [input, state_mem] + ([output_mem] if output_mem is not None else [])
+
+    def compute(ctx, p, ins):
+        x, c = _data_of(ins[0]), _data_of(ins[1])
+        h = _data_of(ins[2]) if len(ins) > 2 else jnp.zeros_like(c)
+        new_h, st = prnn.lstm_cell(x, prnn.LSTMState(h, c), p["w"], p.get("b"),
+                                   gate_act=g_act.fn, cell_act=s_act.fn,
+                                   out_act=o_act.fn)
+        # pack h and c side by side; callers split with lstm_step_state
+        return jnp.concatenate([new_h, st.c], axis=-1)
+
+    node = LayerOutput(name=name, layer_type="lstm_step", inputs=inputs,
+                       fn=compute, params=params, size=2 * size,
+                       is_sequence=False)
+    node.lstm_size = size
+    return node
+
+
+def lstm_step_output(step_node, name: Optional[str] = None) -> LayerOutput:
+    """h_t half of an lstm_step node."""
+    size = step_node.lstm_size
+    name = name or unique_name("lstm_h")
+
+    def compute(ctx, p, ins):
+        return _data_of(ins[0])[..., :size]
+
+    return LayerOutput(name=name, layer_type="lstm_h", inputs=[step_node],
+                       fn=compute, size=size, is_sequence=False)
+
+
+def lstm_step_state(step_node, name: Optional[str] = None) -> LayerOutput:
+    """c_t half of an lstm_step node."""
+    size = step_node.lstm_size
+    name = name or unique_name("lstm_c")
+
+    def compute(ctx, p, ins):
+        return _data_of(ins[0])[..., size:]
+
+    return LayerOutput(name=name, layer_type="lstm_c", inputs=[step_node],
+                       fn=compute, size=size, is_sequence=False)
+
+
+__all__ += ["lstm_step_output", "lstm_step_state"]

@@ -1,0 +1,285 @@
+"""Recurrent layer groups — the RecurrentGradientMachine analog.
+
+Reference: paddle/gserver/gradientmachines/RecurrentGradientMachine.{h,cpp}
+(unrolls a per-frame sub-network over sequence frames with cross-frame
+`memory` links, AgentLayer/ScatterAgent plumbing) and the config surface
+trainer_config_helpers recurrent_group/memory/StaticInput (layers.py).
+
+TPU-native: the user's ``step`` function is traced ONCE into a sub-Topology
+whose frame inputs are placeholder nodes; at runtime the group node converts
+sequence inputs to padded [B, T, D] and drives the sub-topology under
+``lax.scan`` — one compiled region for all timesteps (the reference re-ran a
+C++ sub-network per frame). Memories are scan carries; masked steps carry
+state through unchanged, preserving exact variable-length semantics.
+
+``memory(name=N)`` links to the step-graph layer literally named N, exactly
+like the reference's name-based memory links.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.platform.enforce import EnforceError, enforce_that
+from paddle_tpu.sequence import SequenceBatch
+from paddle_tpu.topology import (Context, LayerOutput, ParamSpec, Topology,
+                                 unique_name)
+
+__all__ = ["memory", "StaticInput", "recurrent_group"]
+
+
+# stack of per-group memory collections; populated while a step fn is traced
+_MEMORY_STACK: List[List["_Memory"]] = []
+
+
+class _Memory:
+    def __init__(self, node: LayerOutput, link_name: str, size: int,
+                 boot_layer: Optional[LayerOutput], boot_with_const_id=None):
+        self.node = node            # placeholder node used inside the step
+        self.link_name = link_name  # step layer whose output feeds t+1
+        self.size = size
+        self.boot_layer = boot_layer
+        self.boot_with_const_id = boot_with_const_id
+
+
+def memory(name: str, size: int, boot_layer: Optional[LayerOutput] = None,
+           is_seq: bool = False, **_kw) -> LayerOutput:
+    """Cross-frame state inside a recurrent_group step (reference:
+    trainer_config_helpers memory()). ``name`` names the step layer whose
+    output becomes this memory at the next frame."""
+    enforce_that(len(_MEMORY_STACK) > 0,
+                 "memory() must be called inside a recurrent_group step",
+                 context="recurrent")
+    enforce_that(not is_seq, "sequence memories (is_seq=True) are not "
+                 "supported yet — restructure as a nested recurrent_group",
+                 context="recurrent")
+    enforce_that(not _kw, f"unsupported memory() options: {sorted(_kw)}",
+                 context="recurrent")
+    enforce_that(boot_layer is None or not boot_layer.is_sequence,
+                 "memory boot_layer must be a non-sequence layer "
+                 "(pool/last_seq it first)", context="recurrent")
+    node = LayerOutput(name=unique_name(f"mem_{name}"), layer_type="memory",
+                       inputs=[], fn=None, size=size, is_sequence=False)
+    _MEMORY_STACK[-1].append(_Memory(node, name, size, boot_layer))
+    return node
+
+
+class StaticInput:
+    """A full (possibly sequence) value visible unchanged at every frame
+    (reference: StaticInput in layers.py / the 'static agent' link)."""
+
+    def __init__(self, input: LayerOutput, is_seq: bool = None):
+        self.input = input
+        self.is_seq = input.is_sequence if is_seq is None else is_seq
+
+
+def recurrent_group(step, input, reverse: bool = False,
+                    name: Optional[str] = None) -> Union[LayerOutput, List[LayerOutput]]:
+    """Run ``step`` over the frames of the sequence inputs (reference:
+    recurrent_group → RecurrentGradientMachine::forward,
+    RecurrentGradientMachine.cpp:530).
+
+    ``input``: sequence LayerOutputs (per-frame slices) and/or StaticInputs.
+    ``step(*frame_args)`` builds the per-frame graph; returns one or more
+    LayerOutputs. Sequence outputs of the group are SequenceBatches aligned
+    with the first sequence input.
+    """
+    name = name or unique_name("recurrent_group")
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+
+    seq_inputs: List[LayerOutput] = []
+    static_inputs: List[StaticInput] = []
+    frame_args: List[LayerOutput] = []
+    frame_nodes: List[LayerOutput] = []    # placeholders for per-frame slices
+    static_nodes: List[LayerOutput] = []   # placeholders for statics
+
+    for item in inputs:
+        if isinstance(item, StaticInput):
+            node = LayerOutput(name=unique_name(f"{name}_static"),
+                               layer_type="static_frame", inputs=[], fn=None,
+                               size=item.input.size,
+                               is_sequence=item.is_seq)
+            static_inputs.append(item)
+            static_nodes.append(node)
+            frame_args.append(node)
+        else:
+            enforce_that(item.is_sequence,
+                         f"recurrent_group input {item.name} must be a sequence "
+                         "(wrap non-sequences in StaticInput)", context="recurrent")
+            node = LayerOutput(name=unique_name(f"{name}_frame"),
+                               layer_type="frame", inputs=[], fn=None,
+                               size=item.size, is_sequence=False)
+            seq_inputs.append(item)
+            frame_nodes.append(node)
+            frame_args.append(node)
+
+    enforce_that(len(seq_inputs) > 0, "recurrent_group needs >=1 sequence input",
+                 context="recurrent")
+
+    # ---- trace the step graph once --------------------------------------
+    _MEMORY_STACK.append([])
+    try:
+        step_outs = step(*frame_args)
+    finally:
+        memories = _MEMORY_STACK.pop()
+    multi_out = isinstance(step_outs, (list, tuple))
+    out_list: List[LayerOutput] = list(step_outs) if multi_out else [step_outs]
+
+    sub_outputs = list(out_list)
+    sub_topo_probe = Topology(sub_outputs)
+    # memory link targets must exist in the step graph
+    link_nodes: Dict[str, LayerOutput] = {}
+    for m in memories:
+        target = sub_topo_probe.by_name.get(m.link_name)
+        if target is None:
+            # the linked layer may not be on the path to outputs; search the
+            # step outputs' closure plus memory links transitively — require
+            # the user to return it if truly disjoint
+            raise EnforceError(
+                f"memory links to layer {m.link_name!r} which is not in the "
+                f"step graph reachable from its outputs", context="recurrent")
+        link_nodes[m.link_name] = target
+    sub_topo = Topology(sub_outputs + [link_nodes[m.link_name] for m in memories])
+
+    # ---- build the group node in the outer graph ------------------------
+    outer_inputs: List[LayerOutput] = (
+        list(seq_inputs) + [s.input for s in static_inputs] +
+        [m.boot_layer for m in memories if m.boot_layer is not None])
+
+    # Hoist sub-graph params, pinning each spec's canonical name to its sub
+    # key so the OUTER param table uses the same key regardless of which
+    # group hosts the step — this is what lets a recurrent_group (training)
+    # and a beam_search (generation) built from the same step share weights.
+    import dataclasses as _dc
+
+    group_params: Dict[str, ParamSpec] = {}
+    for key, spec in sub_topo.param_specs().items():
+        if spec.attr.name is None:
+            spec = _dc.replace(spec, attr=_dc.replace(spec.attr, name=key))
+        group_params[key] = spec
+
+    n_seq = len(seq_inputs)
+    n_static = len(static_inputs)
+
+    def compute(ctx: Context, p, ins):
+        seq_vals: List[SequenceBatch] = ins[:n_seq]
+        static_vals = ins[n_seq:n_seq + n_static]
+        boot_vals = ins[n_seq + n_static:]
+        boot_map = {}
+        bi = 0
+        for m in memories:
+            if m.boot_layer is not None:
+                boot_map[m.node.name] = boot_vals[bi]
+                bi += 1
+
+        first = seq_vals[0]
+        padded_list, mask = [], None
+        T = None
+        for sv in seq_vals:
+            pd, mk = sv.to_padded()
+            padded_list.append(pd)
+            mask = mk if mask is None else mask
+            T = pd.shape[1]
+        B = first.num_seqs
+
+        # stateful sub-layers (batch_norm moving stats) ride the scan carry
+        # and propagate outward through the group's own state slots
+        group_name = ctx._current or name
+        init_sub_state = sub_topo.init_state()
+        sub_state0 = {
+            lname: {k: ctx.get_state(group_name, f"{lname}/{k}")
+                    for k in slots}
+            for lname, slots in init_sub_state.items()
+        } if init_sub_state else {}
+        base_key = ctx.rng_for(group_name)
+
+        def frame(carry, xs):
+            mems, sstate = carry
+            t_slices, m_t, t_idx = xs
+            feeds: Dict[str, Any] = {}
+            for node, sl in zip(frame_nodes, t_slices):
+                feeds[node.name] = sl
+            for node, sv in zip(static_nodes, static_vals):
+                feeds[node.name] = sv
+            for m in memories:
+                feeds[m.node.name] = mems[m.node.name]
+            # fresh randomness per frame (dropout masks differ across time)
+            key = jax.random.fold_in(base_key, t_idx)
+            outs, new_sstate = sub_topo.forward(p, sstate, feeds,
+                                                train=ctx.train, rng=key)
+            frame_outs = outs[: len(out_list)]
+            link_outs = outs[len(out_list):]
+            new_mems = {}
+            mm = m_t[:, None]
+            for m, lo in zip(memories, link_outs):
+                prev = mems[m.node.name]
+                val = lo.data if isinstance(lo, SequenceBatch) else lo
+                new_mems[m.node.name] = jnp.where(mm, val, prev)
+            # state only advances on frames where any sequence is live
+            any_live = jnp.any(m_t)
+            kept_state = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(any_live, new, old),
+                new_sstate, sstate) if sstate else sstate
+            ys = tuple(o.data if isinstance(o, SequenceBatch) else o
+                       for o in frame_outs)
+            return (new_mems, kept_state), ys
+
+        init_mems = {}
+        for m in memories:
+            if m.node.name in boot_map:
+                bv = boot_map[m.node.name]
+                enforce_that(not isinstance(bv, SequenceBatch),
+                             f"memory {m.link_name!r} boot_layer must be a "
+                             "non-sequence layer (got a sequence)",
+                             context="recurrent")
+                init_mems[m.node.name] = bv.astype(jnp.float32)
+            else:
+                init_mems[m.node.name] = jnp.zeros((B, m.size), jnp.float32)
+
+        xs = (tuple(jnp.swapaxes(pd, 0, 1) for pd in padded_list),
+              jnp.swapaxes(mask, 0, 1),
+              jnp.arange(T, dtype=jnp.int32))
+        (_, final_sstate), ys = jax.lax.scan(frame, (init_mems, sub_state0),
+                                             xs, reverse=reverse)
+        for lname, slots in (final_sstate or {}).items():
+            for k, v in slots.items():
+                ctx.set_state(group_name, f"{lname}/{k}", v)
+        # ys: tuple of [T, B, D] -> SequenceBatch each
+        results = []
+        for y in ys:
+            y = jnp.swapaxes(y, 0, 1)  # [B, T, D]
+            results.append(SequenceBatch.from_padded(y, first.lengths,
+                                                     capacity=first.capacity))
+        return tuple(results) if multi_out else results[0]
+
+    # expose sub-layer state (e.g. batch_norm moving stats) as group state
+    # slots keyed '<sublayer>/<slot>' so it persists across steps
+    group_state = {
+        f"{lname}/{k}": spec
+        for lname, slots in sub_topo.state_specs().items()
+        for k, spec in slots.items()
+    }
+
+    group_node = LayerOutput(name=name, layer_type="recurrent_group",
+                             inputs=outer_inputs, fn=compute,
+                             params=group_params, state=group_state,
+                             size=out_list[0].size,
+                             is_sequence=True)
+
+    if not multi_out:
+        return group_node
+
+    # expose each step output as its own node reading the group's tuple
+    results = []
+    for idx, o in enumerate(out_list):
+        def pick(ctx, p, ins, idx=idx):
+            return ins[0][idx]
+
+        node = LayerOutput(name=f"{name}_out{idx}", layer_type="rg_output",
+                           inputs=[group_node], fn=pick, size=o.size,
+                           is_sequence=True)
+        results.append(node)
+    return results

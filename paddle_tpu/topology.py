@@ -160,7 +160,9 @@ class Topology:
         self.outputs: List[LayerOutput] = list(outputs)
         self.nodes: List[LayerOutput] = topological_order(self.outputs)
         self.by_name: Dict[str, LayerOutput] = {n.name: n for n in self.nodes}
-        self.data_nodes: List[LayerOutput] = [n for n in self.nodes if n.layer_type == "data"]
+        self.data_nodes: List[LayerOutput] = sorted(
+            (n for n in self.nodes if n.layer_type == "data"),
+            key=lambda n: getattr(n, "declare_idx", 0))
 
     # ---- specs -----------------------------------------------------------
 
@@ -207,7 +209,7 @@ class Topology:
         ctx = Context(train=train, rng=rng, state=state)
         values: Dict[str, Any] = {}
         for node in topological_order(wanted):
-            if node.layer_type == "data":
+            if node.fn is None:  # data layers and frame/memory placeholders
                 if node.name not in feeds:
                     raise EnforceError(f"missing feed for data layer {node.name!r}",
                                        context="forward")
