@@ -1,0 +1,282 @@
+"""Blockwise (flash) attention for TPU — pallas kernel + pure-JAX reference.
+
+This is the TPU-native successor of the reference's attention machinery
+(trainer_config_helpers/networks.py:1304 simple_attention, :1402
+dot_product_attention) extended to the modern multi-head form the new
+framework needs for long-context support.  Segment-id masking plays the role
+of the reference's ragged-sequence representation
+(Argument.sequenceStartPositions, paddle/parameter/Argument.h:84-90;
+LoDTensor, paddle/framework/lod_tensor.h:57): sequences are packed
+back-to-back in one buffer and attention never crosses a segment boundary,
+so there is no padding waste.
+
+Design notes (TPU-first):
+  - forward is a pallas kernel: grid (batch, heads, q-blocks); K/V live in
+    VMEM per (batch, head); online-softmax accumulation in fp32; matmuls hit
+    the MXU with block_q x head_dim x block_k shapes.
+  - backward is a blockwise lax.scan over key blocks in plain JAX (memory
+    O(S * block_k), never materialises the S x S score matrix); XLA fuses it
+    well.  A full pallas backward is a later optimisation.
+  - on CPU (tests / 8-device virtual mesh) the kernel runs in interpret mode.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+# ---------------------------------------------------------------------------
+# Reference implementation (test oracle; also used for tiny shapes)
+# ---------------------------------------------------------------------------
+
+def mha_reference(q, k, v, segment_ids=None, kv_segment_ids=None,
+                  causal: bool = False, sm_scale: Optional[float] = None):
+    """Plain-JAX multi-head attention.
+
+    q: (B, Sq, H, D); k, v: (B, Sk, H, D); segment_ids: (B, Sq) int32,
+    kv_segment_ids: (B, Sk).  Returns (B, Sq, H, D).
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    mask = None
+    if segment_ids is not None:
+        kv_seg = segment_ids if kv_segment_ids is None else kv_segment_ids
+        mask = (segment_ids[:, None, :, None] == kv_seg[:, None, None, :])
+    if causal:
+        cm = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))[None, None]
+        mask = cm if mask is None else (mask & cm)
+    if mask is not None:
+        s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward kernel
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref,
+                      lse_ref, *, block_k: int, sm_scale: float,
+                      causal: bool):
+    # q_ref: (1, 1, block_q, D); k_ref/v_ref: (1, 1, Sk, D)
+    # qseg_ref: (B, block_q); kseg_ref: (B, Sk) — full batch dim because TPU
+    # block shapes must tile (8, 128) or span the whole array dim
+    block_q = q_ref.shape[2]
+    head_dim = q_ref.shape[3]
+    seq_k = k_ref.shape[2]
+    b = pl.program_id(0)
+    qi = pl.program_id(2)
+
+    q = q_ref[0, 0, :, :].astype(jnp.float32) * sm_scale
+    q_ids = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    q_seg = qseg_ref[b, :].reshape(block_q, 1)
+
+    num_kb = seq_k // block_k
+
+    def body(j, carry):
+        m_prev, l_prev, acc = carry
+        kb = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        k_ids = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        k_seg = kseg_ref[b, pl.ds(j * block_k, block_k)]
+        mask = (q_seg == k_seg.reshape(1, block_k))
+        if causal:
+            mask = mask & (q_ids >= k_ids)
+        s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
+
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc
+
+    m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
+
+    if causal:
+        # skip key blocks strictly after this q block
+        num_kb_eff = jnp.minimum(
+            num_kb, (qi + 1) * block_q // block_k +
+            jnp.int32(block_q % block_k != 0) + 1)
+    else:
+        num_kb_eff = num_kb
+    m, l, acc = jax.lax.fori_loop(0, num_kb_eff, body, (m0, l0, acc0))
+
+    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zeros, not NaN
+    o_ref[0, 0, :, :] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0, 0, :, :] = m + jnp.log(l)
+
+
+def _flash_fwd(q, k, v, q_seg, kv_seg, causal, sm_scale, block_q, block_k,
+               interpret):
+    batch, seq_q, heads, head_dim = q.shape
+    seq_k = k.shape[1]
+    block_q = min(block_q, seq_q)
+    block_k = min(block_k, seq_k)
+    assert seq_q % block_q == 0 and seq_k % block_k == 0, (
+        f"sequence lengths ({seq_q},{seq_k}) must divide by blocks "
+        f"({block_q},{block_k}) — DataFeeder pads capacity to multiples")
+    # (B, S, H, D) -> (B, H, S, D) for contiguous per-head blocks
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    grid = (batch, heads, seq_q // block_q)
+    kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
+                               sm_scale=sm_scale, causal=causal)
+    out_t, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, head_dim),
+                         lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, seq_k, head_dim),
+                         lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, seq_k, head_dim),
+                         lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((batch, block_q), lambda b, h, i: (0, i)),
+            pl.BlockSpec((batch, seq_k), lambda b, h, i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, head_dim),
+                         lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((batch, heads, seq_q, head_dim), q.dtype),
+            jax.ShapeDtypeStruct((batch, heads, seq_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, q_seg, kv_seg)
+    return out_t.transpose(0, 2, 1, 3), lse[..., 0]
+
+
+# ---------------------------------------------------------------------------
+# Backward: blockwise scan over key blocks (plain JAX)
+# ---------------------------------------------------------------------------
+
+def _flash_bwd(res, do, *, causal, sm_scale, block_k):
+    q, k, v, q_seg, kv_seg, out, lse = res
+    batch, seq_q, heads, head_dim = q.shape
+    seq_k = k.shape[1]
+    block_k = min(block_k, seq_k)
+    nkb = seq_k // block_k
+
+    qf = q.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1)  # (B,Sq,H)
+    q_ids = jnp.arange(seq_q)
+    k_ids_all = jnp.arange(seq_k).reshape(nkb, block_k)
+    k_blocks = k.reshape(batch, nkb, block_k, heads, head_dim)
+    v_blocks = v.reshape(batch, nkb, block_k, heads, head_dim)
+    kseg_blocks = kv_seg.reshape(batch, nkb, block_k)
+
+    def one_block(dq_acc, blk):
+        kb, vb, ksegb, kids = blk  # kb: (B, block_k, H, D)
+        s = jnp.einsum("bqhd,bkhd->bqhk", qf, kb.astype(jnp.float32))
+        s = s * sm_scale
+        mask = (q_seg[:, :, None, None] == ksegb[:, None, None, :])
+        if causal:
+            mask = mask & (q_ids[None, :, None, None] >= kids[None, None, None, :])
+        s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
+        p = jnp.exp(s - lse.transpose(0, 2, 1)[:, :, :, None])  # (B,Sq,H,bk)
+        p = jnp.where(mask, p, 0.0)
+        dv = jnp.einsum("bqhk,bqhd->bkhd", p, dof)
+        dp = jnp.einsum("bqhd,bkhd->bqhk", dof, vb.astype(jnp.float32))
+        ds = p * (dp - delta[:, :, :, None]) * sm_scale
+        dq_acc = dq_acc + jnp.einsum("bqhk,bkhd->bqhd", ds,
+                                     kb.astype(jnp.float32))
+        dk = jnp.einsum("bqhk,bqhd->bkhd", ds, qf)
+        return dq_acc, (dk, dv)
+
+    dq0 = jnp.zeros((batch, seq_q, heads, head_dim), jnp.float32)
+    dq, (dk_b, dv_b) = jax.lax.scan(
+        one_block, dq0,
+        (k_blocks.transpose(1, 0, 2, 3, 4), v_blocks.transpose(1, 0, 2, 3, 4),
+         kseg_blocks.transpose(1, 0, 2), k_ids_all))
+    dk = dk_b.transpose(1, 0, 2, 3, 4).reshape(batch, seq_k, heads, head_dim)
+    dv = dv_b.transpose(1, 0, 2, 3, 4).reshape(batch, seq_k, heads, head_dim)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            None, None)
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_attention(q, k, v, q_seg, kv_seg, causal, sm_scale, block_q,
+                     block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, q_seg, kv_seg, causal, sm_scale, block_q,
+                        block_k, interpret)
+    return out
+
+
+def _fwd_rule(q, k, v, q_seg, kv_seg, causal, sm_scale, block_q, block_k,
+              interpret):
+    out, lse = _flash_fwd(q, k, v, q_seg, kv_seg, causal, sm_scale, block_q,
+                          block_k, interpret)
+    return out, (q, k, v, q_seg, kv_seg, out, lse)
+
+
+def _bwd_rule(causal, sm_scale, block_q, block_k, interpret, res, do):
+    return _flash_bwd(res, do, causal=causal, sm_scale=sm_scale,
+                      block_k=block_k)
+
+
+_flash_attention.defvjp(_fwd_rule, _bwd_rule)
+
+
+def flash_attention(q, k, v, segment_ids=None, kv_segment_ids=None,
+                    causal: bool = False, sm_scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """Blockwise multi-head attention (pallas forward, blockwise backward).
+
+    Args:
+      q: (B, Sq, H, D); k, v: (B, Sk, H, D).
+      segment_ids: (B, Sq) int32 packed-sequence ids; tokens only attend
+        within their own segment (use -1 for padding: give padding its own
+        id).  None => full attention.
+      kv_segment_ids: (B, Sk); defaults to segment_ids (self-attention).
+      causal: lower-triangular masking (positions are absolute in the packed
+        buffer — combine with segment ids for per-sequence causality).
+    """
+    if sm_scale is None:
+        sm_scale = float(q.shape[-1]) ** -0.5
+    if interpret is None:
+        interpret = _interpret_default()
+    batch, seq_q = q.shape[0], q.shape[1]
+    seq_k = k.shape[1]
+    if segment_ids is None:
+        q_seg = jnp.zeros((batch, seq_q), jnp.int32)
+        kv_seg = jnp.zeros((batch, seq_k), jnp.int32)
+    else:
+        q_seg = segment_ids.astype(jnp.int32)
+        kv_seg = (q_seg if kv_segment_ids is None
+                  else kv_segment_ids.astype(jnp.int32))
+    return _flash_attention(q, k, v, q_seg, kv_seg, bool(causal),
+                            float(sm_scale), int(block_q), int(block_k),
+                            bool(interpret))
