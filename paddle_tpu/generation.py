@@ -22,7 +22,10 @@ import jax.numpy as jnp
 from paddle_tpu.attr import ParamAttr
 from paddle_tpu.ops.embedding import embedding_lookup
 from paddle_tpu.platform.enforce import EnforceError, enforce_that
-from paddle_tpu.recurrent import StaticInput, _MEMORY_STACK
+from paddle_tpu.recurrent import (StaticInput, group_state_slots,
+                                  make_static_node, pin_param_names,
+                                  read_group_state, resolve_memory_links,
+                                  trace_step)
 from paddle_tpu.sequence import SequenceBatch
 from paddle_tpu.topology import (Context, LayerOutput, ParamSpec, Topology,
                                  unique_name)
@@ -69,10 +72,7 @@ def beam_search(step, input, bos_id: int, eos_id: int, beam_size: int = 5,
                                    size=item.embedding_size, is_sequence=False)
             frame_args.append(gen_node)
         elif isinstance(item, StaticInput):
-            node = LayerOutput(name=unique_name(f"{name}_static"),
-                               layer_type="static_frame", inputs=[], fn=None,
-                               size=item.input.size,
-                               is_sequence=item.is_seq)
+            node = make_static_node(name, item)
             static_inputs.append(item)
             static_nodes.append(node)
             frame_args.append(node)
@@ -83,23 +83,13 @@ def beam_search(step, input, bos_id: int, eos_id: int, beam_size: int = 5,
     enforce_that(gen is not None, "beam_search needs a GeneratedInput",
                  context="beam_search")
 
-    _MEMORY_STACK.append([])
-    try:
-        prob_layer = step(*frame_args)
-    finally:
-        memories = _MEMORY_STACK.pop()
+    prob_layer, memories = trace_step(step, frame_args)
     enforce_that(not isinstance(prob_layer, (list, tuple)),
                  "beam_search step must return a single probability layer",
                  context="beam_search")
 
-    probe = Topology([prob_layer])
-    link_nodes = []
-    for m in memories:
-        target = probe.by_name.get(m.link_name)
-        if target is None:
-            raise EnforceError(f"memory links to {m.link_name!r} not in step graph",
-                               context="beam_search")
-        link_nodes.append(target)
+    link_nodes = resolve_memory_links(Topology([prob_layer]), memories,
+                                      "beam_search")
     sub_topo = Topology([prob_layer] + link_nodes)
 
     outer_inputs = [s.input for s in static_inputs] + \
@@ -107,13 +97,7 @@ def beam_search(step, input, bos_id: int, eos_id: int, beam_size: int = 5,
 
     # pin canonical names so generation shares weights with the training
     # recurrent_group built from the same step (see recurrent.py)
-    import dataclasses as _dc
-
-    group_params: Dict[str, ParamSpec] = {}
-    for key, spec in sub_topo.param_specs().items():
-        if spec.attr.name is None:
-            spec = _dc.replace(spec, attr=_dc.replace(spec.attr, name=key))
-        group_params[key] = spec
+    group_params = pin_param_names(sub_topo)
     emb_key = gen.embedding_name
     if emb_key not in group_params:
         group_params[emb_key] = ParamSpec(
@@ -165,7 +149,10 @@ def beam_search(step, input, bos_id: int, eos_id: int, beam_size: int = 5,
             else:
                 init_mems[m.node.name] = jnp.zeros((B * K, m.size), jnp.float32)
 
-        sub_state = sub_topo.init_state()
+        # trained sub-layer state (batch_norm moving stats) comes in through
+        # the node's state slots — NOT a fresh init_state(), which would
+        # silently normalise with untrained statistics at generation time
+        sub_state = read_group_state(ctx, ctx._current or name, sub_topo)
         rngkey = ctx.rng_for(ctx._current or name)
 
         init = {
@@ -250,7 +237,8 @@ def beam_search(step, input, bos_id: int, eos_id: int, beam_size: int = 5,
         return tokens, final["lengths"], final["scores"]
 
     node = LayerOutput(name=name, layer_type="beam_search", inputs=outer_inputs,
-                       fn=compute, params=group_params, size=max_length,
+                       fn=compute, params=group_params,
+                       state=group_state_slots(sub_topo), size=max_length,
                        is_sequence=False)
     node.beam_size = beam_size
     node.max_length = max_length

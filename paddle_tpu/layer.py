@@ -1892,7 +1892,13 @@ def lstm_step(input, state_mem, output_mem=None, size: int = None, act=None,
     lstm_step_state()."""
     size = size or state_mem.size
     name = name or unique_name("lstm_step")
-    params = {"w": ParamSpec((size, 4 * size), ParamAttr.to_attr(param_attr))}
+    # the h-recurrence weight only exists when the step actually carries an
+    # h memory; without output_mem the recurrence must be pre-projected into
+    # ``input`` (the reference lstm_step contract) and a weight here would be
+    # a dead randomly-initialised parameter
+    params = {}
+    if output_mem is not None:
+        params["w"] = ParamSpec((size, 4 * size), ParamAttr.to_attr(param_attr))
     has_bias = bool(bias_attr)
     if has_bias:
         params["b"] = ParamSpec((4 * size,), ParamAttr.to_attr(
@@ -1905,7 +1911,8 @@ def lstm_step(input, state_mem, output_mem=None, size: int = None, act=None,
     def compute(ctx, p, ins):
         x, c = _data_of(ins[0]), _data_of(ins[1])
         h = _data_of(ins[2]) if len(ins) > 2 else jnp.zeros_like(c)
-        new_h, st = prnn.lstm_cell(x, prnn.LSTMState(h, c), p["w"], p.get("b"),
+        new_h, st = prnn.lstm_cell(x, prnn.LSTMState(h, c), p.get("w"),
+                                   p.get("b"),
                                    gate_act=g_act.fn, cell_act=s_act.fn,
                                    out_act=o_act.fn)
         # pack h and c side by side; callers split with lstm_step_state

@@ -31,9 +31,10 @@ def lstm_cell(x_proj: jax.Array, state: LSTMState, w_h: jax.Array,
               bias: Optional[jax.Array] = None,
               gate_act=jax.nn.sigmoid, cell_act=jnp.tanh,
               out_act=jnp.tanh) -> Tuple[jax.Array, LSTMState]:
-    """One LSTM step. x_proj: [B, 4H] (input already projected), w_h: [H, 4H]."""
+    """One LSTM step. x_proj: [B, 4H] (input already projected), w_h: [H, 4H]
+    or None when the h-recurrence is pre-projected into x_proj."""
     h, c = state
-    gates = x_proj + matmul(h, w_h)
+    gates = x_proj if w_h is None else x_proj + matmul(h, w_h)
     if bias is not None:
         gates = gates + bias
     i, f, g, o = jnp.split(gates, 4, axis=-1)

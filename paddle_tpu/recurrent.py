@@ -76,6 +76,78 @@ class StaticInput:
         self.is_seq = input.is_sequence if is_seq is None else is_seq
 
 
+# ---------------------------------------------------------------------------
+# Shared machinery for step-function hosts (recurrent_group and
+# generation.beam_search both trace a step graph, resolve memory links, and
+# hoist/pin sub-graph params — keep the logic in one place)
+# ---------------------------------------------------------------------------
+
+def make_static_node(group_name: str, item: StaticInput) -> LayerOutput:
+    """Placeholder node a StaticInput is bound to inside the step graph."""
+    return LayerOutput(name=unique_name(f"{group_name}_static"),
+                       layer_type="static_frame", inputs=[], fn=None,
+                       size=item.input.size, is_sequence=item.is_seq)
+
+
+def trace_step(step, frame_args):
+    """Trace the user's step function once; returns (outputs, memories)."""
+    _MEMORY_STACK.append([])
+    try:
+        step_outs = step(*frame_args)
+    finally:
+        memories = _MEMORY_STACK.pop()
+    return step_outs, memories
+
+
+def resolve_memory_links(probe: Topology, memories: Sequence[_Memory],
+                         context: str) -> List[LayerOutput]:
+    """Find each memory's linked step layer in the probe topology (one entry
+    per memory, aligned with ``memories``)."""
+    link_nodes: List[LayerOutput] = []
+    for m in memories:
+        target = probe.by_name.get(m.link_name)
+        if target is None:
+            raise EnforceError(
+                f"memory links to layer {m.link_name!r} which is not in the "
+                f"step graph reachable from its outputs", context=context)
+        link_nodes.append(target)
+    return link_nodes
+
+
+def pin_param_names(sub_topo: Topology) -> Dict[str, ParamSpec]:
+    """Hoist sub-graph params, pinning each spec's canonical name to its sub
+    key so the OUTER param table uses the same key regardless of which group
+    hosts the step — this is what lets a recurrent_group (training) and a
+    beam_search (generation) built from the same step share weights."""
+    import dataclasses as _dc
+
+    group_params: Dict[str, ParamSpec] = {}
+    for key, spec in sub_topo.param_specs().items():
+        if spec.attr.name is None:
+            spec = _dc.replace(spec, attr=_dc.replace(spec.attr, name=key))
+        group_params[key] = spec
+    return group_params
+
+
+def group_state_slots(sub_topo: Topology) -> Dict[str, object]:
+    """Expose sub-layer state (e.g. batch_norm moving stats) as group state
+    slots keyed '<sublayer>/<slot>' so it persists across steps."""
+    return {
+        f"{lname}/{k}": spec
+        for lname, slots in sub_topo.state_specs().items()
+        for k, spec in slots.items()
+    }
+
+
+def read_group_state(ctx: Context, group_name: str, sub_topo: Topology):
+    """Rebuild the sub-topology state dict from the group node's state slots."""
+    init_sub_state = sub_topo.init_state()
+    return {
+        lname: {k: ctx.get_state(group_name, f"{lname}/{k}") for k in slots}
+        for lname, slots in init_sub_state.items()
+    } if init_sub_state else {}
+
+
 def recurrent_group(step, input, reverse: bool = False,
                     name: Optional[str] = None) -> Union[LayerOutput, List[LayerOutput]]:
     """Run ``step`` over the frames of the sequence inputs (reference:
@@ -98,10 +170,7 @@ def recurrent_group(step, input, reverse: bool = False,
 
     for item in inputs:
         if isinstance(item, StaticInput):
-            node = LayerOutput(name=unique_name(f"{name}_static"),
-                               layer_type="static_frame", inputs=[], fn=None,
-                               size=item.input.size,
-                               is_sequence=item.is_seq)
+            node = make_static_node(name, item)
             static_inputs.append(item)
             static_nodes.append(node)
             frame_args.append(node)
@@ -120,46 +189,21 @@ def recurrent_group(step, input, reverse: bool = False,
                  context="recurrent")
 
     # ---- trace the step graph once --------------------------------------
-    _MEMORY_STACK.append([])
-    try:
-        step_outs = step(*frame_args)
-    finally:
-        memories = _MEMORY_STACK.pop()
+    step_outs, memories = trace_step(step, frame_args)
     multi_out = isinstance(step_outs, (list, tuple))
     out_list: List[LayerOutput] = list(step_outs) if multi_out else [step_outs]
 
     sub_outputs = list(out_list)
-    sub_topo_probe = Topology(sub_outputs)
-    # memory link targets must exist in the step graph
-    link_nodes: Dict[str, LayerOutput] = {}
-    for m in memories:
-        target = sub_topo_probe.by_name.get(m.link_name)
-        if target is None:
-            # the linked layer may not be on the path to outputs; search the
-            # step outputs' closure plus memory links transitively — require
-            # the user to return it if truly disjoint
-            raise EnforceError(
-                f"memory links to layer {m.link_name!r} which is not in the "
-                f"step graph reachable from its outputs", context="recurrent")
-        link_nodes[m.link_name] = target
-    sub_topo = Topology(sub_outputs + [link_nodes[m.link_name] for m in memories])
+    link_nodes = resolve_memory_links(Topology(sub_outputs), memories,
+                                      "recurrent")
+    sub_topo = Topology(sub_outputs + link_nodes)
 
     # ---- build the group node in the outer graph ------------------------
     outer_inputs: List[LayerOutput] = (
         list(seq_inputs) + [s.input for s in static_inputs] +
         [m.boot_layer for m in memories if m.boot_layer is not None])
 
-    # Hoist sub-graph params, pinning each spec's canonical name to its sub
-    # key so the OUTER param table uses the same key regardless of which
-    # group hosts the step — this is what lets a recurrent_group (training)
-    # and a beam_search (generation) built from the same step share weights.
-    import dataclasses as _dc
-
-    group_params: Dict[str, ParamSpec] = {}
-    for key, spec in sub_topo.param_specs().items():
-        if spec.attr.name is None:
-            spec = _dc.replace(spec, attr=_dc.replace(spec.attr, name=key))
-        group_params[key] = spec
+    group_params = pin_param_names(sub_topo)
 
     n_seq = len(seq_inputs)
     n_static = len(static_inputs)
@@ -180,20 +224,24 @@ def recurrent_group(step, input, reverse: bool = False,
         T = None
         for sv in seq_vals:
             pd, mk = sv.to_padded()
+            enforce_that(
+                T is None or pd.shape[1] == T,
+                f"recurrent_group sequence inputs disagree on max length "
+                f"({pd.shape[1]} vs {T}); all in-links must share lengths "
+                f"and bucketing (reference requires equal-length in-links)",
+                context="recurrent")
             padded_list.append(pd)
-            mask = mk if mask is None else mask
+            # AND the masks: a frame only runs while EVERY in-link is live,
+            # so differing per-sample lengths never feed padding into a
+            # live step (equal lengths keep this a no-op)
+            mask = mk if mask is None else jnp.logical_and(mask, mk)
             T = pd.shape[1]
         B = first.num_seqs
 
         # stateful sub-layers (batch_norm moving stats) ride the scan carry
         # and propagate outward through the group's own state slots
         group_name = ctx._current or name
-        init_sub_state = sub_topo.init_state()
-        sub_state0 = {
-            lname: {k: ctx.get_state(group_name, f"{lname}/{k}")
-                    for k in slots}
-            for lname, slots in init_sub_state.items()
-        } if init_sub_state else {}
+        sub_state0 = read_group_state(ctx, group_name, sub_topo)
         base_key = ctx.rng_for(group_name)
 
         def frame(carry, xs):
@@ -255,13 +303,7 @@ def recurrent_group(step, input, reverse: bool = False,
                                                      capacity=first.capacity))
         return tuple(results) if multi_out else results[0]
 
-    # expose sub-layer state (e.g. batch_norm moving stats) as group state
-    # slots keyed '<sublayer>/<slot>' so it persists across steps
-    group_state = {
-        f"{lname}/{k}": spec
-        for lname, slots in sub_topo.state_specs().items()
-        for k, spec in slots.items()
-    }
+    group_state = group_state_slots(sub_topo)
 
     group_node = LayerOutput(name=name, layer_type="recurrent_group",
                              inputs=outer_inputs, fn=compute,

@@ -145,7 +145,9 @@ def test_group_trains_with_grad():
 
     grads = jax.grad(loss_fn)(params.as_dict())
     gnorms = {k: float(jnp.linalg.norm(v)) for k, v in grads.items()}
-    # the recurrent fc weights must receive gradient
+    # the RECURRENT fc weights specifically must receive gradient — a broken
+    # scan carry would still give out_fc a gradient from the last frame
     rec_keys = [k for k in gnorms if "h.w" in k or k.endswith("h.w0")]
-    assert any(gnorms[k] > 1e-8 for k in gnorms), gnorms
+    assert rec_keys, gnorms
+    assert any(gnorms[k] > 1e-8 for k in rec_keys), gnorms
     assert all(np.isfinite(list(gnorms.values())))
