@@ -150,9 +150,11 @@ def beam_search(step, input, bos_id: int, eos_id: int, beam_size: int = 5,
                 init_mems[m.node.name] = jnp.zeros((B * K, m.size), jnp.float32)
 
         # trained sub-layer state (batch_norm moving stats) comes in through
-        # the node's state slots — NOT a fresh init_state(), which would
-        # silently normalise with untrained statistics at generation time
-        sub_state = read_group_state(ctx, ctx._current or name, sub_topo)
+        # namespaces keyed by the SUB-LAYER names — shared with the training
+        # recurrent_group built from the same stably-named step, so a
+        # trainer's model_state plugs in directly (not a fresh init_state,
+        # which would normalise with untrained statistics)
+        sub_state = read_group_state(ctx, sub_topo)
         rngkey = ctx.rng_for(ctx._current or name)
 
         init = {
@@ -238,8 +240,8 @@ def beam_search(step, input, bos_id: int, eos_id: int, beam_size: int = 5,
 
     node = LayerOutput(name=name, layer_type="beam_search", inputs=outer_inputs,
                        fn=compute, params=group_params,
-                       state=group_state_slots(sub_topo), size=max_length,
-                       is_sequence=False)
+                       foreign_state=group_state_slots(sub_topo),
+                       size=max_length, is_sequence=False)
     node.beam_size = beam_size
     node.max_length = max_length
     return node

@@ -23,7 +23,15 @@ class Inference:
         outputs = [output_layer] if isinstance(output_layer, LayerOutput) else list(output_layer)
         self.topology = Topology(outputs)
         self.parameters = parameters
-        self.model_state = model_state if model_state is not None else self.topology.init_state()
+        # merge the caller's (possibly larger, training-topology) state over
+        # init defaults: shared namespaces get trained values, anything the
+        # inference graph needs but the caller lacks falls back to init
+        init = self.topology.init_state()
+        if model_state is not None:
+            for ns in init:
+                if ns in model_state:
+                    init[ns] = {**init[ns], **model_state[ns]}
+        self.model_state = init
         self._fn = jax.jit(self._forward)
 
     def _forward(self, params, state, feeds):

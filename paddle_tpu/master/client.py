@@ -1,0 +1,159 @@
+"""Trainer-side master client (reference:
+python/paddle/v2/master/client.py:15-80 over go/master/c/client.go).
+
+``MasterClient(None)`` runs against an in-process Service (the
+inmem_store analog used throughout the reference's tests); passing an
+``"host:port"`` string talks to a MasterServer (Python or C++) over TCP.
+
+``next_record()`` drives the task lifecycle: fetch a task, stream its
+chunks from local recordio files, report task_finished, and return None
+at end of pass.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import List, Optional
+
+from .recordio import recordio_read_chunk
+from .service import Service
+from .server import send_msg, recv_msg
+
+
+class _InprocTransport:
+    def __init__(self, service: Optional[Service] = None):
+        self.service = service or Service()
+
+    def call(self, method: str, **params):
+        svc = self.service
+        if method == "set_dataset":
+            return svc.set_dataset(params["paths"])
+        if method == "get_task":
+            t = svc.get_task()
+            if t is None:
+                return None
+            return {"id": t.id, "epoch": t.epoch,
+                    "chunks": [{"path": c.path, "offset": c.offset,
+                                "count": c.count} for c in t.chunks]}
+        if method == "task_finished":
+            return svc.task_finished(params["task_id"])
+        if method == "task_failed":
+            return svc.task_failed(params["task_id"])
+        if method == "all_done":
+            return svc.all_done()
+        if method == "new_pass":
+            svc.new_pass()
+            return True
+        if method == "request_save_model":
+            return svc.request_save_model(params.get("block_s", 60.0))
+        raise ValueError(method)
+
+
+class _TcpTransport:
+    def __init__(self, addr: str, timeout_s: float = 30.0):
+        host, port = addr.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout_s)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def call(self, method: str, **params):
+        send_msg(self._sock, {"method": method, "params": params})
+        resp = recv_msg(self._sock)
+        if resp is None:
+            raise ConnectionError("master connection closed")
+        if not resp.get("ok"):
+            raise RuntimeError(f"master error: {resp.get('error')}")
+        return resp.get("result")
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class MasterClient:
+    def __init__(self, addr: Optional[str] = None,
+                 service: Optional[Service] = None,
+                 poll_interval_s: float = 0.05):
+        if addr:
+            self._t = _TcpTransport(addr)
+        else:
+            self._t = _InprocTransport(service)
+        self._poll = poll_interval_s
+        self._records: List[bytes] = []
+        self._task_id: Optional[int] = None
+
+    # -- dataset / records ---------------------------------------------------
+
+    def set_dataset(self, paths) -> int:
+        if isinstance(paths, str):
+            paths = paths.split(",")
+        return self._t.call("set_dataset", paths=list(paths))
+
+    def next_record(self) -> Optional[bytes]:
+        """Next record of the current pass, or None when the pass is done."""
+        while not self._records:
+            if not self._fetch_task():
+                return None
+        return self._records.pop(0)
+
+    def task_failed(self) -> None:
+        """Report the in-flight task failed (fault-injection / error paths)."""
+        if self._task_id is not None:
+            self._t.call("task_failed", task_id=self._task_id)
+            self._task_id = None
+            self._records = []
+
+    # -- pass control --------------------------------------------------------
+
+    def begin_pass(self) -> None:
+        """Recycle the task queue if the previous pass fully completed.
+        Safe under multiple trainers: new_pass only fires when todo and
+        pending are both empty, so exactly one epoch boundary happens."""
+        if self._t.call("all_done"):
+            self._t.call("new_pass")
+
+    def new_pass(self) -> None:
+        self._t.call("new_pass")
+
+    def request_save_model(self, block_s: float = 60.0) -> bool:
+        return self._t.call("request_save_model", block_s=block_s)
+
+    def close(self) -> None:
+        # release an in-flight task immediately rather than letting its
+        # lease time out and re-serve already-consumed records
+        try:
+            self.task_failed()
+        except (ConnectionError, RuntimeError, OSError):
+            pass
+        if hasattr(self._t, "close"):
+            self._t.close()
+
+    # -- internals -----------------------------------------------------------
+
+    def _fetch_task(self) -> bool:
+        """Load the next task's records. False at end of pass."""
+        if self._task_id is not None:
+            self._t.call("task_finished", task_id=self._task_id)
+            self._task_id = None
+        while True:
+            task = self._t.call("get_task")
+            if task is not None:
+                break
+            if self._t.call("all_done"):
+                return False
+            time.sleep(self._poll)  # other workers hold pending tasks
+        recs: List[bytes] = []
+        try:
+            for c in task["chunks"]:
+                got = recordio_read_chunk(c["path"], c["offset"], c["count"])
+                recs.extend(g if isinstance(g, bytes) else bytes(g)
+                            for g in got)
+        except OSError:
+            self._t.call("task_failed", task_id=task["id"])
+            return True  # try another task
+        self._task_id = task["id"]
+        self._records = recs
+        return True

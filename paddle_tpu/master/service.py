@@ -1,0 +1,238 @@
+"""Task-queue service: the Go master's state machine in Python.
+
+Mirrors go/master/service.go —
+  - dataset partitioning into chunk tasks         (service.go:106)
+  - todo/pending/done queues with timeout requeue (service.go:313-356)
+  - per-task failure count and discard            (service.go:368-448)
+  - state snapshot persisted on every mutation    (service.go:207,
+    etcd_client.go:96-129 — here a JSON file written atomically)
+  - RequestSaveModel dedup so only one trainer
+    saves the model at a time                     (service.go:474)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field, asdict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .recordio import recordio_index
+
+MAX_TASK_FAILURES = 3
+
+
+@dataclass
+class Chunk:
+    path: str
+    offset: int
+    count: int
+
+
+@dataclass
+class Task:
+    id: int
+    epoch: int = 0
+    num_failures: int = 0
+    chunks: List[Chunk] = field(default_factory=list)
+
+
+class Service:
+    """In-memory task queue with optional file snapshot.
+
+    ``time_fn`` is injectable for deterministic timeout tests (the Go
+    tests drive timeouts the same way via internal hooks,
+    service_internal_test.go).
+    """
+
+    def __init__(self, chunks_per_task: int = 8, timeout_s: float = 60.0,
+                 max_failures: int = MAX_TASK_FAILURES,
+                 snapshot_path: Optional[str] = None, time_fn=time.time):
+        self.chunks_per_task = max(1, int(chunks_per_task))
+        self.timeout_s = timeout_s
+        self.max_failures = max_failures
+        self.snapshot_path = snapshot_path
+        self._time = time_fn
+        self._lock = threading.RLock()
+
+        self._todo: List[Task] = []
+        # task id -> (task, deadline)
+        self._pending: Dict[int, Tuple[Task, float]] = {}
+        self._done: List[Task] = []
+        self._dataset_set = False
+        self._dataset_paths: List[str] = []
+        self._next_id = 0
+        self._pass_no = 0
+        # save-model dedup: time until which save requests are "taken"
+        self._save_until = 0.0
+
+        if snapshot_path and os.path.exists(snapshot_path):
+            self._recover(snapshot_path)
+
+    # ---- dataset -----------------------------------------------------------
+
+    def set_dataset(self, paths: Sequence[str]) -> int:
+        """Partition recordio files into chunk tasks. Idempotent: only the
+        first caller's dataset wins (service.go SetDataset does the same so
+        N trainers can race to init)."""
+        with self._lock:
+            paths = list(paths)
+            if self._dataset_set:
+                if paths == self._dataset_paths:
+                    return len(self._todo)
+                # different dataset than the (possibly recovered) state:
+                # re-partition from scratch rather than serving stale chunks
+                self._todo, self._pending, self._done = [], {}, []
+                self._next_id = 0
+                self._pass_no = 0
+            tasks: List[Task] = []
+            for path in paths:
+                offsets = recordio_index(path)
+                i = 0
+                while i < len(offsets):
+                    n = min(self.chunks_per_task, len(offsets) - i)
+                    tasks.append(Task(id=self._next_id, chunks=[
+                        Chunk(path=path, offset=offsets[i], count=n)]))
+                    self._next_id += 1
+                    i += n
+            self._todo = tasks
+            self._dataset_set = True
+            self._dataset_paths = paths
+            self._snapshot()
+            return len(tasks)
+
+    # ---- task lifecycle ----------------------------------------------------
+
+    def get_task(self) -> Optional[Task]:
+        """Pop a todo task into pending (with deadline). Returns None when
+        nothing is available right now — caller should retry or treat an
+        all-done pass as end-of-data (see all_done)."""
+        with self._lock:
+            self._check_timeouts()
+            if not self._todo:
+                return None
+            task = self._todo.pop(0)
+            self._pending[task.id] = (task, self._time() + self.timeout_s)
+            self._snapshot()
+            return task
+
+    def task_finished(self, task_id: int) -> bool:
+        with self._lock:
+            ent = self._pending.pop(task_id, None)
+            if ent is None:
+                return False
+            task = ent[0]
+            task.num_failures = 0
+            self._done.append(task)
+            self._maybe_new_pass()
+            self._snapshot()
+            return True
+
+    def task_failed(self, task_id: int) -> bool:
+        """Requeue a failed task, or discard it past the failure cap
+        (service.go:448 discards and counts it done)."""
+        with self._lock:
+            ent = self._pending.pop(task_id, None)
+            if ent is None:
+                return False
+            task = ent[0]
+            task.num_failures += 1
+            if task.num_failures >= self.max_failures:
+                self._done.append(task)
+                self._maybe_new_pass()
+            else:
+                self._todo.append(task)
+            self._snapshot()
+            return True
+
+    def all_done(self) -> bool:
+        """True when the current pass has been fully consumed."""
+        with self._lock:
+            self._check_timeouts()
+            return self._dataset_set and not self._todo and not self._pending
+
+    def new_pass(self) -> None:
+        """Recycle done tasks into todo for the next epoch."""
+        with self._lock:
+            self._start_new_pass()
+            self._snapshot()
+
+    # ---- save-model dedup --------------------------------------------------
+
+    def request_save_model(self, block_s: float) -> bool:
+        """First trainer to ask within a window gets True (service.go:474)."""
+        with self._lock:
+            now = self._time()
+            if now < self._save_until:
+                return False
+            self._save_until = now + block_s
+            return True
+
+    # ---- internals ---------------------------------------------------------
+
+    def _check_timeouts(self) -> None:
+        now = self._time()
+        expired = [tid for tid, (_, dl) in self._pending.items() if dl <= now]
+        for tid in expired:
+            task, _ = self._pending.pop(tid)
+            task.num_failures += 1
+            if task.num_failures >= self.max_failures:
+                self._done.append(task)
+                self._maybe_new_pass()
+            else:
+                self._todo.append(task)
+        if expired:
+            self._snapshot()
+
+    def _maybe_new_pass(self) -> None:
+        if self._dataset_set and not self._todo and not self._pending:
+            # pass complete; tasks stay in done until new_pass() recycles
+            self._pass_no += 1
+
+    def _start_new_pass(self) -> None:
+        for t in self._done:
+            t.epoch += 1
+            t.num_failures = 0
+        self._todo.extend(self._done)
+        self._done = []
+
+    # ---- snapshot / recover ------------------------------------------------
+
+    def _state(self) -> dict:
+        return {
+            "todo": [asdict(t) for t in self._todo],
+            "pending": [asdict(t) for t, _ in self._pending.values()],
+            "done": [asdict(t) for t in self._done],
+            "dataset_set": self._dataset_set,
+            "dataset_paths": self._dataset_paths,
+            "next_id": self._next_id,
+            "pass_no": self._pass_no,
+        }
+
+    def _snapshot(self) -> None:
+        if not self.snapshot_path:
+            return
+        tmp = self.snapshot_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._state(), f)
+        os.replace(tmp, self.snapshot_path)
+
+    def _recover(self, path: str) -> None:
+        with open(path) as f:
+            st = json.load(f)
+
+        def mk(d):
+            return Task(id=d["id"], epoch=d["epoch"],
+                        num_failures=d["num_failures"],
+                        chunks=[Chunk(**c) for c in d["chunks"]])
+
+        # pending tasks at crash time go back to todo (the Go master does
+        # the same on snapshot recovery: leases died with the process)
+        self._todo = [mk(d) for d in st["todo"]] + [mk(d) for d in st["pending"]]
+        self._done = [mk(d) for d in st["done"]]
+        self._dataset_set = st["dataset_set"]
+        self._dataset_paths = st.get("dataset_paths", [])
+        self._next_id = st["next_id"]
+        self._pass_no = st["pass_no"]

@@ -64,11 +64,15 @@ def cloud_reader(paths, etcd_endpoints=None, timeout_sec: int = 5,
         from paddle_tpu.master import MasterClient
 
         client = MasterClient(etcd_endpoints)
-        client.set_dataset(paths)
-        while True:
-            rec = client.next_record()
-            if rec is None:
-                break
-            yield rec
+        try:
+            client.set_dataset(paths)
+            client.begin_pass()  # recycle tasks if a prior pass finished
+            while True:
+                rec = client.next_record()
+                if rec is None:
+                    break
+                yield rec
+        finally:
+            client.close()
 
     return reader

@@ -129,23 +129,26 @@ def pin_param_names(sub_topo: Topology) -> Dict[str, ParamSpec]:
     return group_params
 
 
-def group_state_slots(sub_topo: Topology) -> Dict[str, object]:
-    """Expose sub-layer state (e.g. batch_norm moving stats) as group state
-    slots keyed '<sublayer>/<slot>' so it persists across steps."""
+def group_state_slots(sub_topo: Topology) -> Dict[str, Dict[str, object]]:
+    """Sub-layer state (e.g. batch_norm moving stats) exposed under the
+    SUB-LAYER names themselves (LayerOutput.foreign_state), so a training
+    group and a generation host built from the same (stably-named) step
+    read and write the same slots — the state analog of pin_param_names."""
+    return sub_topo.state_specs()
+
+
+def read_group_state(ctx: Context, sub_topo: Topology):
+    """Rebuild the sub-topology state dict from the shared namespaces."""
     return {
-        f"{lname}/{k}": spec
+        lname: {k: ctx.get_state(lname, k) for k in slots}
         for lname, slots in sub_topo.state_specs().items()
-        for k, spec in slots.items()
     }
 
 
-def read_group_state(ctx: Context, group_name: str, sub_topo: Topology):
-    """Rebuild the sub-topology state dict from the group node's state slots."""
-    init_sub_state = sub_topo.init_state()
-    return {
-        lname: {k: ctx.get_state(group_name, f"{lname}/{k}") for k in slots}
-        for lname, slots in init_sub_state.items()
-    } if init_sub_state else {}
+def write_group_state(ctx: Context, sub_state) -> None:
+    for lname, slots in (sub_state or {}).items():
+        for k, v in slots.items():
+            ctx.set_state(lname, k, v)
 
 
 def recurrent_group(step, input, reverse: bool = False,
@@ -239,9 +242,9 @@ def recurrent_group(step, input, reverse: bool = False,
         B = first.num_seqs
 
         # stateful sub-layers (batch_norm moving stats) ride the scan carry
-        # and propagate outward through the group's own state slots
+        # and propagate outward through namespaces shared by sub-layer name
         group_name = ctx._current or name
-        sub_state0 = read_group_state(ctx, group_name, sub_topo)
+        sub_state0 = read_group_state(ctx, sub_topo)
         base_key = ctx.rng_for(group_name)
 
         def frame(carry, xs):
@@ -292,22 +295,24 @@ def recurrent_group(step, input, reverse: bool = False,
               jnp.arange(T, dtype=jnp.int32))
         (_, final_sstate), ys = jax.lax.scan(frame, (init_mems, sub_state0),
                                              xs, reverse=reverse)
-        for lname, slots in (final_sstate or {}).items():
-            for k, v in slots.items():
-                ctx.set_state(group_name, f"{lname}/{k}", v)
+        write_group_state(ctx, final_sstate)
+        # A frame is a real output only while EVERY in-link was live; with
+        # unequal per-sample lengths the extra frames ran on padding, so
+        # zero them and report the combined (elementwise-min) lengths.
+        out_lengths = jnp.sum(mask.astype(first.lengths.dtype), axis=1)
         # ys: tuple of [T, B, D] -> SequenceBatch each
         results = []
         for y in ys:
             y = jnp.swapaxes(y, 0, 1)  # [B, T, D]
-            results.append(SequenceBatch.from_padded(y, first.lengths,
+            y = jnp.where(mask[:, :, None], y, 0)
+            results.append(SequenceBatch.from_padded(y, out_lengths,
                                                      capacity=first.capacity))
         return tuple(results) if multi_out else results[0]
 
-    group_state = group_state_slots(sub_topo)
-
     group_node = LayerOutput(name=name, layer_type="recurrent_group",
                              inputs=outer_inputs, fn=compute,
-                             params=group_params, state=group_state,
+                             params=group_params,
+                             foreign_state=group_state_slots(sub_topo),
                              size=out_list[0].size,
                              is_sequence=True)
 

@@ -95,6 +95,12 @@ class SequenceBatch:
         take = order[:cap]
         flat = padded.reshape((B * T,) + padded.shape[2:])[take]
         seg = jnp.where(valid_full[take], seg_full[take], B).astype(jnp.int32)
+        if cap > B * T:  # pad out to the requested static capacity
+            extra = cap - B * T
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((extra,) + flat.shape[1:], flat.dtype)])
+            seg = jnp.concatenate(
+                [seg, jnp.full((extra,), B, jnp.int32)])
         data = jnp.where(
             (seg < B).reshape((-1,) + (1,) * (flat.ndim - 1)), flat, 0)
         return SequenceBatch(data=data, segment_ids=seg, lengths=lengths,

@@ -102,6 +102,12 @@ class LayerOutput:
     fn: Callable[[Context, Dict[str, jax.Array], List[Any]], Any]
     params: Dict[str, ParamSpec] = field(default_factory=dict)
     state: Dict[str, StateSpec] = field(default_factory=dict)
+    # State slots this node manages under OTHER namespaces (sub-layer names
+    # of a hosted step graph). Keyed namespace -> slot -> spec. Lets a
+    # training recurrent_group and a beam_search generator built from the
+    # same step SHARE stateful slots (batch-norm moving stats) the same way
+    # pinned param names share weights.
+    foreign_state: Dict[str, Dict[str, StateSpec]] = field(default_factory=dict)
     size: Optional[int] = None          # feature dimension, v2-API compatible
     is_sequence: bool = False           # value is a SequenceBatch
     is_cost: bool = False               # per-example loss output
@@ -187,7 +193,21 @@ class Topology:
         return spec.attr.name or f"{node.name}.{pname}"
 
     def state_specs(self) -> Dict[str, Dict[str, StateSpec]]:
-        return {n.name: dict(n.state) for n in self.nodes if n.state}
+        out: Dict[str, Dict[str, StateSpec]] = {}
+        for n in self.nodes:
+            if n.state:
+                out.setdefault(n.name, {}).update(n.state)
+            for ns, slots in n.foreign_state.items():
+                have = out.setdefault(ns, {})
+                for k, spec in slots.items():
+                    if k in have:
+                        enforce_that(
+                            tuple(have[k].shape) == tuple(spec.shape),
+                            f"shared state slot {ns}/{k} shape mismatch "
+                            f"{have[k].shape} vs {spec.shape}", context="topology")
+                    else:
+                        have[k] = spec
+        return out
 
     def init_state(self) -> Dict[str, Dict[str, jax.Array]]:
         out: Dict[str, Dict[str, jax.Array]] = {}
@@ -220,7 +240,10 @@ class Topology:
             ctx._current = node.name
             values[node.name] = node.fn(ctx, node_params, ins)
         new_state = dict(state)
-        new_state.update(ctx.state_out)
+        for ns, slots in ctx.state_out.items():
+            # per-slot merge: a node updating one slot must not drop the
+            # namespace's other slots
+            new_state[ns] = {**new_state.get(ns, {}), **slots}
         return [values[w.name] for w in wanted], new_state
 
     def __repr__(self):

@@ -151,3 +151,71 @@ def test_group_trains_with_grad():
     assert rec_keys, gnorms
     assert any(gnorms[k] > 1e-8 for k in rec_keys), gnorms
     assert all(np.isfinite(list(gnorms.values())))
+
+
+def test_group_state_shared_with_generation_host():
+    """Batch-norm moving stats learned inside a training recurrent_group must
+    flow into an inference host built from the same stably-named step (the
+    state analog of pinned param names)."""
+    paddle.topology.reset_name_scope()
+    D = 4
+
+    def make_step():
+        def step(frame):
+            h = layer.fc(input=frame, size=D, act="linear", name="gs_fc",
+                         param_attr=ParamAttr(name="gs_w"), bias_attr=False)
+            return layer.batch_norm(input=h, name="gs_bn")
+        return step
+
+    x = layer.data(name="gx", type=paddle.data_type.dense_vector_sequence(D))
+    lab = layer.data(name="glab",
+                     type=paddle.data_type.dense_vector_sequence(D))
+    out = layer.recurrent_group(step=make_step(), input=x, name="train_grp")
+    cost = layer.square_error_cost(input=out, label=lab, name="gs_cost")
+
+    topo = paddle.topology.Topology([cost])
+    params = paddle.Parameters.from_topology(topo, seed=0)
+    state = topo.init_state()
+    assert "gs_bn" in state, "bn state must live under the sub-layer name"
+
+    feeds = {"gx": _seq_feed(D, [3, 5], seed=1),
+             "glab": _seq_feed(D, [3, 5], seed=2)}
+    _, state = topo.forward(params.as_dict(), state, feeds, train=True)
+    moved = np.asarray(state["gs_bn"]["moving_mean"])
+    assert np.abs(moved).sum() > 0, "training did not update moving stats"
+
+    # fresh trace of the same step hosted by a new group (generation-style)
+    x2 = layer.data(name="gx2", type=paddle.data_type.dense_vector_sequence(D))
+    gen_out = layer.recurrent_group(step=make_step(), input=x2, name="gen_grp")
+    inf = paddle.inference.Inference(gen_out, params, model_state=state)
+    assert np.allclose(np.asarray(inf.model_state["gs_bn"]["moving_mean"]),
+                       moved), "trained stats must reach the generation host"
+    got = inf._fn(params.as_dict(), inf.model_state,
+                  {"gx2": _seq_feed(D, [4], seed=3)})
+    assert np.all(np.isfinite(np.asarray(got[0].data)))
+
+
+def test_group_unequal_inlink_lengths_masked():
+    """Frames past a sample's shortest in-link must be zeroed and excluded
+    from the output lengths (combined-mask semantics)."""
+    paddle.topology.reset_name_scope()
+    D = 3
+    a = layer.data(name="ua", type=paddle.data_type.dense_vector_sequence(D))
+    b = layer.data(name="ub", type=paddle.data_type.dense_vector_sequence(D))
+
+    def step(fa, fb):
+        return layer.addto(input=[fa, fb], name="u_add")
+
+    out = layer.recurrent_group(step=step, input=[a, b], name="u_grp")
+    topo = paddle.topology.Topology([out])
+    params = paddle.Parameters.from_topology(topo, seed=0)
+
+    fa = _seq_feed(D, [5, 2], seed=4)
+    fb = _seq_feed(D, [3, 5], seed=5)
+    (res,), _ = topo.forward(params.as_dict(), topo.init_state(),
+                             {"ua": fa, "ub": fb})
+    lens = np.asarray(res.lengths)
+    assert list(lens[:2]) == [3, 2], f"combined lengths wrong: {lens}"
+    padded, _ = res.to_padded()
+    padded = np.asarray(padded)
+    assert np.all(padded[0, 3:] == 0) and np.all(padded[1, 2:] == 0)
