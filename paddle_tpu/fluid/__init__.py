@@ -1,0 +1,26 @@
+"""paddle_tpu.fluid — the Gen-2 "Fluid prototype" analog, TPU-native.
+
+Reference: paddle/framework (ProgramDesc/Scope/Operator/Executor/autodiff),
+paddle/operators (76 op families), python/paddle/v2/framework (graph builder,
+Executor, layers, optimizer) — see SURVEY.md §2.2.
+
+Design: Program/Block/Operator IR built in python; ``append_backward`` is a
+program transform adding grad ops; ``Executor`` traces the whole program into
+one jit-compiled XLA function (grad ops via jax.vjp of the forward computes).
+"""
+
+from paddle_tpu.fluid import backward, layers, optimizer, ops
+from paddle_tpu.fluid.backward import append_backward
+from paddle_tpu.fluid.executor import Executor, Scope, global_scope
+from paddle_tpu.fluid.framework import (Block, Operator, Parameter, Program,
+                                        Variable, default_main_program,
+                                        grad_name, program_guard,
+                                        reset_default_program)
+from paddle_tpu.fluid.ops import LoDArray, registered_ops
+
+__all__ = [
+    "backward", "layers", "optimizer", "ops", "append_backward",
+    "Executor", "Scope", "global_scope", "Block", "Operator", "Parameter",
+    "Program", "Variable", "default_main_program", "grad_name",
+    "program_guard", "reset_default_program", "LoDArray", "registered_ops",
+]

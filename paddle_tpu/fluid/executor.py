@@ -1,0 +1,287 @@
+"""Fluid-analog Executor: traces a Program into ONE jitted XLA function.
+
+Reference analog: paddle/framework/executor.cc:59-88 (create vars,
+instantiate each OpDesc, run sequentially — an interpreter) and
+python/paddle/v2/framework/executor.py (feed/fetch injection).
+
+TPU-native design: instead of interpreting one op at a time, ``Executor.run``
+traces the whole op list into a pure jax function of
+``(persistable_values, feed_values, rng) -> (fetches, updated_persistables)``
+and jit-compiles it, cached by (program fingerprint, feed shapes/lods). XLA
+then fuses across op boundaries — the per-op dispatch the reference pays at
+every step happens here exactly once per program/shape bucket.
+
+Grad ops (backward.py) are executed with ``jax.vjp`` of the recorded forward
+op applications; gradient fan-in is summed here (the reference emits add ops).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.fluid import ops as op_lib
+from paddle_tpu.fluid.framework import (Parameter, Program, Variable,
+                                        default_main_program, grad_name)
+from paddle_tpu.fluid.ops import ComputeCtx, LoDArray
+from paddle_tpu.platform.enforce import EnforceError, enforce_that
+
+# LoDArray must be a pytree so jax.vjp/jit can see through it.
+jax.tree_util.register_pytree_node(
+    LoDArray,
+    lambda la: ((la.data,), la.lod),
+    lambda lod, children: LoDArray(children[0], lod))
+
+
+class Scope:
+    """Persistable variable store (framework/scope.h analog, flat)."""
+
+    def __init__(self):
+        self.values: Dict[str, Any] = {}
+
+    def find_var(self, name: str):
+        return self.values.get(name)
+
+    def set_var(self, name: str, value) -> None:
+        self.values[name] = value
+
+    def var_names(self) -> List[str]:
+        return sorted(self.values)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+def _init_value(var: Parameter, seed: int) -> np.ndarray:
+    """Materialise a parameter initializer (initializer.py analog)."""
+    rng = np.random.RandomState(
+        (seed * 2654435761 + hash(var.name)) % (2 ** 31))
+    init = var.initializer or {"type": "xavier"}
+    kind = init.get("type", "xavier")
+    shape = var.shape
+    if kind == "constant":
+        out = np.full(shape, init.get("value", 0.0))
+    elif kind == "uniform":
+        low, high = init.get("low", -1.0), init.get("high", 1.0)
+        out = rng.uniform(low, high, size=shape)
+    elif kind == "normal":
+        out = rng.normal(init.get("mean", 0.0), init.get("std", 1.0),
+                         size=shape)
+    elif kind == "xavier":
+        fan_in = shape[0] if len(shape) else 1
+        fan_out = shape[1] if len(shape) > 1 else fan_in
+        if len(shape) == 4:  # OIHW conv filter
+            rf = shape[2] * shape[3]
+            fan_in, fan_out = shape[1] * rf, shape[0] * rf
+        limit = float(np.sqrt(6.0 / (fan_in + fan_out)))
+        out = rng.uniform(-limit, limit, size=shape)
+    else:
+        raise EnforceError(f"unknown initializer {kind!r}", context="fluid")
+    return out.astype(var.dtype)
+
+
+def _feed_to_value(v):
+    if isinstance(v, LoDArray):
+        return v
+    if isinstance(v, tuple) and len(v) == 2:
+        data, lod = v
+        return LoDArray(np.asarray(data),
+                        tuple(tuple(int(o) for o in lvl) for lvl in lod))
+    return np.asarray(v)
+
+
+def _abstract(v):
+    if isinstance(v, LoDArray):
+        return ("lod", v.lod, v.data.shape, str(v.data.dtype))
+    a = np.asarray(v) if not hasattr(v, "shape") else v
+    return (a.shape, str(a.dtype))
+
+
+class Executor:
+    """Runs Programs. ``place`` is accepted for API parity but jax device
+    placement is global (paddle_tpu.platform)."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: Dict[Tuple, Any] = {}
+        self._step = 0  # default rng stream advances per run
+
+    # ------------------------------------------------------------------
+    def run(self, program: Optional[Program] = None, feed: Dict = None,
+            fetch_list: Sequence = (), scope: Optional[Scope] = None,
+            is_test: bool = False, seed: Optional[int] = None,
+            return_numpy: bool = True):
+        program = program or default_main_program()
+        scope = scope or _global_scope
+        feed = {k: _feed_to_value(v) for k, v in (feed or {}).items()}
+        fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                       for f in fetch_list]
+
+        self._materialize_params(program, scope)
+        persist_names = self._persistable_names(program, scope)
+        persist_vals = {n: scope.values[n] for n in persist_names}
+
+        key = (program.fingerprint(), is_test, tuple(fetch_names),
+               tuple(sorted((k, _abstract(v)) for k, v in feed.items())))
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = self._compile(program, fetch_names, is_test,
+                               sorted(feed), persist_names)
+            self._cache[key] = fn
+
+        rng = jax.random.PRNGKey(self._step if seed is None else seed)
+        self._step += 1
+        fetches, updates = fn(persist_vals, feed, rng)
+        for n, v in updates.items():
+            scope.values[n] = v
+        if return_numpy:
+            fetches = [np.asarray(f.data) if isinstance(f, LoDArray)
+                       else np.asarray(f) for f in fetches]
+        return fetches
+
+    # ------------------------------------------------------------------
+    def _materialize_params(self, program: Program, scope: Scope) -> None:
+        for var in program.global_block().vars.values():
+            if var.persistable and var.name not in scope.values:
+                if isinstance(var, Parameter):
+                    scope.values[var.name] = _init_value(
+                        var, program.random_seed)
+                elif var.initializer is not None:
+                    scope.values[var.name] = _init_value(
+                        var, program.random_seed)  # typed init spec
+                elif all(s > 0 for s in var.shape):
+                    scope.values[var.name] = np.zeros(var.shape, var.dtype)
+
+    def _persistable_names(self, program: Program, scope: Scope) -> List[str]:
+        names = []
+        for var in program.global_block().vars.values():
+            if var.persistable and var.name in scope.values:
+                names.append(var.name)
+        return sorted(names)
+
+    # ------------------------------------------------------------------
+    def _compile(self, program: Program, fetch_names, is_test,
+                 feed_names, persist_names):
+        block = program.global_block()
+        written_persist = [
+            n for n in persist_names
+            if any(n in op.output_names() for op in block.ops)]
+
+        def run_program(persist_vals, feed_vals, rng):
+            values: Dict[str, Any] = {}
+            values.update(persist_vals)
+            values.update(feed_vals)
+            ctx = ComputeCtx(rng, is_test)
+            # record each forward op's actual inputs so grad ops and
+            # aliased (in-place persistable) writes can't disagree
+            recorded: Dict[int, Dict[str, List[Any]]] = {}
+
+            def trace_block(sub_idx: int, env: Dict[str, Any]):
+                sub = program.blocks[sub_idx]
+                local = dict(env)
+
+                def look(name):
+                    return local[name] if name in local else values[name]
+
+                for sop in sub.ops:
+                    sins = {slot: [look(n) for n in ns]
+                            for slot, ns in sop.inputs.items()}
+                    souts = op_lib.get(sop.type).compute(
+                        sins, dict(sop.attrs), ctx)
+                    for slot, ns in sop.outputs.items():
+                        for n, v in zip(ns, souts.get(slot, [])):
+                            local[n] = v
+                return local
+
+            ctx.trace_block = trace_block
+
+            for pos, op in enumerate(block.ops):
+                if op.type.endswith("_grad"):
+                    self._run_grad_op(op, block, values, recorded, ctx)
+                    continue
+                info = op_lib.get(op.type)
+                attrs = dict(op.attrs)
+                if info.uses_rng:
+                    attrs.setdefault("_rng_salt", pos)
+                ins = {slot: [values[n] for n in ns]
+                       for slot, ns in op.inputs.items()}
+                recorded[pos] = (ins, attrs)
+                outs = info.compute(ins, attrs, ctx)
+                for slot, ns in op.outputs.items():
+                    vs = outs.get(slot, [])
+                    enforce_that(len(vs) >= len(ns),
+                                 f"op {op.type} slot {slot} produced "
+                                 f"{len(vs)} values for {len(ns)} names",
+                                 context="fluid")
+                    for n, v in zip(ns, vs):
+                        values[n] = v
+
+            fetches = [values[n] for n in fetch_names]
+            updates = {n: values[n] for n in written_persist}
+            return fetches, updates
+
+        return jax.jit(run_program)
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _run_grad_op(op, block, values, recorded, ctx):
+        fwd = block.ops[int(op.attrs["fwd_idx"])]
+        info = op_lib.get(fwd.type)
+        ins, attrs = recorded[int(op.attrs["fwd_idx"])]
+
+        def f(ins_):
+            return info.compute(ins_, attrs, ctx)
+
+        primal_out, vjp_fn = jax.vjp(f, ins)
+
+        # cotangent: grad value where present, zeros elsewhere
+        def cot_for(name, template):
+            t = template.data if isinstance(template, LoDArray) else template
+            gname = grad_name(name)
+            if gname in values:
+                g = values[gname]
+                g = g.data if isinstance(g, LoDArray) else g
+                g = jnp.reshape(g, t.shape) if g.size == t.size else \
+                    jnp.broadcast_to(g, t.shape)
+            else:
+                g = jnp.zeros_like(t)
+            if isinstance(template, LoDArray):
+                return LoDArray(g, template.lod)
+            return g
+
+        cot = {}
+        for slot, ns in fwd.outputs.items():
+            outs = primal_out.get(slot, [])
+            cot[slot] = [cot_for(n, o) for n, o in zip(ns, outs)]
+        for slot, outs in primal_out.items():
+            if slot not in cot:
+                cot[slot] = [jax.tree.map(jnp.zeros_like, o) for o in outs]
+            # outputs the op produced beyond the named ones
+            elif len(cot[slot]) < len(outs):
+                cot[slot].extend(jax.tree.map(jnp.zeros_like, extra)
+                                 for extra in outs[len(cot[slot]):])
+
+        (gins,) = vjp_fn(cot)
+
+        wanted = set(op.output("InGrad"))
+        for slot, ns in fwd.inputs.items():
+            for n, g in zip(ns, gins.get(slot, [])):
+                gname = grad_name(n)
+                if gname not in wanted:
+                    continue
+                gd = g.data if isinstance(g, LoDArray) else g
+                if gd is None or (hasattr(gd, "dtype")
+                                  and gd.dtype == jax.dtypes.float0):
+                    continue
+                if gname in values:
+                    prev = values[gname]
+                    pd = prev.data if isinstance(prev, LoDArray) else prev
+                    gd = pd + gd
+                values[gname] = gd

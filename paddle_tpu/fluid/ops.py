@@ -1,0 +1,1064 @@
+"""Fluid-analog operator library: the registry + pure-jax compute kernels.
+
+Reference analog: paddle/operators/ (76 op families, each a CPU .cc + GPU .cu
+kernel pair registered via REGISTER_OP*, framework/op_registry.h) and
+paddle/operators/math (shared kernel lib).
+
+TPU-native design: ONE implementation per op, written in jax, traced by the
+Executor into a single XLA program — there is no CPU/GPU kernel split (XLA
+targets every backend) and no hand-written gradient kernels (grad ops are
+computed with ``jax.vjp`` of the forward compute; see backward.py/executor.py,
+replacing the reference's per-op grad kernels and GradOpDescMaker).
+
+``compute(ins, attrs, ctx)`` takes a dict slot -> list of values and returns
+a dict slot -> list of values. Values are ``jax.Array`` or ``LoDArray``
+(ragged sequence batch; lod_tensor.h:57-80 analog).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from paddle_tpu.platform.enforce import EnforceError, enforce_that
+
+# ---------------------------------------------------------------------------
+# LoDArray: the LoDTensor analog flowing through fluid programs
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class LoDArray:
+    """Dense data + level-of-detail ragged boundaries.
+
+    ``lod`` is a list of levels, each a python list of monotonically
+    increasing offsets (lod_tensor.h:57: LoD = vector<Vector<size_t>>).
+    Offsets are static per trace — ragged structure is a compile-time
+    property on TPU (re-trace per bucket), the data is not."""
+
+    data: Any
+    lod: Tuple[Tuple[int, ...], ...]
+
+    def sequence_ids(self) -> np.ndarray:
+        """Per-row segment id from the finest lod level."""
+        offs = self.lod[-1]
+        ids = np.zeros(offs[-1], np.int32)
+        for i in range(len(offs) - 1):
+            ids[offs[i]:offs[i + 1]] = i
+        return ids
+
+    @property
+    def num_sequences(self) -> int:
+        return len(self.lod[-1]) - 1
+
+
+def _dat(v):
+    return v.data if isinstance(v, LoDArray) else v
+
+
+def _like(template, data):
+    if isinstance(template, LoDArray):
+        return LoDArray(data, template.lod)
+    return data
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class OpInfo:
+    type: str
+    compute: Callable
+    family: str = "misc"
+    stateful_outputs: Tuple[str, ...] = ()   # outputs that alias persistables
+    no_grad: bool = False                    # not differentiable (metrics etc.)
+    uses_rng: bool = False
+
+
+_REGISTRY: Dict[str, OpInfo] = {}
+
+
+def register(type: str, *, family: str = "misc", stateful: Sequence[str] = (),
+             no_grad: bool = False, uses_rng: bool = False):
+    def deco(fn):
+        enforce_that(type not in _REGISTRY, f"op {type} already registered",
+                     context="fluid")
+        _REGISTRY[type] = OpInfo(type, fn, family, tuple(stateful), no_grad,
+                                 uses_rng)
+        return fn
+    return deco
+
+
+def get(type: str) -> OpInfo:
+    enforce_that(type in _REGISTRY, f"unknown op type {type!r}",
+                 context="fluid")
+    return _REGISTRY[type]
+
+
+def check_registered(type: str) -> None:
+    if type.endswith("_grad"):
+        type = type[:-5]
+    get(type)
+
+
+def registered_ops() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+class ComputeCtx:
+    """Per-trace context: rng, test mode, and sub-block tracer hook."""
+
+    def __init__(self, rng: Optional[jax.Array], is_test: bool,
+                 trace_block: Optional[Callable] = None):
+        self.rng = rng
+        self.is_test = is_test
+        self.trace_block = trace_block  # set by the Executor
+
+    def rng_for(self, salt: int) -> jax.Array:
+        key = self.rng if self.rng is not None else jax.random.PRNGKey(0)
+        return jax.random.fold_in(key, salt)
+
+
+def _one(ins, slot):
+    vs = ins.get(slot, [])
+    enforce_that(len(vs) == 1, f"slot {slot} expects 1 input, got {len(vs)}",
+                 context="fluid")
+    return vs[0]
+
+
+def _opt(ins, slot, default=None):
+    vs = ins.get(slot, [])
+    return vs[0] if vs else default
+
+
+# ---------------------------------------------------------------------------
+# elementwise family (elementwise_op.cc analog, with axis broadcast)
+# ---------------------------------------------------------------------------
+
+
+def _bcast(x, y, axis: int):
+    """Reference broadcast semantics: y's shape matches a contiguous slice of
+    x's dims starting at `axis` (elementwise_op.h); -1 = rank(x)-rank(y)."""
+    if x.ndim == y.ndim:
+        return y
+    if axis == -1:
+        axis = x.ndim - y.ndim
+    shape = [1] * x.ndim
+    for i, s in enumerate(y.shape):
+        shape[axis + i] = s
+    return y.reshape(shape)
+
+
+def _elementwise(fn):
+    def compute(ins, attrs, ctx):
+        x, y = _one(ins, "X"), _one(ins, "Y")
+        xd, yd = _dat(x), _dat(y)
+        out = fn(xd, _bcast(xd, yd, int(attrs.get("axis", -1))))
+        return {"Out": [_like(x, out)]}
+    return compute
+
+
+for _name, _fn in [("elementwise_add", jnp.add),
+                   ("elementwise_sub", jnp.subtract),
+                   ("elementwise_mul", jnp.multiply),
+                   ("elementwise_div", jnp.divide),
+                   ("elementwise_pow", jnp.power),
+                   ("elementwise_max", jnp.maximum),
+                   ("elementwise_min", jnp.minimum)]:
+    register(_name, family="elementwise")(_elementwise(_fn))
+
+
+# ---------------------------------------------------------------------------
+# activations (activation_op.cc bundle)
+# ---------------------------------------------------------------------------
+
+
+def _unary(fn):
+    def compute(ins, attrs, ctx):
+        x = _one(ins, "X")
+        return {"Out": [_like(x, fn(_dat(x), attrs))]}
+    return compute
+
+
+_ACTS = {
+    "sigmoid": lambda x, a: jax.nn.sigmoid(x),
+    "logsigmoid": lambda x, a: jax.nn.log_sigmoid(x),
+    "exp": lambda x, a: jnp.exp(x),
+    "relu": lambda x, a: jax.nn.relu(x),
+    "tanh": lambda x, a: jnp.tanh(x),
+    "sqrt": lambda x, a: jnp.sqrt(x),
+    "abs": lambda x, a: jnp.abs(x),
+    "reciprocal": lambda x, a: 1.0 / x,
+    "log": lambda x, a: jnp.log(x),
+    "square": lambda x, a: jnp.square(x),
+    "softsign": lambda x, a: x / (1.0 + jnp.abs(x)),
+    "brelu": lambda x, a: jnp.clip(x, a.get("t_min", 0.0), a.get("t_max", 24.0)),
+    "soft_relu": lambda x, a: jnp.log1p(jnp.exp(jnp.clip(
+        x, -a.get("threshold", 40.0), a.get("threshold", 40.0)))),
+    "pow": lambda x, a: jnp.power(x, a.get("factor", 1.0)),
+    "stanh": lambda x, a: a.get("scale_b", 1.7159) * jnp.tanh(
+        a.get("scale_a", 2.0 / 3.0) * x),
+    "leaky_relu": lambda x, a: jnp.where(x >= 0, x, a.get("alpha", 0.02) * x),
+    "relu6": lambda x, a: jnp.clip(x, 0.0, a.get("threshold", 6.0)),
+    "softplus": lambda x, a: jax.nn.softplus(x),
+    "hard_shrink": lambda x, a: jnp.where(
+        jnp.abs(x) > a.get("threshold", 0.5), x, 0.0),
+    "soft_shrink": lambda x, a: jnp.sign(x) * jax.nn.relu(
+        jnp.abs(x) - a.get("lambda", 0.5)),
+    "elu": lambda x, a: jnp.where(x > 0, x, a.get("alpha", 1.0)
+                                  * (jnp.exp(x) - 1.0)),
+    "sign": lambda x, a: jnp.sign(x),
+    "floor": lambda x, a: jnp.floor(x),
+    "ceil": lambda x, a: jnp.ceil(x),
+    "round": lambda x, a: jnp.round(x),
+}
+
+for _name, _fn in _ACTS.items():
+    register(_name, family="activation")(_unary(_fn))
+
+
+@register("scale", family="elementwise")
+def _scale(ins, attrs, ctx):
+    x = _one(ins, "X")
+    out = _dat(x) * attrs.get("scale", 1.0) + attrs.get("bias", 0.0)
+    return {"Out": [_like(x, out)]}
+
+
+@register("clip", family="elementwise")
+def _clip(ins, attrs, ctx):
+    x = _one(ins, "X")
+    return {"Out": [_like(x, jnp.clip(_dat(x), attrs["min"], attrs["max"]))]}
+
+
+@register("cast", family="elementwise")
+def _cast(ins, attrs, ctx):
+    x = _one(ins, "X")
+    return {"Out": [_like(x, _dat(x).astype(attrs["out_dtype"]))]}
+
+
+# ---------------------------------------------------------------------------
+# matmul family (mul_op / matmul_op; MXU-bound — keep batched & fusable)
+# ---------------------------------------------------------------------------
+
+
+@register("mul", family="matmul")
+def _mul(ins, attrs, ctx):
+    x, y = _dat(_one(ins, "X")), _dat(_one(ins, "Y"))
+    xn = int(attrs.get("x_num_col_dims", 1))
+    yn = int(attrs.get("y_num_col_dims", 1))
+    xm = x.reshape((int(np.prod(x.shape[:xn])), -1))
+    ym = y.reshape((int(np.prod(y.shape[:yn])), -1))
+    out = xm @ ym
+    out_shape = x.shape[:xn] + y.shape[yn:]
+    return {"Out": [_like(_one(ins, "X"), out.reshape(out_shape))]}
+
+
+@register("matmul", family="matmul")
+def _matmul(ins, attrs, ctx):
+    x, y = _dat(_one(ins, "X")), _dat(_one(ins, "Y"))
+    if attrs.get("transpose_X", False):
+        x = jnp.swapaxes(x, -1, -2)
+    if attrs.get("transpose_Y", False):
+        y = jnp.swapaxes(y, -1, -2)
+    return {"Out": [x @ y]}
+
+
+# ---------------------------------------------------------------------------
+# conv / pool (NCHW like the reference; lax targets the MXU directly,
+# no im2col materialisation — operators/math/im2col is unnecessary on TPU)
+# ---------------------------------------------------------------------------
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (int(v), int(v))
+
+
+@register("conv2d", family="conv")
+def _conv2d(ins, attrs, ctx):
+    x, w = _dat(_one(ins, "Input")), _dat(_one(ins, "Filter"))
+    s, p = _pair(attrs.get("strides", 1)), _pair(attrs.get("paddings", 0))
+    d = _pair(attrs.get("dilations", 1))
+    groups = int(attrs.get("groups", 1))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=s, padding=[(p[0], p[0]), (p[1], p[1])],
+        rhs_dilation=d, feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    b = _opt(ins, "Bias")
+    if b is not None:
+        out = out + _dat(b).reshape(1, -1, 1, 1)
+    return {"Output": [out]}
+
+
+@register("conv2d_transpose", family="conv")
+def _conv2d_transpose(ins, attrs, ctx):
+    x, w = _dat(_one(ins, "Input")), _dat(_one(ins, "Filter"))
+    s, p = _pair(attrs.get("strides", 1)), _pair(attrs.get("paddings", 0))
+    # filter layout [in, out, H, W] (conv2dtranspose_op.cc convention)
+    out = lax.conv_transpose(
+        x, w, strides=s, padding=[(p[0], p[0]), (p[1], p[1])],
+        dimension_numbers=("NCHW", "IOHW", "NCHW"))
+    return {"Output": [out]}
+
+
+@register("conv3d", family="conv")
+def _conv3d(ins, attrs, ctx):
+    x, w = _dat(_one(ins, "Input")), _dat(_one(ins, "Filter"))
+    s = tuple(attrs.get("strides", (1, 1, 1)))
+    p = tuple(attrs.get("paddings", (0, 0, 0)))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=s, padding=[(q, q) for q in p],
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    return {"Output": [out]}
+
+
+def _pool(x, ksize, strides, paddings, ptype, exclusive=True):
+    k, s, p = _pair(ksize), _pair(strides), _pair(paddings)
+    window = (1, 1) + k
+    stride = (1, 1) + s
+    pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    if ptype == "max":
+        return lax.reduce_window(x, -jnp.inf, lax.max, window, stride, pads)
+    summed = lax.reduce_window(x, 0.0, lax.add, window, stride, pads)
+    if exclusive and (p[0] or p[1]):
+        ones = jnp.ones_like(x)
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, stride, pads)
+        return summed / counts
+    return summed / float(k[0] * k[1])
+
+
+@register("pool2d", family="pool")
+def _pool2d(ins, attrs, ctx):
+    x = _dat(_one(ins, "X"))
+    if attrs.get("global_pooling", False):
+        k = x.shape[2:4]
+        return {"Out": [_pool(x, k, k, 0, attrs.get("pooling_type", "max"))]}
+    return {"Out": [_pool(x, attrs.get("ksize", 2),
+                          attrs.get("strides", 1), attrs.get("paddings", 0),
+                          attrs.get("pooling_type", "max"))]}
+
+
+@register("pool2d_with_index", family="pool", no_grad=False)
+def _pool2d_with_index(ins, attrs, ctx):
+    x = _dat(_one(ins, "X"))
+    k, s = _pair(attrs.get("ksize", 2)), _pair(attrs.get("strides", 1))
+    p = _pair(attrs.get("paddings", 0))
+    n, c, h, w = x.shape
+    flat_idx = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
+    flat_idx = jnp.broadcast_to(flat_idx, x.shape)
+
+    def sel(acc, cur):
+        av, ai = acc
+        cv, ci = cur
+        take = cv > av
+        return jnp.where(take, cv, av), jnp.where(take, ci, ai)
+
+    window = (1, 1) + k
+    stride = (1, 1) + s
+    pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    out, idx = lax.reduce_window((x, flat_idx), (-jnp.inf, -1.0),
+                                 sel, window, stride, pads)
+    return {"Out": [out], "Mask": [idx.astype(jnp.int32)]}
+
+
+# ---------------------------------------------------------------------------
+# batch_norm (batch_norm_op.cc; stateful moving stats)
+# ---------------------------------------------------------------------------
+
+
+@register("batch_norm", family="norm",
+          stateful=("MeanOut", "VarianceOut"))
+def _batch_norm(ins, attrs, ctx):
+    x = _dat(_one(ins, "X"))
+    scale, bias = _dat(_one(ins, "Scale")), _dat(_one(ins, "Bias"))
+    mean_in = _dat(_one(ins, "Mean"))
+    var_in = _dat(_one(ins, "Variance"))
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    layout = attrs.get("data_layout", "NCHW")
+    axes = tuple(i for i in range(x.ndim)
+                 if i != (1 if layout == "NCHW" else x.ndim - 1))
+    shape = [1] * x.ndim
+    shape[1 if layout == "NCHW" else -1] = -1
+    if ctx.is_test or attrs.get("is_test", False):
+        mean, var = mean_in, var_in
+        mean_out, var_out = mean_in, var_in
+        saved_mean = mean
+        saved_var = var
+    else:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        mean_out = momentum * mean_in + (1 - momentum) * mean
+        var_out = momentum * var_in + (1 - momentum) * var
+        saved_mean, saved_var = mean, var
+    inv = lax.rsqrt(var.reshape(shape) + eps)
+    y = (x - mean.reshape(shape)) * inv * scale.reshape(shape) \
+        + bias.reshape(shape)
+    return {"Y": [y], "MeanOut": [mean_out], "VarianceOut": [var_out],
+            "SavedMean": [saved_mean], "SavedVariance": [saved_var]}
+
+
+@register("lrn", family="norm")
+def _lrn(ins, attrs, ctx):
+    x = _dat(_one(ins, "X"))
+    n = int(attrs.get("n", 5))
+    k, alpha, beta = (attrs.get("k", 2.0), attrs.get("alpha", 1e-4),
+                      attrs.get("beta", 0.75))
+    sq = jnp.square(x)
+    pad = n // 2
+    sq = jnp.pad(sq, ((0, 0), (pad, pad), (0, 0), (0, 0)))
+    acc = sum(sq[:, i:i + x.shape[1]] for i in range(n))
+    return {"Out": [x / jnp.power(k + alpha * acc, beta)]}
+
+
+@register("layer_norm", family="norm")
+def _layer_norm(ins, attrs, ctx):
+    x = _dat(_one(ins, "X"))
+    eps = attrs.get("epsilon", 1e-5)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + eps)
+    scale, bias = _opt(ins, "Scale"), _opt(ins, "Bias")
+    if scale is not None:
+        y = y * _dat(scale)
+    if bias is not None:
+        y = y + _dat(bias)
+    return {"Y": [y]}
+
+
+# ---------------------------------------------------------------------------
+# softmax / losses
+# ---------------------------------------------------------------------------
+
+
+@register("softmax", family="softmax")
+def _softmax(ins, attrs, ctx):
+    x = _one(ins, "X")
+    return {"Out": [_like(x, jax.nn.softmax(_dat(x), axis=-1))]}
+
+
+def _xent(probs, label, soft):
+    if soft:
+        return -jnp.sum(label * jnp.log(jnp.clip(probs, 1e-10, None)),
+                        axis=-1, keepdims=True)
+    idx = label.reshape(-1).astype(jnp.int32)
+    picked = jnp.take_along_axis(probs, idx[:, None], axis=-1)
+    return -jnp.log(jnp.clip(picked, 1e-10, None))
+
+
+@register("cross_entropy", family="loss")
+def _cross_entropy(ins, attrs, ctx):
+    x, label = _dat(_one(ins, "X")), _dat(_one(ins, "Label"))
+    return {"Y": [_xent(x, label, attrs.get("soft_label", False))]}
+
+
+@register("softmax_with_cross_entropy", family="loss")
+def _softmax_xent(ins, attrs, ctx):
+    logits, label = _dat(_one(ins, "Logits")), _dat(_one(ins, "Label"))
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+    else:
+        idx = label.reshape(-1).astype(jnp.int32)
+        loss = -jnp.take_along_axis(logp, idx[:, None], axis=-1)
+    return {"Softmax": [jnp.exp(logp)], "Loss": [loss]}
+
+
+@register("sigmoid_cross_entropy_with_logits", family="loss")
+def _sigmoid_xent(ins, attrs, ctx):
+    x, label = _dat(_one(ins, "X")), _dat(_one(ins, "Labels"))
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return {"Out": [loss]}
+
+
+@register("squared_l2_distance", family="loss")
+def _sq_l2_dist(ins, attrs, ctx):
+    x, y = _dat(_one(ins, "X")), _dat(_one(ins, "Y"))
+    sub = x - y
+    return {"sub_result": [sub],
+            "Out": [jnp.sum(jnp.square(sub), axis=-1, keepdims=True)]}
+
+
+@register("squared_l2_norm", family="loss")
+def _sq_l2_norm(ins, attrs, ctx):
+    x = _dat(_one(ins, "X"))
+    return {"Out": [jnp.sum(jnp.square(x)).reshape(1)]}
+
+
+@register("rank_loss", family="loss")
+def _rank_loss(ins, attrs, ctx):
+    label = _dat(_one(ins, "Label"))
+    left, right = _dat(_one(ins, "Left")), _dat(_one(ins, "Right"))
+    d = left - right
+    return {"Out": [jnp.log1p(jnp.exp(d)) - label * d]}
+
+
+@register("margin_rank_loss", family="loss")
+def _margin_rank_loss(ins, attrs, ctx):
+    label = _dat(_one(ins, "Label"))
+    x1, x2 = _dat(_one(ins, "X1")), _dat(_one(ins, "X2"))
+    margin = attrs.get("margin", 0.0)
+    out = jax.nn.relu(-label * (x1 - x2) + margin)
+    return {"Out": [out], "Activated": [(out > 0).astype(x1.dtype)]}
+
+
+@register("smooth_l1_loss", family="loss")
+def _smooth_l1(ins, attrs, ctx):
+    x, y = _dat(_one(ins, "X")), _dat(_one(ins, "Y"))
+    sigma2 = attrs.get("sigma", 1.0) ** 2
+    diff = x - y
+    iw, ow = _opt(ins, "InsideWeight"), _opt(ins, "OutsideWeight")
+    if iw is not None:
+        diff = diff * _dat(iw)
+    ad = jnp.abs(diff)
+    val = jnp.where(ad < 1.0 / sigma2, 0.5 * sigma2 * diff * diff,
+                    ad - 0.5 / sigma2)
+    if ow is not None:
+        val = val * _dat(ow)
+    return {"Diff": [diff],
+            "Out": [jnp.sum(val, axis=-1, keepdims=True)]}
+
+
+@register("huber_loss", family="loss")
+def _huber(ins, attrs, ctx):
+    x, y = _dat(_one(ins, "X")), _dat(_one(ins, "Y"))
+    delta = attrs.get("delta", 1.0)
+    r = y - x
+    ar = jnp.abs(r)
+    out = jnp.where(ar <= delta, 0.5 * r * r, delta * (ar - 0.5 * delta))
+    return {"Residual": [r], "Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# embeddings / gather / scatter
+# ---------------------------------------------------------------------------
+
+
+@register("lookup_table", family="embedding")
+def _lookup_table(ins, attrs, ctx):
+    w, ids = _dat(_one(ins, "W")), _one(ins, "Ids")
+    idx = _dat(ids).reshape(-1).astype(jnp.int32)
+    out = jnp.take(w, idx, axis=0)
+    return {"Out": [_like(ids, out)]}
+
+
+@register("gather", family="embedding")
+def _gather(ins, attrs, ctx):
+    x, idx = _dat(_one(ins, "X")), _dat(_one(ins, "Index"))
+    return {"Out": [jnp.take(x, idx.reshape(-1).astype(jnp.int32), axis=0)]}
+
+
+@register("scatter", family="embedding")
+def _scatter(ins, attrs, ctx):
+    ref = _dat(_one(ins, "Ref"))
+    idx = _dat(_one(ins, "Index")).reshape(-1).astype(jnp.int32)
+    upd = _dat(_one(ins, "Updates"))
+    return {"Out": [ref.at[idx].set(upd)]}
+
+
+# ---------------------------------------------------------------------------
+# shape ops
+# ---------------------------------------------------------------------------
+
+
+@register("reshape", family="shape")
+def _reshape(ins, attrs, ctx):
+    x = _dat(_one(ins, "X"))
+    shape = list(attrs["shape"])
+    return {"Out": [x.reshape(shape)]}
+
+
+@register("transpose", family="shape")
+def _transpose(ins, attrs, ctx):
+    x = _dat(_one(ins, "X"))
+    return {"Out": [jnp.transpose(x, attrs["axis"])]}
+
+
+@register("concat", family="shape")
+def _concat(ins, attrs, ctx):
+    xs = [_dat(v) for v in ins["X"]]
+    return {"Out": [jnp.concatenate(xs, axis=int(attrs.get("axis", 0)))]}
+
+
+@register("split", family="shape")
+def _split(ins, attrs, ctx):
+    x = _dat(_one(ins, "X"))
+    axis = int(attrs.get("axis", 0))
+    if "sections" in attrs and attrs["sections"]:
+        secs = np.cumsum(attrs["sections"])[:-1].tolist()
+        outs = jnp.split(x, secs, axis=axis)
+    else:
+        outs = jnp.split(x, int(attrs["num"]), axis=axis)
+    return {"Out": list(outs)}
+
+
+@register("pad", family="shape")
+def _pad(ins, attrs, ctx):
+    x = _dat(_one(ins, "X"))
+    p = attrs["paddings"]
+    pads = [(int(p[2 * i]), int(p[2 * i + 1])) for i in range(x.ndim)]
+    return {"Out": [jnp.pad(x, pads,
+                            constant_values=attrs.get("pad_value", 0.0))]}
+
+
+@register("crop", family="shape")
+def _crop(ins, attrs, ctx):
+    x = _dat(_one(ins, "X"))
+    offsets = attrs["offsets"]
+    shape = attrs["shape"]
+    idx = tuple(slice(int(o), int(o) + int(s))
+                for o, s in zip(offsets, shape))
+    return {"Out": [x[idx]]}
+
+
+@register("squeeze", family="shape")
+def _squeeze(ins, attrs, ctx):
+    x = _dat(_one(ins, "X"))
+    axes = attrs.get("axes")
+    return {"Out": [jnp.squeeze(x, axis=tuple(axes) if axes else None)]}
+
+
+@register("unsqueeze", family="shape")
+def _unsqueeze(ins, attrs, ctx):
+    x = _dat(_one(ins, "X"))
+    out = x
+    for a in sorted(attrs["axes"]):
+        out = jnp.expand_dims(out, a)
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# reductions / stats
+# ---------------------------------------------------------------------------
+
+
+def _reduce(fn):
+    def compute(ins, attrs, ctx):
+        x = _dat(_one(ins, "X"))
+        dim = attrs.get("dim")
+        keep = attrs.get("keep_dim", False)
+        if attrs.get("reduce_all", dim is None):
+            return {"Out": [fn(x)]}
+        return {"Out": [fn(x, axis=int(dim), keepdims=keep)]}
+    return compute
+
+
+for _name, _fn in [("reduce_sum", jnp.sum), ("reduce_mean", jnp.mean),
+                   ("reduce_max", jnp.max), ("reduce_min", jnp.min)]:
+    register(_name, family="reduce")(_reduce(_fn))
+
+
+@register("mean", family="reduce")
+def _mean(ins, attrs, ctx):
+    return {"Out": [jnp.mean(_dat(_one(ins, "X")))]}
+
+
+@register("sum", family="reduce")
+def _sum(ins, attrs, ctx):
+    xs = [_dat(v) for v in ins["X"]]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": [out]}
+
+
+@register("minus", family="elementwise")
+def _minus(ins, attrs, ctx):
+    return {"Out": [_dat(_one(ins, "X")) - _dat(_one(ins, "Y"))]}
+
+
+@register("top_k", family="search", no_grad=True)
+def _top_k(ins, attrs, ctx):
+    x = _dat(_one(ins, "X"))
+    k = int(attrs.get("k", 1))
+    vals, idx = lax.top_k(x, k)
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int32)]}
+
+
+@register("accuracy", family="metric", no_grad=True)
+def _accuracy(ins, attrs, ctx):
+    pred = _dat(_one(ins, "Out"))          # top-k indices [N, k]
+    label = _dat(_one(ins, "Label")).reshape(-1, 1)
+    correct = jnp.any(pred == label, axis=1)
+    # int32: jax defaults to 32-bit; the reference's int64 width is not
+    # meaningful for batch-local counters
+    total = jnp.array(pred.shape[0], jnp.int32)
+    num_correct = jnp.sum(correct).astype(jnp.int32)
+    return {"Accuracy": [num_correct.astype(jnp.float32) / pred.shape[0]],
+            "Correct": [num_correct], "Total": [total]}
+
+
+@register("argmax", family="search", no_grad=True)
+def _argmax(ins, attrs, ctx):
+    x = _dat(_one(ins, "X"))
+    return {"Out": [jnp.argmax(x, axis=int(attrs.get("axis", -1)))
+                    .astype(jnp.int32)]}
+
+
+# ---------------------------------------------------------------------------
+# random / fill
+# ---------------------------------------------------------------------------
+
+
+@register("uniform_random", family="random", no_grad=True, uses_rng=True)
+def _uniform_random(ins, attrs, ctx):
+    key = ctx.rng_for(attrs.get("_rng_salt", 0))
+    shape = tuple(int(s) for s in attrs["shape"])
+    out = jax.random.uniform(key, shape, minval=attrs.get("min", -1.0),
+                             maxval=attrs.get("max", 1.0))
+    return {"Out": [out.astype(attrs.get("dtype", "float32"))]}
+
+
+@register("gaussian_random", family="random", no_grad=True, uses_rng=True)
+def _gaussian_random(ins, attrs, ctx):
+    key = ctx.rng_for(attrs.get("_rng_salt", 1))
+    shape = tuple(int(s) for s in attrs["shape"])
+    out = (attrs.get("mean", 0.0)
+           + attrs.get("std", 1.0) * jax.random.normal(key, shape))
+    return {"Out": [out.astype(attrs.get("dtype", "float32"))]}
+
+
+@register("fill_constant", family="fill", no_grad=True)
+def _fill_constant(ins, attrs, ctx):
+    shape = tuple(int(s) for s in attrs["shape"])
+    return {"Out": [jnp.full(shape, attrs.get("value", 0.0),
+                             dtype=attrs.get("dtype", "float32"))]}
+
+
+@register("fill_zeros_like", family="fill", no_grad=True)
+def _fill_zeros_like(ins, attrs, ctx):
+    x = _one(ins, "X")
+    return {"Out": [_like(x, jnp.zeros_like(_dat(x)))]}
+
+
+@register("increment", family="fill", no_grad=True)
+def _increment(ins, attrs, ctx):
+    x = _dat(_one(ins, "X"))
+    return {"Out": [x + attrs.get("step", 1.0)]}
+
+
+@register("dropout", family="random", uses_rng=True)
+def _dropout(ins, attrs, ctx):
+    x = _one(ins, "X")
+    prob = attrs.get("dropout_prob", 0.5)
+    if ctx.is_test or attrs.get("is_test", False) or prob == 0.0:
+        return {"Out": [x], "Mask": [jnp.ones_like(_dat(x))]}
+    key = ctx.rng_for(attrs.get("_rng_salt", 2))
+    mask = (jax.random.uniform(key, _dat(x).shape) >= prob).astype(
+        _dat(x).dtype)
+    return {"Out": [_like(x, _dat(x) * mask / (1.0 - prob))], "Mask": [mask]}
+
+
+# ---------------------------------------------------------------------------
+# recurrent building blocks (lstm_unit_op / gru_unit_op)
+# ---------------------------------------------------------------------------
+
+
+@register("lstm_unit", family="rnn")
+def _lstm_unit(ins, attrs, ctx):
+    x = _dat(_one(ins, "X"))          # [N, 4D] pre-activations i,f,c,o
+    c_prev = _dat(_one(ins, "C_prev"))
+    forget_bias = attrs.get("forget_bias", 0.0)
+    i, f, g, o = jnp.split(x, 4, axis=-1)
+    c = jax.nn.sigmoid(f + forget_bias) * c_prev \
+        + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return {"C": [c], "H": [h]}
+
+
+@register("gru_unit", family="rnn")
+def _gru_unit(ins, attrs, ctx):
+    x = _dat(_one(ins, "Input"))       # [N, 3D] projected input
+    h_prev = _dat(_one(ins, "HiddenPrev"))
+    w = _dat(_one(ins, "Weight"))      # [D, 3D]: gates [D,2D] + cand [D,D]
+    d = h_prev.shape[-1]
+    gates_x, cand_x = x[:, :2 * d], x[:, 2 * d:]
+    wg, wc = w[:, :2 * d], w[:, 2 * d:]
+    b = _opt(ins, "Bias")
+    gates = gates_x + h_prev @ wg
+    cand_b = 0.0
+    if b is not None:
+        bd = _dat(b)
+        gates = gates + bd[:2 * d]
+        cand_b = bd[2 * d:]
+    u, r = jnp.split(jax.nn.sigmoid(gates), 2, axis=-1)
+    c = jnp.tanh(cand_x + (r * h_prev) @ wc + cand_b)
+    h = u * h_prev + (1.0 - u) * c
+    return {"Gate": [jnp.concatenate([u, r], -1)], "ResetHiddenPrev":
+            [r * h_prev], "Hidden": [h]}
+
+
+# ---------------------------------------------------------------------------
+# sequence (LoD) ops — segment-id based, padding-free capability
+# ---------------------------------------------------------------------------
+
+
+def _seg_matrix(la: LoDArray):
+    """[num_seq, rows] one-hot segment matrix (static per trace)."""
+    ids = la.sequence_ids()
+    n = la.num_sequences
+    m = np.zeros((n, len(ids)), np.float32)
+    m[ids, np.arange(len(ids))] = 1.0
+    return jnp.asarray(m)
+
+
+@register("sequence_pool", family="sequence")
+def _sequence_pool(ins, attrs, ctx):
+    x = _one(ins, "X")
+    enforce_that(isinstance(x, LoDArray), "sequence_pool needs LoD input",
+                 context="fluid")
+    ptype = attrs.get("pooltype", "AVERAGE").upper()
+    seg = _seg_matrix(x)                     # [S, R]
+    data = x.data.reshape(x.data.shape[0], -1)
+    if ptype == "SUM":
+        out = seg @ data
+    elif ptype == "AVERAGE":
+        out = (seg @ data) / jnp.sum(seg, axis=1, keepdims=True)
+    elif ptype == "SQRT":
+        out = (seg @ data) / jnp.sqrt(jnp.sum(seg, axis=1, keepdims=True))
+    elif ptype == "MAX":
+        big = jnp.where(seg[:, :, None] > 0, data[None, :, :], -jnp.inf)
+        out = jnp.max(big, axis=1)
+    elif ptype == "LAST":
+        offs = np.asarray(x.lod[-1][1:]) - 1
+        out = data[jnp.asarray(offs)]
+    elif ptype == "FIRST":
+        offs = np.asarray(x.lod[-1][:-1])
+        out = data[jnp.asarray(offs)]
+    else:
+        raise EnforceError(f"bad pooltype {ptype}", context="fluid")
+    return {"Out": [out.reshape((out.shape[0],) + x.data.shape[1:])]}
+
+
+@register("sequence_softmax", family="sequence")
+def _sequence_softmax(ins, attrs, ctx):
+    x = _one(ins, "X")
+    enforce_that(isinstance(x, LoDArray), "sequence_softmax needs LoD",
+                 context="fluid")
+    ids = jnp.asarray(x.sequence_ids())
+    data = x.data.reshape(-1)
+    n = x.num_sequences
+    seg_max = jax.ops.segment_max(data, ids, num_segments=n)
+    e = jnp.exp(data - seg_max[ids])
+    seg_sum = jax.ops.segment_sum(e, ids, num_segments=n)
+    return {"Out": [LoDArray((e / seg_sum[ids]).reshape(x.data.shape),
+                             x.lod)]}
+
+
+@register("sequence_concat", family="sequence")
+def _sequence_concat(ins, attrs, ctx):
+    xs = ins["X"]
+    enforce_that(all(isinstance(v, LoDArray) for v in xs),
+                 "sequence_concat needs LoD inputs", context="fluid")
+    level = int(attrs.get("level", 0))
+    axis = int(attrs.get("axis", 0))
+    if axis == 1:
+        return {"Out": [LoDArray(
+            jnp.concatenate([v.data for v in xs], axis=1), xs[0].lod)]}
+    # axis 0: interleave per sequence
+    lods = [np.asarray(v.lod[-1]) for v in xs]
+    pieces, new_offs = [], [0]
+    for s in range(len(lods[0]) - 1):
+        for v, lod in zip(xs, lods):
+            pieces.append(v.data[int(lod[s]):int(lod[s + 1])])
+        new_offs.append(new_offs[-1]
+                        + sum(int(l[s + 1] - l[s]) for l in lods))
+    del level
+    return {"Out": [LoDArray(jnp.concatenate(pieces, axis=0),
+                             (tuple(new_offs),))]}
+
+
+@register("sequence_expand", family="sequence")
+def _sequence_expand(ins, attrs, ctx):
+    x, y = _one(ins, "X"), _one(ins, "Y")
+    enforce_that(isinstance(y, LoDArray), "sequence_expand needs LoD Y",
+                 context="fluid")
+    ids = jnp.asarray(y.sequence_ids())
+    xd = _dat(x)
+    return {"Out": [LoDArray(jnp.take(xd, ids, axis=0), y.lod)]}
+
+
+# ---------------------------------------------------------------------------
+# recurrent op — sub-block over time via lax.scan (recurrent_op.cc analog,
+# StaticRNN python/paddle/v2/framework/layers.py:333)
+# ---------------------------------------------------------------------------
+
+
+@register("recurrent", family="rnn")
+def _recurrent(ins, attrs, ctx):
+    enforce_that(ctx.trace_block is not None,
+                 "recurrent op needs executor trace hook", context="fluid")
+    xs = [_dat(v) for v in ins.get("Inputs", [])]        # each [T, B, ...]
+    init_states = [_dat(v) for v in ins.get("InitStates", [])]
+    params = list(ins.get("Parameters", []))
+    step_in = list(attrs["step_inputs"])            # sub-block var names
+    st_in = list(attrs["step_states_in"])
+    st_out = list(attrs["step_states_out"])
+    step_out = list(attrs["step_outputs"])
+    param_names = list(attrs.get("param_names", []))
+    sub_idx = int(attrs["sub_block"])
+
+    def body(carry, xt):
+        env = dict(zip(step_in, xt))
+        env.update(zip(st_in, carry))
+        # parameters enter through the op's input slots so program-level
+        # autodiff (vjp over this compute) reaches them through the scan
+        env.update(zip(param_names, params))
+        env = ctx.trace_block(sub_idx, env)
+        new_carry = tuple(env[n] for n in st_out)
+        outs = tuple(env[n] for n in step_out)
+        return new_carry, outs
+
+    # reverse=True runs the recurrence from the last frame backwards with
+    # outputs stacked at their original positions (lax.scan reverse, not an
+    # output flip — the carry must flow backwards)
+    carry, ys = lax.scan(body, tuple(init_states), tuple(xs),
+                         reverse=bool(attrs.get("reverse", False)))
+    return {"Outputs": list(ys), "FinalStates": list(carry)}
+
+
+# ---------------------------------------------------------------------------
+# optimizer ops (sgd_op / momentum_op / adam_op ... — run server-side in the
+# reference's pserver (ParameterServer2.cpp:362-541); here they're ordinary
+# ops in the train program, sharded by pjit like everything else)
+# ---------------------------------------------------------------------------
+
+
+def _lr(ins):
+    lr = _dat(_one(ins, "LearningRate"))
+    return lr.reshape(()) if hasattr(lr, "reshape") else lr
+
+
+@register("sgd", family="optimizer", stateful=("ParamOut",), no_grad=True)
+def _sgd(ins, attrs, ctx):
+    p, g = _dat(_one(ins, "Param")), _dat(_one(ins, "Grad"))
+    return {"ParamOut": [p - _lr(ins) * g]}
+
+
+@register("momentum", family="optimizer",
+          stateful=("ParamOut", "VelocityOut"), no_grad=True)
+def _momentum(ins, attrs, ctx):
+    p, g = _dat(_one(ins, "Param")), _dat(_one(ins, "Grad"))
+    v = _dat(_one(ins, "Velocity"))
+    mu = attrs.get("mu", 0.9)
+    lr = _lr(ins)
+    v_new = mu * v + g
+    if attrs.get("use_nesterov", False):
+        p_new = p - lr * (g + mu * v_new)
+    else:
+        p_new = p - lr * v_new
+    return {"ParamOut": [p_new], "VelocityOut": [v_new]}
+
+
+@register("adagrad", family="optimizer",
+          stateful=("ParamOut", "MomentOut"), no_grad=True)
+def _adagrad(ins, attrs, ctx):
+    p, g = _dat(_one(ins, "Param")), _dat(_one(ins, "Grad"))
+    m = _dat(_one(ins, "Moment"))
+    eps = attrs.get("epsilon", 1e-6)
+    m_new = m + jnp.square(g)
+    return {"ParamOut": [p - _lr(ins) * g / (jnp.sqrt(m_new) + eps)],
+            "MomentOut": [m_new]}
+
+
+@register("adadelta", family="optimizer",
+          stateful=("ParamOut", "AvgSquaredGradOut", "AvgSquaredUpdateOut"),
+          no_grad=True)
+def _adadelta(ins, attrs, ctx):
+    p, g = _dat(_one(ins, "Param")), _dat(_one(ins, "Grad"))
+    ag = _dat(_one(ins, "AvgSquaredGrad"))
+    au = _dat(_one(ins, "AvgSquaredUpdate"))
+    rho, eps = attrs.get("rho", 0.95), attrs.get("epsilon", 1e-6)
+    ag_new = rho * ag + (1 - rho) * jnp.square(g)
+    upd = -jnp.sqrt(au + eps) / jnp.sqrt(ag_new + eps) * g
+    au_new = rho * au + (1 - rho) * jnp.square(upd)
+    return {"ParamOut": [p + _lr(ins) * upd], "AvgSquaredGradOut": [ag_new],
+            "AvgSquaredUpdateOut": [au_new]}
+
+
+@register("rmsprop", family="optimizer",
+          stateful=("ParamOut", "MomentOut", "MeanSquareOut"), no_grad=True)
+def _rmsprop(ins, attrs, ctx):
+    p, g = _dat(_one(ins, "Param")), _dat(_one(ins, "Grad"))
+    ms = _dat(_one(ins, "MeanSquare"))
+    mom = _dat(_one(ins, "Moment"))
+    rho, eps = attrs.get("decay", 0.9), attrs.get("epsilon", 1e-6)
+    momentum = attrs.get("momentum", 0.0)
+    ms_new = rho * ms + (1 - rho) * jnp.square(g)
+    mom_new = momentum * mom + _lr(ins) * g / jnp.sqrt(ms_new + eps)
+    return {"ParamOut": [p - mom_new], "MomentOut": [mom_new],
+            "MeanSquareOut": [ms_new]}
+
+
+@register("decayed_adagrad", family="optimizer",
+          stateful=("ParamOut", "MomentOut"), no_grad=True)
+def _decayed_adagrad(ins, attrs, ctx):
+    p, g = _dat(_one(ins, "Param")), _dat(_one(ins, "Grad"))
+    m = _dat(_one(ins, "Moment"))
+    decay, eps = attrs.get("decay", 0.95), attrs.get("epsilon", 1e-6)
+    m_new = decay * m + (1 - decay) * jnp.square(g)
+    return {"ParamOut": [p - _lr(ins) * g / (jnp.sqrt(m_new) + eps)],
+            "MomentOut": [m_new]}
+
+
+@register("adam", family="optimizer",
+          stateful=("ParamOut", "Moment1Out", "Moment2Out"), no_grad=True)
+def _adam(ins, attrs, ctx):
+    p, g = _dat(_one(ins, "Param")), _dat(_one(ins, "Grad"))
+    m1, m2 = _dat(_one(ins, "Moment1")), _dat(_one(ins, "Moment2"))
+    b1p = _dat(_one(ins, "Beta1Pow")).reshape(())
+    b2p = _dat(_one(ins, "Beta2Pow")).reshape(())
+    b1, b2 = attrs.get("beta1", 0.9), attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    m1n = b1 * m1 + (1 - b1) * g
+    m2n = b2 * m2 + (1 - b2) * jnp.square(g)
+    lr = _lr(ins) * jnp.sqrt(1 - b2p * b2) / (1 - b1p * b1)
+    return {"ParamOut": [p - lr * m1n / (jnp.sqrt(m2n) + eps)],
+            "Moment1Out": [m1n], "Moment2Out": [m2n]}
+
+
+@register("adamax", family="optimizer",
+          stateful=("ParamOut", "MomentOut", "InfNormOut"), no_grad=True)
+def _adamax(ins, attrs, ctx):
+    p, g = _dat(_one(ins, "Param")), _dat(_one(ins, "Grad"))
+    m, inf = _dat(_one(ins, "Moment")), _dat(_one(ins, "InfNorm"))
+    b1p = _dat(_one(ins, "Beta1Pow")).reshape(())
+    b1, b2 = attrs.get("beta1", 0.9), attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    m_new = b1 * m + (1 - b1) * g
+    inf_new = jnp.maximum(b2 * inf, jnp.abs(g))
+    lr = _lr(ins) / (1 - b1p * b1)
+    return {"ParamOut": [p - lr * m_new / (inf_new + eps)],
+            "MomentOut": [m_new], "InfNormOut": [inf_new]}
+
+
+@register("proximal_gd", family="optimizer", stateful=("ParamOut",),
+          no_grad=True)
+def _proximal_gd(ins, attrs, ctx):
+    p, g = _dat(_one(ins, "Param")), _dat(_one(ins, "Grad"))
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    lr = _lr(ins)
+    prox = p - lr * g
+    if l1 > 0:
+        prox = jnp.sign(prox) * jax.nn.relu(jnp.abs(prox) - lr * l1)
+    return {"ParamOut": [prox / (1.0 + lr * l2)]}
+
+
+@register("beta_pow_update", family="optimizer",
+          stateful=("Beta1PowOut", "Beta2PowOut"), no_grad=True)
+def _beta_pow_update(ins, attrs, ctx):
+    """Adam/Adamax beta^t accumulators (adam_op.cc keeps them as inputs;
+    we advance them explicitly once per step)."""
+    b1p = _dat(_one(ins, "Beta1Pow"))
+    out = {"Beta1PowOut": [b1p * attrs.get("beta1", 0.9)]}
+    if "Beta2Pow" in ins:
+        out["Beta2PowOut"] = [_dat(_one(ins, "Beta2Pow"))
+                              * attrs.get("beta2", 0.999)]
+    return out

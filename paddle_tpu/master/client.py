@@ -17,7 +17,7 @@ import time
 from typing import List, Optional
 
 from .recordio import recordio_read_chunk
-from .service import Service
+from .service import Service, dispatch
 from .server import send_msg, recv_msg
 
 
@@ -26,28 +26,7 @@ class _InprocTransport:
         self.service = service or Service()
 
     def call(self, method: str, **params):
-        svc = self.service
-        if method == "set_dataset":
-            return svc.set_dataset(params["paths"])
-        if method == "get_task":
-            t = svc.get_task()
-            if t is None:
-                return None
-            return {"id": t.id, "epoch": t.epoch,
-                    "chunks": [{"path": c.path, "offset": c.offset,
-                                "count": c.count} for c in t.chunks]}
-        if method == "task_finished":
-            return svc.task_finished(params["task_id"])
-        if method == "task_failed":
-            return svc.task_failed(params["task_id"])
-        if method == "all_done":
-            return svc.all_done()
-        if method == "new_pass":
-            svc.new_pass()
-            return True
-        if method == "request_save_model":
-            return svc.request_save_model(params.get("block_s", 60.0))
-        raise ValueError(method)
+        return dispatch(self.service, method, params)
 
 
 class _TcpTransport:

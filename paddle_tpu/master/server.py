@@ -20,7 +20,7 @@ import struct
 import threading
 from typing import Optional
 
-from .service import Service
+from .service import Service, dispatch
 
 _LEN = struct.Struct("<I")
 
@@ -73,31 +73,7 @@ class _Handler(socketserver.BaseRequestHandler):
 
     @staticmethod
     def _dispatch(svc: Service, req):
-        method = req.get("method")
-        params = req.get("params") or {}
-        if method == "set_dataset":
-            return svc.set_dataset(params["paths"])
-        if method == "get_task":
-            task = svc.get_task()
-            if task is None:
-                return None
-            return {"id": task.id, "epoch": task.epoch,
-                    "chunks": [{"path": c.path, "offset": c.offset,
-                                "count": c.count} for c in task.chunks]}
-        if method == "task_finished":
-            return svc.task_finished(int(params["task_id"]))
-        if method == "task_failed":
-            return svc.task_failed(int(params["task_id"]))
-        if method == "all_done":
-            return svc.all_done()
-        if method == "new_pass":
-            svc.new_pass()
-            return True
-        if method == "request_save_model":
-            return svc.request_save_model(float(params.get("block_s", 60.0)))
-        if method == "ping":
-            return "pong"
-        raise ValueError(f"unknown method {method!r}")
+        return dispatch(svc, req.get("method"), req.get("params"))
 
 
 class _Server(socketserver.ThreadingTCPServer):

@@ -236,3 +236,33 @@ class Service:
         self._dataset_paths = st.get("dataset_paths", [])
         self._next_id = st["next_id"]
         self._pass_no = st["pass_no"]
+
+
+def dispatch(svc: "Service", method, params):
+    """The RPC method table (go/master net/rpc surface analog) — shared by
+    the TCP server handler and the client's in-process transport so the
+    wire protocol has exactly one definition."""
+    params = params or {}
+    if method == "set_dataset":
+        return svc.set_dataset(params["paths"])
+    if method == "get_task":
+        task = svc.get_task()
+        if task is None:
+            return None
+        return {"id": task.id, "epoch": task.epoch,
+                "chunks": [{"path": c.path, "offset": c.offset,
+                            "count": c.count} for c in task.chunks]}
+    if method == "task_finished":
+        return svc.task_finished(int(params["task_id"]))
+    if method == "task_failed":
+        return svc.task_failed(int(params["task_id"]))
+    if method == "all_done":
+        return svc.all_done()
+    if method == "new_pass":
+        svc.new_pass()
+        return True
+    if method == "request_save_model":
+        return svc.request_save_model(float(params.get("block_s", 60.0)))
+    if method == "ping":
+        return "pong"
+    raise ValueError(f"unknown method {method!r}")

@@ -1,0 +1,165 @@
+"""Fluid end-to-end model tests.
+
+Reference: python/paddle/v2/framework/tests/test_fit_a_line.py,
+test_recognize_digits_mlp.py / test_recognize_digits_conv.py,
+test_recurrent_op.py — small models trained a few steps must converge.
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers, optimizer
+
+
+def test_fit_a_line():
+    rng = np.random.RandomState(0)
+    true_w = rng.randn(13, 1).astype(np.float32)
+
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        x = layers.data("x", [13])
+        y = layers.data("y", [1])
+        pred = layers.fc(x, size=1, bias_attr=True)
+        cost = layers.square_error_cost(pred, y)
+        loss = layers.mean(cost)
+        optimizer.SGDOptimizer(learning_rate=0.05).minimize(loss)
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    losses = []
+    for step in range(60):
+        xb = rng.randn(32, 13).astype(np.float32)
+        yb = xb @ true_w
+        (l,) = exe.run(prog, feed={"x": xb, "y": yb}, fetch_list=[loss],
+                       scope=scope)
+        losses.append(float(l))
+    assert losses[-1] < 0.05 * losses[0], losses[::10]
+
+
+def test_recognize_digits_mlp():
+    rng = np.random.RandomState(1)
+
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        img = layers.data("img", [64])
+        label = layers.data("label", [1], dtype="int64")
+        h = layers.fc(img, size=32, act="relu")
+        logits = layers.fc(h, size=4)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        acc = layers.accuracy(logits, label)
+        optimizer.AdamOptimizer(learning_rate=0.01).minimize(loss)
+
+    # separable synthetic "digits": class leaves a signature block
+    def batch(n=64):
+        y = rng.randint(0, 4, (n, 1)).astype(np.int64)
+        x = rng.randn(n, 64).astype(np.float32) * 0.3
+        for i in range(n):
+            x[i, y[i, 0] * 16:(y[i, 0] + 1) * 16] += 1.5
+        return x, y
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    acc_v = 0.0
+    for step in range(80):
+        xb, yb = batch()
+        l, acc_v = exe.run(prog, feed={"img": xb, "label": yb},
+                           fetch_list=[loss, acc], scope=scope)
+    assert float(acc_v) > 0.9, float(acc_v)
+
+
+def test_recognize_digits_conv():
+    rng = np.random.RandomState(2)
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        img = layers.data("img", [1, 8, 8])
+        label = layers.data("label", [1], dtype="int64")
+        c = layers.conv2d(img, num_filters=4, filter_size=3, padding=1,
+                          act="relu")
+        p = layers.pool2d(c, pool_size=2)
+        logits = layers.fc(p, size=2)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        optimizer.MomentumOptimizer(learning_rate=0.05,
+                                    momentum=0.9).minimize(loss)
+
+    def batch(n=32):
+        y = rng.randint(0, 2, (n, 1)).astype(np.int64)
+        x = rng.randn(n, 1, 8, 8).astype(np.float32) * 0.2
+        x[y[:, 0] == 1, :, 2:6, 2:6] += 1.0
+        return x, y
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    first = None
+    for step in range(40):
+        xb, yb = batch()
+        (l,) = exe.run(prog, feed={"img": xb, "label": yb},
+                       fetch_list=[loss], scope=scope)
+        if first is None:
+            first = float(l)
+    assert float(l) < 0.6 * first, (first, float(l))
+
+
+def test_static_rnn_forward_and_grad():
+    """StaticRNN (recurrent op → lax.scan) computes a running sum RNN and
+    trains parameters through the scan (test_recurrent_op.py analog)."""
+    rng = np.random.RandomState(3)
+    T, B, D, H = 5, 4, 3, 6
+
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        x = layers.data("x", [T, B, D], append_batch_size=False)
+        target = layers.data("target", [B, H], append_batch_size=False)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            h_prev = rnn.memory(shape=(B, H), init_value=0.0)
+            h = layers.fc([xt, h_prev], size=H, act="tanh",
+                          bias_attr=True, name="rnn_fc")
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        outs = rnn()
+        # last frame
+        last = layers.crop(outs, offsets=[T - 1, 0, 0], shape=[1, B, H])
+        last = layers.reshape(last, [B, H])
+        loss = layers.mean(layers.square_error_cost(last, target))
+        optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    xb = rng.randn(T, B, D).astype(np.float32)
+    tb = rng.rand(B, H).astype(np.float32) * 0.5
+    losses = []
+    for _ in range(50):
+        (l,) = exe.run(prog, feed={"x": xb, "target": tb},
+                       fetch_list=[loss], scope=scope)
+        losses.append(float(l))
+    assert losses[-1] < 0.2 * losses[0], losses[::10]
+
+
+def test_uniform_gaussian_random_ops():
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        u = prog.global_block().create_var()
+        prog.global_block().append_op(
+            "uniform_random", outputs={"Out": u},
+            attrs={"shape": [1000], "min": -1.0, "max": 1.0})
+        g = prog.global_block().create_var()
+        prog.global_block().append_op(
+            "gaussian_random", outputs={"Out": g},
+            attrs={"shape": [1000], "mean": 0.0, "std": 1.0})
+    exe = fluid.Executor()
+    uv, gv = exe.run(prog, fetch_list=[u, g], scope=fluid.Scope(), seed=42)
+    assert -1.0 <= uv.min() and uv.max() <= 1.0
+    assert abs(float(gv.mean())) < 0.2 and 0.7 < float(gv.std()) < 1.3
+
+
+def test_program_printing_and_prune():
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        x = layers.data("x", [4])
+        h = layers.fc(x, size=3, act="relu")
+        loss = layers.mean(h)
+    s = prog.to_string()
+    assert "mul" in s and "param" in s

@@ -1,0 +1,283 @@
+"""Fluid op tests: the OpTest harness analog.
+
+Reference: python/paddle/v2/framework/tests/op_test.py — build the op in a
+small program, check forward output against a numpy reference
+(check_output_with_place, op_test.py:286) and analytic-vs-numeric gradients
+(get_numeric_gradient op_test.py:97, check_grad :388). 96 per-op test files
+collapse here into one harness + table-driven cases.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.framework import grad_name
+
+
+class OpTest:
+    """Run one op in a fresh program; check outputs and gradients."""
+
+    def __init__(self, op_type, inputs, attrs=None, out_slots=("Out",)):
+        self.op_type = op_type
+        self.inputs = inputs            # slot -> np array or list of arrays
+        self.attrs = attrs or {}
+        self.out_slots = out_slots
+
+    def _build(self):
+        prog = fluid.Program()
+        with fluid.program_guard(prog):
+            in_vars, feed = {}, {}
+            for slot, arrs in self.inputs.items():
+                arrs_l = arrs if isinstance(arrs, list) else [arrs]
+                vs = []
+                for i, a in enumerate(arrs_l):
+                    name = f"{slot.lower()}_{i}"
+                    if isinstance(a, fluid.LoDArray):
+                        v = layers.data(name, a.data.shape,
+                                        dtype=str(a.data.dtype),
+                                        lod_level=len(a.lod),
+                                        append_batch_size=False)
+                    else:
+                        v = layers.data(name, a.shape, dtype=str(a.dtype),
+                                        append_batch_size=False)
+                    v.stop_gradient = False
+                    vs.append(v)
+                    feed[name] = a
+                in_vars[slot] = vs
+            outs = {s: prog.global_block().create_var()
+                    for s in self.out_slots}
+            prog.global_block().append_op(
+                self.op_type, inputs=in_vars,
+                outputs={s: [v] for s, v in outs.items()},
+                attrs=self.attrs)
+        return prog, feed, in_vars, outs
+
+    def check_output(self, expect, atol=1e-5, slot=None):
+        prog, feed, _, outs = self._build()
+        slot = slot or self.out_slots[0]
+        exe = fluid.Executor()
+        (got,) = exe.run(prog, feed=feed, fetch_list=[outs[slot]],
+                         scope=fluid.Scope())
+        np.testing.assert_allclose(got, expect, atol=atol, rtol=1e-4)
+        return got
+
+    def check_grad(self, wrt, out_slot=None, delta=5e-3, atol=2e-3):
+        """Numeric-vs-analytic gradient of mean(out) w.r.t. input `wrt`."""
+        prog, feed, in_vars, outs = self._build()
+        out_slot = out_slot or self.out_slots[0]
+        with fluid.program_guard(prog):
+            loss = layers.mean(outs[out_slot])
+        slot, idx = wrt if isinstance(wrt, tuple) else (wrt, 0)
+        target = in_vars[slot][idx]
+        fluid.append_backward(loss, parameter_list=[])
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        analytic = exe.run(prog, feed=feed,
+                           fetch_list=[grad_name(target.name)],
+                           scope=scope)[0]
+
+        base = feed[target.name].astype(np.float64)
+        numeric = np.zeros_like(base)
+
+        def eval_loss(arr):
+            f2 = dict(feed)
+            f2[target.name] = arr.astype(feed[target.name].dtype)
+            return float(exe.run(prog, feed=f2, fetch_list=[loss],
+                                 scope=scope)[0])
+
+        flat = base.reshape(-1)
+        num_flat = numeric.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + delta
+            up = eval_loss(base)
+            flat[i] = orig - delta
+            down = eval_loss(base)
+            flat[i] = orig
+            num_flat[i] = (up - down) / (2 * delta)
+        np.testing.assert_allclose(analytic, numeric, atol=atol, rtol=1e-2)
+
+
+RNG = np.random.RandomState(7)
+
+
+def test_elementwise_ops():
+    x = RNG.randn(4, 5).astype(np.float32)
+    y = RNG.randn(4, 5).astype(np.float32)
+    OpTest("elementwise_add", {"X": x, "Y": y}).check_output(x + y)
+    OpTest("elementwise_mul", {"X": x, "Y": y}).check_output(x * y)
+    OpTest("elementwise_max", {"X": x, "Y": y}).check_output(
+        np.maximum(x, y))
+
+
+def test_elementwise_broadcast_axis():
+    x = RNG.randn(2, 3, 4).astype(np.float32)
+    y = RNG.randn(3,).astype(np.float32)
+    OpTest("elementwise_add", {"X": x, "Y": y}, {"axis": 1}).check_output(
+        x + y[None, :, None])
+
+
+def test_mul_and_grad():
+    x = RNG.randn(3, 4).astype(np.float32)
+    w = RNG.randn(4, 5).astype(np.float32)
+    t = OpTest("mul", {"X": x, "Y": w})
+    t.check_output(x @ w)
+    t.check_grad("X")
+    t.check_grad("Y")
+
+
+def test_activation_grads():
+    x = (RNG.randn(3, 4) * 2).astype(np.float32)
+    OpTest("sigmoid", {"X": x}).check_output(1 / (1 + np.exp(-x)))
+    OpTest("tanh", {"X": x}).check_grad("X")
+    OpTest("square", {"X": x}).check_grad("X")
+    OpTest("stanh", {"X": x}).check_grad("X")
+
+
+def test_softmax_cross_entropy():
+    logits = RNG.randn(4, 6).astype(np.float32)
+    label = RNG.randint(0, 6, (4, 1)).astype(np.int64)
+    t = OpTest("softmax_with_cross_entropy",
+               {"Logits": logits, "Label": label},
+               out_slots=("Softmax", "Loss"))
+    m = logits - logits.max(-1, keepdims=True)
+    p = np.exp(m) / np.exp(m).sum(-1, keepdims=True)
+    expect = -np.log(p[np.arange(4), label.ravel()])[:, None]
+    t.check_output(expect, slot="Loss")
+    t.check_grad("Logits", out_slot="Loss")
+
+
+def test_conv2d_and_grad():
+    x = RNG.randn(2, 3, 8, 8).astype(np.float32)
+    w = RNG.randn(4, 3, 3, 3).astype(np.float32)
+    t = OpTest("conv2d", {"Input": x, "Filter": w},
+               {"strides": 1, "paddings": 1}, out_slots=("Output",))
+    t.check_grad("Filter", out_slot="Output", delta=1e-2, atol=5e-3)
+
+
+def test_pool2d():
+    x = RNG.randn(2, 3, 6, 6).astype(np.float32)
+    t = OpTest("pool2d", {"X": x},
+               {"ksize": 2, "strides": 2, "pooling_type": "max"})
+    expect = x.reshape(2, 3, 3, 2, 3, 2).max(axis=(3, 5))
+    t.check_output(expect)
+
+
+def test_reduce_and_shape_ops():
+    x = RNG.randn(3, 4).astype(np.float32)
+    OpTest("reduce_sum", {"X": x}, {"dim": 1, "reduce_all": False}
+           ).check_output(x.sum(1))
+    OpTest("reshape", {"X": x}, {"shape": [4, 3]}).check_output(
+        x.reshape(4, 3))
+    OpTest("transpose", {"X": x}, {"axis": [1, 0]}).check_output(x.T)
+    OpTest("pad", {"X": x}, {"paddings": [1, 0, 0, 2]}).check_output(
+        np.pad(x, ((1, 0), (0, 2))))
+
+
+def test_top_k_accuracy():
+    x = np.array([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1]], np.float32)
+    label = np.array([[1], [2]], np.int64)
+    t = OpTest("top_k", {"X": x}, {"k": 1}, out_slots=("Out", "Indices"))
+    t.check_output(np.array([[1], [0]]), slot="Indices")
+
+
+def test_lookup_table_grad():
+    w = RNG.randn(10, 4).astype(np.float32)
+    ids = np.array([[1], [3], [1]], np.int64)
+    t = OpTest("lookup_table", {"W": w, "Ids": ids})
+    t.check_output(w[[1, 3, 1]])
+    t.check_grad("W")
+
+
+def test_lstm_gru_units():
+    x = RNG.randn(3, 16).astype(np.float32)
+    c = RNG.randn(3, 4).astype(np.float32)
+    t = OpTest("lstm_unit", {"X": x, "C_prev": c}, out_slots=("C", "H"))
+    t.check_grad("X", out_slot="H")
+
+    xi = RNG.randn(3, 12).astype(np.float32)
+    h = RNG.randn(3, 4).astype(np.float32)
+    w = RNG.randn(4, 12).astype(np.float32)
+    t = OpTest("gru_unit", {"Input": xi, "HiddenPrev": h, "Weight": w},
+               out_slots=("Gate", "ResetHiddenPrev", "Hidden"))
+    t.check_grad("Weight", out_slot="Hidden")
+
+
+def test_batch_norm_forward():
+    x = RNG.randn(4, 3, 5, 5).astype(np.float32)
+    scale = np.ones(3, np.float32)
+    bias = np.zeros(3, np.float32)
+    mean = np.zeros(3, np.float32)
+    var = np.ones(3, np.float32)
+    t = OpTest("batch_norm",
+               {"X": x, "Scale": scale, "Bias": bias, "Mean": mean,
+                "Variance": var},
+               out_slots=("Y", "MeanOut", "VarianceOut", "SavedMean",
+                          "SavedVariance"))
+    mu = x.mean(axis=(0, 2, 3))
+    v = x.var(axis=(0, 2, 3))
+    expect = (x - mu[None, :, None, None]) / np.sqrt(
+        v[None, :, None, None] + 1e-5)
+    t.check_output(expect, slot="Y", atol=1e-4)
+
+
+def test_optimizer_ops_numeric():
+    p = RNG.randn(4).astype(np.float32)
+    g = RNG.randn(4).astype(np.float32)
+    lr = np.array([0.1], np.float32)
+    OpTest("sgd", {"Param": p, "Grad": g, "LearningRate": lr},
+           out_slots=("ParamOut",)).check_output(p - 0.1 * g)
+
+    v = np.zeros(4, np.float32)
+    OpTest("momentum",
+           {"Param": p, "Grad": g, "Velocity": v, "LearningRate": lr},
+           {"mu": 0.9}, out_slots=("ParamOut", "VelocityOut")
+           ).check_output(p - 0.1 * g)
+
+
+def test_sequence_pool_lod():
+    data = np.arange(10, dtype=np.float32).reshape(5, 2)
+    lod = ((0, 2, 5),)
+    t = OpTest("sequence_pool", {"X": fluid.LoDArray(data, lod)},
+               {"pooltype": "SUM"})
+    expect = np.stack([data[0:2].sum(0), data[2:5].sum(0)])
+    t.check_output(expect)
+
+
+def test_sequence_softmax_lod():
+    data = RNG.randn(6, 1).astype(np.float32)
+    lod = ((0, 2, 6),)
+    t = OpTest("sequence_softmax", {"X": fluid.LoDArray(data, lod)})
+    d = data.ravel()
+    e = np.exp(d - np.array([d[:2].max()] * 2 + [d[2:].max()] * 4))
+    expect = (e / np.array([e[:2].sum()] * 2 + [e[2:].sum()] * 4)
+              ).reshape(6, 1)
+    t.check_output(expect)
+
+
+def test_registry_inventory():
+    """The op registry must cover the reference's major op families
+    (paddle/operators — SURVEY.md §2.2)."""
+    ops = set(fluid.registered_ops())
+    required = {
+        "elementwise_add", "elementwise_sub", "elementwise_mul",
+        "elementwise_div", "elementwise_pow", "mul", "matmul", "conv2d",
+        "conv2d_transpose", "conv3d", "pool2d", "pool2d_with_index",
+        "batch_norm", "softmax", "softmax_with_cross_entropy",
+        "cross_entropy", "sigmoid_cross_entropy_with_logits",
+        "lookup_table", "lstm_unit", "gru_unit", "recurrent",
+        "sequence_concat", "sequence_pool", "sequence_softmax",
+        "sequence_expand", "reduce_sum", "reduce_mean", "reshape",
+        "transpose", "pad", "crop", "clip", "split", "concat", "scale",
+        "cast", "top_k", "accuracy", "sgd", "momentum", "adam", "adamax",
+        "adagrad", "adadelta", "rmsprop", "proximal_gd", "decayed_adagrad",
+        "uniform_random", "gaussian_random", "fill_constant",
+        "fill_zeros_like", "mean", "sum", "minus", "squared_l2_norm",
+        "squared_l2_distance", "rank_loss", "margin_rank_loss",
+        "smooth_l1_loss", "huber_loss", "dropout", "gather", "scatter",
+        "sigmoid", "tanh", "relu", "sqrt", "abs", "reciprocal", "log",
+        "square", "brelu", "soft_relu", "pow", "stanh", "lrn",
+    }
+    missing = required - ops
+    assert not missing, f"missing op families: {sorted(missing)}"
