@@ -190,3 +190,337 @@ def maxid_printer(input, name: Optional[str] = None) -> LayerOutput:
         return jnp.zeros((1,))
 
     return _metric_node(name, "maxid_printer_evaluator", [input], compute)
+
+
+def rankauc(input, label, weight=None, name: Optional[str] = None) -> LayerOutput:
+    """AUC over ranking scores (reference: rankauc_evaluator →
+    RankAucEvaluator.cpp). Same statistic as auc but reads a raw score
+    column instead of a 2-class distribution."""
+    name = name or unique_name("rankauc_evaluator")
+    inputs = [input, label] + ([weight] if weight is not None else [])
+
+    def compute(ctx, p, ins):
+        score = _data_of(ins[0]).reshape(-1)
+        y = _data_of(ins[1]).reshape(-1).astype(jnp.float32)
+        w = (_data_of(ins[2]).reshape(-1) if weight is not None
+             else jnp.ones_like(score))
+        # weighted Mann-Whitney with tie correction, O(N log N): sort by
+        # score; per element find its tie group via searchsorted, then
+        # AUC = sum_neg w_n (P_above + 0.5 P_equal) / (W_pos W_neg)
+        pos_w = w * y
+        neg_w = w * (1.0 - y)
+        order = jnp.argsort(score)
+        s_ = score[order]
+        pw, nw = pos_w[order], neg_w[order]
+        cpos = jnp.cumsum(pw)
+        total_pos = cpos[-1]
+        total_neg = jnp.sum(nw)
+        lo = jnp.searchsorted(s_, s_, side="left")
+        hi = jnp.searchsorted(s_, s_, side="right")
+        pos_below = jnp.where(lo > 0, cpos[jnp.maximum(lo - 1, 0)], 0.0)
+        pos_in_group = cpos[hi - 1] - pos_below
+        pos_above = total_pos - pos_below - pos_in_group
+        num = jnp.sum(nw * (pos_above + 0.5 * pos_in_group))
+        den = jnp.maximum(total_pos * total_neg, 1e-8)
+        return jnp.broadcast_to(num / den, (1,))
+
+    return _metric_node(name, "rankauc_evaluator", inputs, compute)
+
+
+def chunk(input, label, num_chunk_types: int,
+          chunk_scheme: str = "IOB", name: Optional[str] = None) -> LayerOutput:
+    """Chunk F1 for sequence labeling (reference: chunk_evaluator →
+    ChunkEvaluator.cpp). IOB encoding: tag 2t = B-type_t, 2t+1 = I-type_t,
+    2*num_chunk_types = O."""
+    name = name or unique_name("chunk_evaluator")
+    if chunk_scheme not in ("IOB", "plain"):
+        raise ValueError(f"unsupported chunk scheme {chunk_scheme}")
+    plain = chunk_scheme == "plain"
+    # id layout: IOB → 2t=B-t, 2t+1=I-t, O=2T; plain → t=chunk type, O=T
+    O = num_chunk_types if plain else 2 * num_chunk_types
+
+    def type_of(tags):
+        return tags if plain else tags // 2
+
+    def starts_of(tags, prev_tags, valid):
+        """IOB: starts at B-t or non-continuing I-t. plain: starts where
+        the type differs from the previous token's."""
+        in_c = tags < O
+        prev_in = prev_tags < O
+        if plain:
+            cont = in_c & prev_in & (prev_tags == tags)
+            return valid & in_c & ~cont
+        is_b = (tags % 2 == 0) & in_c
+        is_i = (tags % 2 == 1) & in_c
+        cont = is_i & prev_in & (type_of(prev_tags) == type_of(tags))
+        return valid & (is_b | (is_i & ~cont))
+
+    def compute(ctx, p, ins):
+        pred_v, lab_v = ins[0], ins[1]
+        pred = _data_of(pred_v)
+        if pred.ndim > 1 and pred.shape[-1] > 1:
+            pred = jnp.argmax(pred, -1)
+        pred = pred.reshape(-1).astype(jnp.int32)
+        lab = _data_of(lab_v).reshape(-1).astype(jnp.int32)
+        if isinstance(pred_v, SequenceBatch):
+            seg = pred_v.segment_ids
+            valid = pred_v.valid_mask
+        else:
+            seg = jnp.zeros_like(pred)
+            valid = jnp.ones_like(pred, dtype=bool)
+        n = pred.shape[0]
+        idx = jnp.arange(n)
+
+        def shift_prev(tags):
+            prev = jnp.concatenate([jnp.array([O], jnp.int32), tags[:-1]])
+            prev_seg = jnp.concatenate([jnp.array([-1], seg.dtype), seg[:-1]])
+            return jnp.where(seg != prev_seg, O, prev)
+
+        def ends_of(tags, starts):
+            """Chunk ends where in-chunk and the next token starts a new
+            chunk / is O / is another sequence (conlleval endOfChunk)."""
+            in_c = valid & (tags < O)
+            nxt_start = jnp.concatenate([starts[1:], jnp.array([True])])
+            nxt_tag = jnp.concatenate([tags[1:], jnp.array([O], jnp.int32)])
+            nxt_seg = jnp.concatenate([seg[1:], jnp.array([-1], seg.dtype)])
+            nxt_valid = jnp.concatenate([valid[1:], jnp.array([False])])
+            broken = nxt_start | (nxt_tag >= O) | (nxt_seg != seg) | ~nxt_valid
+            return in_c & broken
+
+        ps = starts_of(pred, shift_prev(pred), valid)
+        ls = starts_of(lab, shift_prev(lab), valid)
+        pe = ends_of(pred, ps)
+        le = ends_of(lab, ls)
+        # conlleval: a chunk is correct iff its start, end, and type all
+        # coincide. last_start[i] = most recent start position <= i.
+        last_ps = jax.lax.cummax(jnp.where(ps, idx, -1))
+        last_ls = jax.lax.cummax(jnp.where(ls, idx, -1))
+        safe_p = jnp.maximum(last_ps, 0)
+        safe_l = jnp.maximum(last_ls, 0)
+        type_eq = type_of(pred[safe_p]) == type_of(lab[safe_l])
+        correct = jnp.sum(jnp.where(
+            pe & le & (last_ps == last_ls) & (last_ps >= 0) & type_eq,
+            1.0, 0.0))
+        n_pred = jnp.maximum(jnp.sum(ps.astype(jnp.float32)), 1e-8)
+        n_lab = jnp.maximum(jnp.sum(ls.astype(jnp.float32)), 1e-8)
+        f1 = 2 * correct / (n_pred + n_lab)
+        return jnp.broadcast_to(f1, (1,))
+
+    return _metric_node(name, "chunk_evaluator", [input, label], compute)
+
+
+def ctc_edit_distance(input, label, blank: Optional[int] = None,
+                      name: Optional[str] = None) -> LayerOutput:
+    """Normalized edit distance between the CTC best-path decode of `input`
+    and `label` (reference: ctc_edit_distance → CTCErrorEvaluator.cpp).
+
+    input: prob sequence [tokens, C] (blank defaults to C-1);
+    label: int sequence. Levenshtein runs as a fixed-shape DP over the
+    static capacities (masked past true lengths) under jit."""
+    name = name or unique_name("ctc_edit_distance_evaluator")
+
+    def compute(ctx, p, ins):
+        probs, lab = ins[0], ins[1]
+        blank_id = blank if blank is not None else probs.data.shape[-1] - 1
+        path = jnp.argmax(probs.data, -1).astype(jnp.int32)   # [cap]
+        labd = _data_of(lab).reshape(-1).astype(jnp.int32)
+
+        n_seq = probs.num_seqs
+        capP, capL = path.shape[0], labd.shape[0]
+        segP, segL = probs.segment_ids, lab.segment_ids
+
+        def per_seq(s):
+            # best-path collapse: keep where != prev and != blank
+            in_s = segP == s
+            prev = jnp.concatenate([jnp.array([-1], jnp.int32), path[:-1]])
+            prev_in = jnp.concatenate([jnp.array([False]), in_s[:-1]])
+            keep = in_s & (path != blank_id) & ((path != prev) | ~prev_in)
+            # compact decoded ids to the front (static shape capP)
+            order = jnp.argsort(~keep, stable=True)
+            dec = jnp.where(keep[order], path[order], -1)
+            m = jnp.sum(keep.astype(jnp.int32))
+            lab_in = segL == s
+            orderL = jnp.argsort(~lab_in, stable=True)
+            ref = jnp.where(lab_in[orderL], labd[orderL], -2)
+            n = jnp.sum(lab_in.astype(jnp.int32))
+
+            # Levenshtein DP rows over ref (length capL), cols over dec
+            row0 = jnp.arange(capP + 1, dtype=jnp.float32)
+
+            def dp(row, j_ref):
+                j, r = j_ref
+                active = j < n
+                sub = row[:-1] + jnp.where(dec == r, 0.0, 1.0)
+                dele = row[1:] + 1.0
+
+                def inner(carry, xs):
+                    s_, d_ = xs
+                    best = jnp.minimum(jnp.minimum(s_, d_), carry + 1.0)
+                    return best, best
+                _, rest = jax.lax.scan(inner, row[0] + 1.0, (sub, dele))
+                new_row = jnp.concatenate([(row[0] + 1.0)[None], rest])
+                return jnp.where(active, new_row, row), None
+
+            rowN, _ = jax.lax.scan(
+                dp, row0, (jnp.arange(capL), ref))
+            dist = rowN[m]
+            return dist / jnp.maximum(n.astype(jnp.float32), 1.0)
+
+        dists = jax.vmap(per_seq)(jnp.arange(n_seq))
+        return jnp.mean(dists)[None]
+
+    return _metric_node(name, "ctc_edit_distance_evaluator", [input, label],
+                        compute)
+
+
+def detection_map(detections, label, num_classes: int, keep_top_k: int,
+                  max_boxes: int = 16, overlap_threshold: float = 0.5,
+                  background_id: int = 0,
+                  name: Optional[str] = None) -> LayerOutput:
+    """11-point interpolated mAP over a batch (reference:
+    detection_map_evaluator → DetectionMAPEvaluator.cpp).
+
+    detections: detection_output layer ([B, K*6] label/score/box rows);
+    label: dense [B, max_boxes*5] gt (class, box), class<0 = pad."""
+    from paddle_tpu.ops.detection import iou_matrix
+    name = name or unique_name("detection_map_evaluator")
+
+    def compute(ctx, p, ins):
+        det = _data_of(ins[0]).reshape(-1, keep_top_k, 6)
+        gt = _data_of(ins[1]).reshape(det.shape[0], max_boxes, 5)
+
+        def tp_flags(det_i, gt_i):
+            """Greedy match in (already score-sorted) order; one gt each."""
+            iou = iou_matrix(det_i[:, 2:6], gt_i[:, 1:5])   # [K, G]
+            cls_ok = det_i[:, 0:1] == gt_i[None, :, 0]
+            valid_gt = gt_i[None, :, 0] >= 0
+            cand = iou * jnp.where(cls_ok & valid_gt, 1.0, 0.0)
+
+            def body(used, k):
+                row = jnp.where(used, 0.0, cand[k])
+                j = jnp.argmax(row)
+                hit = (row[j] >= overlap_threshold) & (det_i[k, 0] >= 0)
+                used = used.at[j].set(used[j] | hit)
+                return used, hit
+            _, hits = jax.lax.scan(body,
+                                   jnp.zeros(gt_i.shape[0], bool),
+                                   jnp.arange(det_i.shape[0]))
+            return hits
+
+        hits = jax.vmap(tp_flags)(det, gt)                  # [B, K]
+        flat_scores = jnp.where(det[:, :, 0] >= 0, det[:, :, 1],
+                                -jnp.inf).reshape(-1)
+        flat_cls = det[:, :, 0].reshape(-1)
+        flat_tp = hits.reshape(-1).astype(jnp.float32)
+        order = jnp.argsort(-flat_scores)
+        tp_sorted = flat_tp[order]
+        valid = jnp.isfinite(flat_scores[order])
+        cls_sorted = flat_cls[order]
+
+        def ap_for(c):
+            sel = (cls_sorted == c) & valid
+            tp_c = jnp.where(sel, tp_sorted, 0.0)
+            cum_tp = jnp.cumsum(tp_c)
+            cum_n = jnp.cumsum(sel.astype(jnp.float32))
+            n_gt = jnp.sum(jnp.where(gt[:, :, 0] == c, 1.0, 0.0))
+            prec = cum_tp / jnp.maximum(cum_n, 1.0)
+            rec = cum_tp / jnp.maximum(n_gt, 1.0)
+            pts = jnp.linspace(0.0, 1.0, 11)
+            ap = jnp.mean(jax.vmap(
+                lambda r: jnp.max(jnp.where(rec >= r, prec, 0.0)))(pts))
+            return jnp.where(n_gt > 0, ap, jnp.nan)
+
+        cls_ids = jnp.array([c for c in range(num_classes)
+                             if c != background_id])
+        aps = jax.vmap(ap_for)(cls_ids.astype(jnp.float32))
+        return jnp.nanmean(aps)[None]
+
+    return _metric_node(name, "detection_map_evaluator",
+                        [detections, label], compute)
+
+
+def gradient_printer(input, name: Optional[str] = None) -> LayerOutput:
+    """Prints the gradient flowing through this node during backward
+    (reference: gradient_printer_evaluator). Implemented as an identity
+    with a custom vjp that debug-prints its cotangent — faithful to the
+    reference even though autodiff is whole-program here."""
+    name = name or unique_name("gradient_printer_evaluator")
+
+    @jax.custom_vjp
+    def probe(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, g):
+        jax.debug.print(name + " grad: {}", g)
+        return (g,)
+
+    probe.defvjp(fwd, bwd)
+
+    def compute(ctx, p, ins):
+        v = ins[0]
+        d = probe(_data_of(v))
+        if isinstance(v, SequenceBatch):
+            return v.with_data(d)
+        return d
+
+    node = _metric_node(name, "gradient_printer_evaluator", [input], compute)
+    node.size = input.size
+    node.is_sequence = input.is_sequence
+    return node
+
+
+def max_frame_printer(input, name: Optional[str] = None) -> LayerOutput:
+    """Prints the frame with the max value per sequence (reference:
+    max_frame_printer_evaluator)."""
+    name = name or unique_name("max_frame_printer_evaluator")
+
+    def compute(ctx, p, ins):
+        v = ins[0]
+        d = _data_of(v)
+        score = d.reshape(d.shape[0], -1).max(-1)
+        if isinstance(v, SequenceBatch):
+            score = jnp.where(v.valid_mask, score, -jnp.inf)
+        jax.debug.print(name + ": frame {}", jnp.argmax(score))
+        return jnp.zeros((1,))
+
+    return _metric_node(name, "max_frame_printer_evaluator", [input], compute)
+
+
+def seq_text_printer(input, name: Optional[str] = None) -> LayerOutput:
+    """Prints sequence token ids (reference: seq_text_printer_evaluator;
+    the id→word file mapping is host-side in the reference too)."""
+    name = name or unique_name("seq_text_printer_evaluator")
+
+    def compute(ctx, p, ins):
+        v = ins[0]
+        d = _data_of(v)
+        ids = d if d.ndim == 1 else jnp.argmax(d, -1)
+        jax.debug.print(name + ": {}", ids)
+        return jnp.zeros((1,))
+
+    return _metric_node(name, "seq_text_printer_evaluator", [input], compute)
+
+
+def classification_error_printer(input, label,
+                                 name: Optional[str] = None) -> LayerOutput:
+    """Prints the per-sample 0/1 error vector (reference:
+    classification_error_printer_evaluator)."""
+    name = name or unique_name("classification_error_printer_evaluator")
+
+    def compute(ctx, p, ins):
+        logits = _data_of(ins[0])
+        y = _data_of(ins[1]).reshape(-1).astype(jnp.int32)
+        err = (jnp.argmax(logits, -1).astype(jnp.int32) != y)
+        jax.debug.print(name + ": {}", err.astype(jnp.int32))
+        return jnp.zeros((1,))
+
+    return _metric_node(name, "classification_error_printer_evaluator",
+                        [input, label], compute)
+
+
+__all__ += ["rankauc", "chunk", "ctc_edit_distance", "detection_map",
+            "gradient_printer", "max_frame_printer", "seq_text_printer",
+            "classification_error_printer"]

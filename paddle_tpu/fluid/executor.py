@@ -17,6 +17,7 @@ op applications; gradient fan-in is summed here (the reference emits add ops).
 
 from __future__ import annotations
 
+import zlib
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -61,8 +62,10 @@ def global_scope() -> Scope:
 
 def _init_value(var: Parameter, seed: int) -> np.ndarray:
     """Materialise a parameter initializer (initializer.py analog)."""
+    # crc32, not hash(): python string hashing is process-randomized and
+    # would give every process (and host) different initial weights
     rng = np.random.RandomState(
-        (seed * 2654435761 + hash(var.name)) % (2 ** 31))
+        (seed * 2654435761 + zlib.crc32(var.name.encode())) % (2 ** 31))
     init = var.initializer or {"type": "xavier"}
     kind = init.get("type", "xavier")
     shape = var.shape
@@ -132,8 +135,7 @@ class Executor:
                tuple(sorted((k, _abstract(v)) for k, v in feed.items())))
         fn = self._cache.get(key)
         if fn is None:
-            fn = self._compile(program, fetch_names, is_test,
-                               sorted(feed), persist_names)
+            fn = self._compile(program, fetch_names, is_test, persist_names)
             self._cache[key] = fn
 
         rng = jax.random.PRNGKey(self._step if seed is None else seed)
@@ -168,7 +170,7 @@ class Executor:
 
     # ------------------------------------------------------------------
     def _compile(self, program: Program, fetch_names, is_test,
-                 feed_names, persist_names):
+                 persist_names):
         block = program.global_block()
         written_persist = [
             n for n in persist_names

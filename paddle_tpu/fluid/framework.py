@@ -251,6 +251,8 @@ class Program:
         return b
 
     def rollback(self) -> None:
+        enforce_that(self._current_block_idx != 0,
+                     "rollback() at the global block", context="fluid")
         self._current_block_idx = self.current_block().parent_idx
 
     # -- introspection ------------------------------------------------------

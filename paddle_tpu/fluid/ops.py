@@ -344,7 +344,7 @@ def _pool2d(ins, attrs, ctx):
                           attrs.get("pooling_type", "max"))]}
 
 
-@register("pool2d_with_index", family="pool", no_grad=False)
+@register("pool2d_with_index", family="pool", no_grad=True)
 def _pool2d_with_index(ins, attrs, ctx):
     x = _dat(_one(ins, "X"))
     k, s = _pair(attrs.get("ksize", 2)), _pair(attrs.get("strides", 1))

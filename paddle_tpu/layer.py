@@ -1950,3 +1950,678 @@ def lstm_step_state(step_node, name: Optional[str] = None) -> LayerOutput:
 
 
 __all__ += ["lstm_step_output", "lstm_step_state"]
+
+
+# ---------------------------------------------------------------------------
+# round-2 completeness batch: the remaining registered layer types of the
+# reference (REGISTER_LAYER list, SURVEY.md §2.1 "Layers (95 types)")
+# ---------------------------------------------------------------------------
+
+
+@_export
+def prelu(input, partial_sum: int = 1, param_attr=None,
+          name: Optional[str] = None) -> LayerOutput:
+    """Parametric ReLU; one slope per group of `partial_sum` features
+    (reference: prelu_layer → ParameterReluLayer.cpp)."""
+    inp = input
+    name = name or unique_name("prelu")
+    enforce_that(inp.size % partial_sum == 0,
+                 "prelu partial_sum must divide input size", context="prelu")
+    n_slopes = inp.size // partial_sum
+    params = {"w": ParamSpec((n_slopes,), ParamAttr.to_attr(param_attr))}
+
+    def compute(ctx, p, ins):
+        v = ins[0]
+        x = _data_of(v)
+        flat = x.reshape(x.shape[0], n_slopes, partial_sum)
+        slope = p["w"].reshape(1, n_slopes, 1)
+        y = jnp.where(flat >= 0, flat, slope * flat).reshape(x.shape)
+        return _like(v, y)
+
+    node = LayerOutput(name=name, layer_type="prelu", inputs=[inp],
+                       fn=compute, params=params, size=inp.size,
+                       is_sequence=inp.is_sequence)
+    return _propagate_img_shape(node, inp)
+
+
+@_export
+def scale_shift(input, param_attr=None, bias_attr=True,
+                name: Optional[str] = None) -> LayerOutput:
+    """y = w * x + b with scalar w, b (reference: scale_shift_layer →
+    ScaleShiftLayer.cpp)."""
+    inp = input
+    name = name or unique_name("scale_shift")
+    params = {"w": ParamSpec((1,), ParamAttr.to_attr(param_attr))}
+    has_bias = bool(bias_attr)
+    if has_bias:
+        params["b"] = ParamSpec((1,), ParamAttr.to_attr(
+            None if bias_attr is True else bias_attr))
+
+    def compute(ctx, p, ins):
+        v = ins[0]
+        y = _data_of(v) * p["w"][0]
+        if has_bias:
+            y = y + p["b"][0]
+        return _like(v, y)
+
+    return LayerOutput(name=name, layer_type="scale_shift", inputs=[inp],
+                       fn=compute, params=params, size=inp.size,
+                       is_sequence=inp.is_sequence)
+
+
+@_export
+def data_norm(input, mean=None, std=None, mode: str = "z-score",
+              name: Optional[str] = None) -> LayerOutput:
+    """Input normalization with fixed statistics (reference: data_norm_layer
+    → DataNormLayer.cpp; stats are precomputed, never trained).
+
+    mean/std are python arrays or scalars; mode ∈ {z-score, min-max,
+    decimal-scaling} (min-max interprets mean/std as min/range)."""
+    inp = input
+    name = name or unique_name("data_norm")
+    mean_a = jnp.asarray(0.0 if mean is None else mean, jnp.float32)
+    std_a = jnp.asarray(1.0 if std is None else std, jnp.float32)
+
+    def compute(ctx, p, ins):
+        v = ins[0]
+        x = _data_of(v)
+        if mode == "z-score":
+            y = (x - mean_a) / jnp.maximum(std_a, 1e-8)
+        elif mode == "min-max":
+            y = (x - mean_a) / jnp.maximum(std_a, 1e-8)
+        elif mode == "decimal-scaling":
+            y = x / jnp.power(10.0, jnp.ceil(jnp.log10(
+                jnp.maximum(std_a, 1e-8))))
+        else:
+            raise EnforceError(f"bad data_norm mode {mode}", context="data_norm")
+        return _like(v, y)
+
+    return LayerOutput(name=name, layer_type="data_norm", inputs=[inp],
+                       fn=compute, size=inp.size,
+                       is_sequence=inp.is_sequence)
+
+
+@_export
+def trans(input, name: Optional[str] = None) -> LayerOutput:
+    """Transpose the (flattened) feature matrix of a non-sequence batch
+    (reference: trans_layer → TransLayer.cpp: batch-size x size matrix
+    transposed). Output batch dim becomes the feature dim."""
+    inp = input
+    name = name or unique_name("trans")
+
+    def compute(ctx, p, ins):
+        return _data_of(ins[0]).T
+
+    return LayerOutput(name=name, layer_type="trans", inputs=[inp],
+                       fn=compute, size=None, is_sequence=False)
+
+
+@_export
+def switch_order(input, reshape_to=("h", "w", "c"),
+                 name: Optional[str] = None) -> LayerOutput:
+    """Switch image memory layout between HWC and CHW flattenings
+    (reference: switch_order_layer → SwitchOrderLayer.cpp)."""
+    inp = input
+    name = name or unique_name("switch_order")
+    in_shape = _img_shape_of(inp)
+    enforce_that(in_shape is not None, "switch_order needs image shape",
+                 context="switch_order")
+    h, w, c = in_shape
+    to_hwc = tuple(reshape_to) == ("h", "w", "c")
+
+    def compute(ctx, p, ins):
+        x = _data_of(ins[0])
+        n = x.shape[0]
+        if to_hwc:   # stored CHW → emit HWC
+            y = x.reshape(n, c, h, w).transpose(0, 2, 3, 1)
+        else:        # stored HWC → emit CHW
+            y = x.reshape(n, h, w, c).transpose(0, 3, 1, 2)
+        return y.reshape(n, -1)
+
+    node = LayerOutput(name=name, layer_type="switch_order", inputs=[inp],
+                       fn=compute, size=inp.size)
+    node.img_shape = (h, w, c)
+    return node
+
+
+@_export
+def tensor(a, b, size: int, act=None, param_attr=None,
+           name: Optional[str] = None) -> LayerOutput:
+    """Bilinear tensor product: out[k] = a · W_k · bᵀ (reference:
+    tensor_layer → TensorLayer.cpp)."""
+    name = name or unique_name("tensor")
+    activation = _resolve_act(act)
+    params = {"w": ParamSpec((size, a.size, b.size),
+                             ParamAttr.to_attr(param_attr))}
+
+    def compute(ctx, p, ins):
+        x, y = _data_of(ins[0]), _data_of(ins[1])
+        out = jnp.einsum("bi,kij,bj->bk", x, p["w"], y)
+        return _apply_act(activation, out)
+
+    return LayerOutput(name=name, layer_type="tensor", inputs=[a, b],
+                       fn=compute, params=params, size=size)
+
+
+@_export
+def out_prod(a, b, name: Optional[str] = None) -> LayerOutput:
+    """Row-wise outer product, flattened (reference: out_prod_layer →
+    OuterProdLayer.cpp)."""
+    name = name or unique_name("out_prod")
+
+    def compute(ctx, p, ins):
+        x, y = _data_of(ins[0]), _data_of(ins[1])
+        return jnp.einsum("bi,bj->bij", x, y).reshape(x.shape[0], -1)
+
+    return LayerOutput(name=name, layer_type="out_prod", inputs=[a, b],
+                       fn=compute, size=a.size * b.size)
+
+
+@_export
+def multiplex(index, inputs, name: Optional[str] = None) -> LayerOutput:
+    """Row-wise select among candidate layers by index layer (reference:
+    multiplex_layer → MultiplexLayer.cpp)."""
+    cands = _as_list(inputs)
+    name = name or unique_name("multiplex")
+
+    def compute(ctx, p, ins):
+        idx = _data_of(ins[0]).reshape(-1).astype(jnp.int32)
+        stack = jnp.stack([_data_of(v) for v in ins[1:]], axis=0)  # [K,B,D]
+        return jnp.take_along_axis(
+            stack, idx[None, :, None], axis=0)[0]
+
+    return LayerOutput(name=name, layer_type="multiplex",
+                       inputs=[index] + cands, fn=compute,
+                       size=cands[0].size)
+
+
+@_export
+def conv_shift(a, b, name: Optional[str] = None) -> LayerOutput:
+    """Circular convolution of each row of `a` with the (odd-width) kernel
+    rows of `b` (reference: conv_shift_layer → ConvShiftLayer.cpp; used by
+    NTM-style addressing)."""
+    name = name or unique_name("conv_shift")
+    enforce_that(b.size % 2 == 1, "conv_shift kernel width must be odd",
+                 context="conv_shift")
+    half = b.size // 2
+
+    def compute(ctx, p, ins):
+        x, k = _data_of(ins[0]), _data_of(ins[1])
+        m = x.shape[1]
+        shifts = [jnp.roll(x, half - j, axis=1) for j in range(k.shape[1])]
+        stack = jnp.stack(shifts, axis=-1)            # [B, M, K]
+        return jnp.einsum("bmk,bk->bm", stack, k)
+
+    return LayerOutput(name=name, layer_type="conv_shift", inputs=[a, b],
+                       fn=compute, size=a.size)
+
+
+@_export
+def linear_comb(weights, vectors, size: int,
+                name: Optional[str] = None) -> LayerOutput:
+    """Weighted combination of M sub-vectors: out = Σ_m w[:,m]·x[:,m,:]
+    (reference: linear_comb_layer / convex_comb_layer →
+    LinearChainCRF... LinearCombLayer.cpp)."""
+    name = name or unique_name("linear_comb")
+
+    def compute(ctx, p, ins):
+        w, x = _data_of(ins[0]), _data_of(ins[1])
+        m = w.shape[1]
+        return jnp.einsum("bm,bmd->bd", w, x.reshape(x.shape[0], m, size))
+
+    return LayerOutput(name=name, layer_type="linear_comb",
+                       inputs=[weights, vectors], fn=compute, size=size)
+
+
+@_export
+def convex_comb(weights, vectors, size: int,
+                name: Optional[str] = None) -> LayerOutput:
+    """Alias of linear_comb (reference registers convex_comb as the same
+    layer)."""
+    return linear_comb(weights, vectors, size, name=name)
+
+
+@_export
+def cos_vm(a, b, size: int, scale: float = 1.0,
+           name: Optional[str] = None) -> LayerOutput:
+    """Cosine similarity of vector `a` against each of the M rows packed in
+    `b` (reference: cos_vm → CosSimVecMatLayer.cpp)."""
+    name = name or unique_name("cos_vm")
+
+    def compute(ctx, p, ins):
+        x, y = _data_of(ins[0]), _data_of(ins[1])
+        m = y.shape[1] // x.shape[1]
+        ym = y.reshape(y.shape[0], m, x.shape[1])
+        num = jnp.einsum("bd,bmd->bm", x, ym)
+        den = (jnp.linalg.norm(x, axis=1, keepdims=True)
+               * jnp.linalg.norm(ym, axis=2))
+        return scale * num / jnp.maximum(den, 1e-8)
+
+    return LayerOutput(name=name, layer_type="cos_vm", inputs=[a, b],
+                       fn=compute, size=size)
+
+
+@_export
+def row_conv(input, context_len: int, act=None, param_attr=None,
+             name: Optional[str] = None) -> LayerOutput:
+    """Lookahead row convolution over future frames within each sequence
+    (reference: row_conv_layer → RowConvLayer.cpp, Deep Speech 2)."""
+    inp = input
+    _need_seq(inp, "row_conv")
+    name = name or unique_name("row_conv")
+    activation = _resolve_act(act)
+    params = {"w": ParamSpec((context_len, inp.size),
+                             ParamAttr.to_attr(param_attr))}
+
+    def compute(ctx, p, ins):
+        sb = ins[0]
+        x, seg = sb.data, sb.segment_ids
+        total = jnp.zeros_like(x)
+        cap = x.shape[0]
+        for j in range(context_len):
+            shifted = jnp.concatenate(
+                [x[j:], jnp.zeros((j,) + x.shape[1:], x.dtype)], axis=0)
+            seg_sh = jnp.concatenate(
+                [seg[j:], jnp.full((j,), -1, seg.dtype)], axis=0)
+            ok = (seg_sh == seg)[:, None]
+            total = total + jnp.where(ok, shifted * p["w"][j][None, :], 0.0)
+        return sb.with_data(_apply_act(activation, total))
+
+    return LayerOutput(name=name, layer_type="row_conv", inputs=[inp],
+                       fn=compute, params=params, size=inp.size,
+                       is_sequence=True)
+
+
+@_export
+def subseq(input, offsets, sizes, name: Optional[str] = None) -> LayerOutput:
+    """Per-sequence sub-range [offset, offset+size) (reference: subseq →
+    SubSequenceLayer.cpp); offsets/sizes are int layers, one per sequence."""
+    inp = input
+    _need_seq(inp, "subseq")
+    name = name or unique_name("subseq")
+
+    def compute(ctx, p, ins):
+        sb = ins[0]
+        s = _data_of(ins[1]).reshape(-1).astype(jnp.int32)
+        n = _data_of(ins[2]).reshape(-1).astype(jnp.int32)
+        return pseq.seq_slice(sb, s, s + n)
+
+    return LayerOutput(name=name, layer_type="subseq",
+                       inputs=[inp, offsets, sizes], fn=compute,
+                       size=inp.size, is_sequence=True)
+
+
+@_export
+def featmap_expand(input, num_filters: int, as_row_vector: bool = True,
+                   name: Optional[str] = None) -> LayerOutput:
+    """Tile each feature map `num_filters` times (reference:
+    featmap_expand → FeatureMapExpandLayer.cpp)."""
+    inp = input
+    name = name or unique_name("featmap_expand")
+
+    def compute(ctx, p, ins):
+        v = ins[0]
+        x = _data_of(v)
+        if as_row_vector:
+            y = jnp.tile(x, (1, num_filters))
+        else:
+            y = jnp.repeat(x, num_filters, axis=1)
+        return _like(v, y)
+
+    return LayerOutput(name=name, layer_type="featmap_expand", inputs=[inp],
+                       fn=compute, size=inp.size * num_filters,
+                       is_sequence=inp.is_sequence)
+
+
+@_export
+def get_output(input, arg_name: str = "default",
+               name: Optional[str] = None) -> LayerOutput:
+    """Expose a named internal output of a multi-output layer (reference:
+    get_output_layer → GetOutputLayer.cpp). For lstm step nodes,
+    arg_name="state" selects c_t (the reference's 'state' output)."""
+    if arg_name in ("state", "cell") and getattr(input, "lstm_size", None):
+        return lstm_step_state(input, name=name)
+    inp = input
+    name = name or unique_name("get_output")
+
+    def compute(ctx, p, ins):
+        return ins[0]
+
+    node = LayerOutput(name=name, layer_type="get_output", inputs=[inp],
+                       fn=compute, size=inp.size,
+                       is_sequence=inp.is_sequence)
+    return _propagate_img_shape(node, inp)
+
+
+@_export
+def print_layer(input, format: Optional[str] = None,
+                name: Optional[str] = None) -> LayerOutput:
+    """Debug-print the input at step time (reference: print layer →
+    PrintLayer.cpp). jax.debug.print fires from inside the compiled
+    program; the layer passes its input through unchanged."""
+    inp = input
+    name = name or unique_name("print")
+    fmt = format or (name + ": {x}")
+
+    def compute(ctx, p, ins):
+        v = ins[0]
+        jax.debug.print(fmt, x=_data_of(v))
+        return v
+
+    node = LayerOutput(name=name, layer_type="print", inputs=[inp],
+                       fn=compute, size=inp.size,
+                       is_sequence=inp.is_sequence)
+    return _propagate_img_shape(node, inp)
+
+
+# ---------------------------------------------------------------------------
+# 3-D convolution stack (reference: Conv3DLayer/DeConv3DLayer/Pool3DLayer)
+# ---------------------------------------------------------------------------
+
+
+def _vol_shape_of(node: LayerOutput):
+    """(D, H, W, C) metadata threaded through the 3-D stack."""
+    return getattr(node, "vol_shape", None)
+
+
+@_export
+def img_conv3d(input, filter_size, num_filters: int, num_channels=None,
+               stride: int = 1, padding: int = 0, act=None,
+               bias_attr=True, param_attr=None, trans: bool = False,
+               depth: int = None, height: int = None, width: int = None,
+               name: Optional[str] = None) -> LayerOutput:
+    """3-D (de)convolution, NDHWC on the MXU (reference: conv3d/deconv3d →
+    Conv3DLayer.cpp / DeConv3DLayer.cpp)."""
+    inp = input
+    name = name or unique_name("conv3d")
+    activation = _resolve_act(act)
+    vol = _vol_shape_of(inp)
+    if vol is None:
+        enforce_that(None not in (depth, height, width, num_channels),
+                     "img_conv3d needs vol shape metadata or "
+                     "depth/height/width/num_channels", context="conv3d")
+        vol = (depth, height, width, num_channels)
+    d, h, w, c = vol
+    k = (filter_size,) * 3 if isinstance(filter_size, int) \
+        else tuple(filter_size)
+    if trans:
+        od = (d - 1) * stride + k[0] - 2 * padding
+        oh = (h - 1) * stride + k[1] - 2 * padding
+        ow = (w - 1) * stride + k[2] - 2 * padding
+    else:
+        od = _conv_out_dim(d, k[0], padding, stride)
+        oh = _conv_out_dim(h, k[1], padding, stride)
+        ow = _conv_out_dim(w, k[2], padding, stride)
+    wshape = k + ((num_filters, c) if trans else (c, num_filters))
+    params = {"w": ParamSpec(wshape, ParamAttr.to_attr(param_attr))}
+    has_bias = bool(bias_attr)
+    if has_bias:
+        params["b"] = ParamSpec((num_filters,), ParamAttr.to_attr(
+            None if bias_attr is True else bias_attr))
+
+    def compute(ctx, p, ins):
+        x = _data_of(ins[0]).reshape(-1, d, h, w, c)
+        if trans:
+            # lhs_dilation = fractional stride; k-1-p pads convert to the
+            # equivalent forward conv (same scheme as ops/conv.py 2-D path)
+            wk = jnp.flip(p["w"], (0, 1, 2)).transpose(0, 1, 2, 4, 3)
+            y = jax.lax.conv_general_dilated(
+                x, wk, window_strides=(1, 1, 1),
+                padding=[(kk - 1 - padding, kk - 1 - padding) for kk in k],
+                lhs_dilation=(stride,) * 3,
+                dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        else:
+            y = pconv.conv3d(x, p["w"], stride=stride, padding=padding)
+        if has_bias:
+            y = y + p["b"]
+        y = _apply_act(activation, y)
+        return _apply_extra(ctx, name, y.reshape(y.shape[0], -1), None)
+
+    node = LayerOutput(name=name, layer_type="conv3d", inputs=[inp],
+                       fn=compute, params=params,
+                       size=od * oh * ow * num_filters)
+    node.vol_shape = (od, oh, ow, num_filters)
+    return node
+
+
+@_export
+def img_pool3d(input, pool_size, pool_type=None, stride: int = None,
+               padding: int = 0, name: Optional[str] = None,
+               **_kw) -> LayerOutput:
+    """3-D pooling (reference: pool3d → Pool3DLayer.cpp)."""
+    inp = input
+    name = name or unique_name("pool3d")
+    ptype = pooling_mod.get(pool_type)
+    stride = stride if stride is not None else pool_size
+    vol = _vol_shape_of(inp)
+    enforce_that(vol is not None, "img_pool3d needs vol shape",
+                 context="pool3d")
+    d, h, w, c = vol
+    k = (pool_size,) * 3 if isinstance(pool_size, int) else tuple(pool_size)
+    od = _conv_out_dim(d, k[0], padding, stride)
+    oh = _conv_out_dim(h, k[1], padding, stride)
+    ow = _conv_out_dim(w, k[2], padding, stride)
+    is_max = isinstance(ptype, pooling_mod.MaxPooling)
+
+    def compute(ctx, p, ins):
+        x = _data_of(ins[0]).reshape(-1, d, h, w, c)
+        window = (1,) + k + (1,)
+        strides = (1,) + (stride,) * 3 + (1,)
+        pads = ((0, 0),) + ((padding, padding),) * 3 + ((0, 0),)
+        if is_max:
+            y = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window,
+                                      strides, pads)
+        else:
+            y = jax.lax.reduce_window(x, 0.0, jax.lax.add, window,
+                                      strides, pads) / (k[0] * k[1] * k[2])
+        return y.reshape(y.shape[0], -1)
+
+    node = LayerOutput(name=name, layer_type="pool3d", inputs=[inp],
+                       fn=compute, size=od * oh * ow * c)
+    node.vol_shape = (od, oh, ow, c)
+    return node
+
+
+# ---------------------------------------------------------------------------
+# MDLSTM (reference: mdlstmemory → MDLstmLayer.cpp) — 2-D LSTM whose cell
+# (i, j) sees states from (i-1, j) and (i, j-1). TPU-native: a lax.scan over
+# rows whose body is a lax.scan over columns (row-major wavefront), all
+# compiled into one XLA while-loop nest.
+# ---------------------------------------------------------------------------
+
+
+@_export
+def mdlstmemory(input, size: int, height: int, width: int,
+                param_attr=None, bias_attr=True,
+                name: Optional[str] = None) -> LayerOutput:
+    """2-D multidimensional LSTM over an image laid out [B, H*W*C].
+
+    Gates: input, output, cell candidate + one forget gate per direction
+    (MDLstmLayer.cpp). Output is [B, H*W*size]."""
+    inp = input
+    name = name or unique_name("mdlstm")
+    enforce_that(inp.size % (height * width) == 0,
+                 "mdlstm input size must be H*W*C", context="mdlstm")
+    c_in = inp.size // (height * width)
+    # x proj -> 5*size (i, f_row, f_col, o, g); two recurrent projections
+    params = {
+        "wx": ParamSpec((c_in, 5 * size), ParamAttr.to_attr(param_attr)),
+        "wr": ParamSpec((size, 5 * size), ParamAttr.to_attr(param_attr)),
+        "wc": ParamSpec((size, 5 * size), ParamAttr.to_attr(param_attr)),
+    }
+    has_bias = bool(bias_attr)
+    if has_bias:
+        params["b"] = ParamSpec((5 * size,), ParamAttr.to_attr(
+            None if bias_attr is True else bias_attr))
+
+    def compute(ctx, p, ins):
+        x = _data_of(ins[0])
+        b = x.shape[0]
+        grid = x.reshape(b, height, width, c_in)
+        xs = jnp.einsum("bhwc,cg->hwbg", grid, p["wx"])
+        if has_bias:
+            xs = xs + p["b"]
+
+        def cell(pre, h_up, c_up, h_left, c_left):
+            z = pre + h_up @ p["wr"] + h_left @ p["wc"]
+            i, f_r, f_c, o, g = jnp.split(z, 5, axis=-1)
+            c_new = (jax.nn.sigmoid(f_r) * c_up
+                     + jax.nn.sigmoid(f_c) * c_left
+                     + jax.nn.sigmoid(i) * jnp.tanh(g))
+            h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+            return h_new, c_new
+
+        zeros = jnp.zeros((b, size), x.dtype)
+
+        def row_step(carry_row, xrow):
+            h_prev_row, c_prev_row = carry_row   # [W, B, size] each
+
+            def col_step(carry_col, inputs):
+                h_left, c_left = carry_col
+                pre, h_up, c_up = inputs
+                h_new, c_new = cell(pre, h_up, c_up, h_left, c_left)
+                return (h_new, c_new), (h_new, c_new)
+
+            (_, _), (h_row, c_row) = jax.lax.scan(
+                col_step, (zeros, zeros), (xrow, h_prev_row, c_prev_row))
+            return (h_row, c_row), h_row
+
+        h0 = jnp.zeros((width, b, size), x.dtype)
+        (_, _), hs = jax.lax.scan(row_step, (h0, h0), xs)  # [H, W, B, size]
+        return hs.transpose(2, 0, 1, 3).reshape(b, -1)
+
+    node = LayerOutput(name=name, layer_type="mdlstm", inputs=[inp],
+                       fn=compute, params=params,
+                       size=height * width * size)
+    node.img_shape = (height, width, size)
+    return node
+
+
+# ---------------------------------------------------------------------------
+# detection suite (reference: priorbox/multibox_loss/detection_output —
+# PriorBoxLayer.cpp, MultiBoxLossLayer.cpp, DetectionOutputLayer.cpp)
+# ---------------------------------------------------------------------------
+
+
+@_export
+def priorbox(input, image_size, min_size, max_size=(), aspect_ratio=(2.0,),
+             variance=(0.1, 0.1, 0.2, 0.2), name: Optional[str] = None
+             ) -> LayerOutput:
+    """Prior (anchor) boxes for a feature map: output [1, P*8] = boxes then
+    variances (reference priorbox emits boxes+variances rows)."""
+    from paddle_tpu.ops import detection as pdet
+    inp = input
+    name = name or unique_name("priorbox")
+    in_shape = _img_shape_of(inp)
+    enforce_that(in_shape is not None, "priorbox needs image shape",
+                 context="priorbox")
+    fh, fw, _ = in_shape
+    ih, iw = (image_size, image_size) if isinstance(image_size, int) \
+        else tuple(image_size)
+    min_sizes = [min_size] if isinstance(min_size, (int, float)) else list(min_size)
+    max_sizes = [max_size] if isinstance(max_size, (int, float)) else list(max_size)
+    boxes_np, var_np = pdet.prior_boxes(fh, fw, ih, iw, min_sizes,
+                                        max_sizes, list(aspect_ratio),
+                                        list(variance))
+    num_p = boxes_np.shape[0]
+
+    def compute(ctx, p, ins):
+        flat = jnp.concatenate([jnp.asarray(boxes_np).reshape(-1),
+                                jnp.asarray(var_np).reshape(-1)])
+        return flat[None, :]
+
+    node = LayerOutput(name=name, layer_type="priorbox", inputs=[inp],
+                       fn=compute, size=num_p * 8)
+    node.num_priors = num_p
+    return node
+
+
+def _split_priors(pb_flat, num_p):
+    boxes = pb_flat[: num_p * 4].reshape(num_p, 4)
+    var = pb_flat[num_p * 4:].reshape(num_p, 4)
+    return boxes, var
+
+
+@_export
+def multibox_loss(input_loc, input_conf, priorbox, label, num_classes: int,
+                  overlap_threshold: float = 0.5, neg_pos_ratio: float = 3.0,
+                  background_id: int = 0, max_boxes: int = 16,
+                  name: Optional[str] = None) -> LayerOutput:
+    """SSD loss. ``label`` is a dense [B, max_boxes*5] layer of
+    (class, xmin, ymin, xmax, ymax) rows, class<0 ⇒ padding (the reference
+    feeds the same records as a sequence; dense-with-padding is the
+    static-shape TPU equivalent)."""
+    from paddle_tpu.ops import detection as pdet
+    locs = _as_list(input_loc)
+    confs = _as_list(input_conf)
+    name = name or unique_name("multibox_loss")
+    num_p = priorbox.num_priors
+
+    def compute(ctx, p, ins):
+        k = len(locs)
+        loc = jnp.concatenate(
+            [_data_of(v).reshape(_data_of(v).shape[0], -1, 4)
+             for v in ins[:k]], axis=1)
+        conf = jnp.concatenate(
+            [_data_of(v).reshape(_data_of(v).shape[0], -1, num_classes)
+             for v in ins[k:2 * k]], axis=1)
+        pb = _data_of(ins[2 * k])[0]
+        gt = _data_of(ins[2 * k + 1]).reshape(loc.shape[0], max_boxes, 5)
+        boxes, var = _split_priors(pb, num_p)
+
+        def one(loc_i, conf_i, gt_i):
+            valid = gt_i[:, 0] >= 0
+            return pdet.multibox_loss(
+                loc_i, conf_i, boxes, var, gt_i[:, 1:5],
+                jnp.maximum(gt_i[:, 0], 0).astype(jnp.int32), valid,
+                num_classes, overlap_threshold, neg_pos_ratio,
+                background_id)
+
+        return jax.vmap(one)(loc, conf, gt)[:, None]
+
+    node = LayerOutput(name=name, layer_type="multibox_loss",
+                       inputs=locs + confs + [priorbox, label], fn=compute,
+                       size=1, is_cost=True)
+    return node
+
+
+@_export
+def detection_output(input_loc, input_conf, priorbox, num_classes: int,
+                     nms_threshold: float = 0.45,
+                     confidence_threshold: float = 0.01,
+                     keep_top_k: int = 100, background_id: int = 0,
+                     name: Optional[str] = None) -> LayerOutput:
+    """Decode + per-class NMS → [B, keep_top_k*6] detections of
+    (label, score, xmin, ymin, xmax, ymax), label −1 = empty slot."""
+    from paddle_tpu.ops import detection as pdet
+    locs = _as_list(input_loc)
+    confs = _as_list(input_conf)
+    name = name or unique_name("detection_output")
+    num_p = priorbox.num_priors
+
+    def compute(ctx, p, ins):
+        k = len(locs)
+        loc = jnp.concatenate(
+            [_data_of(v).reshape(_data_of(v).shape[0], -1, 4)
+             for v in ins[:k]], axis=1)
+        conf = jnp.concatenate(
+            [_data_of(v).reshape(_data_of(v).shape[0], -1, num_classes)
+             for v in ins[k:2 * k]], axis=1)
+        pb = _data_of(ins[2 * k])[0]
+        boxes, var = _split_priors(pb, num_p)
+
+        def one(loc_i, conf_i):
+            return pdet.detection_output(
+                loc_i, conf_i, boxes, var, num_classes, nms_threshold,
+                confidence_threshold, keep_top_k, background_id)
+
+        return jax.vmap(one)(loc, conf).reshape(loc.shape[0], -1)
+
+    return LayerOutput(name=name, layer_type="detection_output",
+                       inputs=locs + confs + [priorbox], fn=compute,
+                       size=keep_top_k * 6)
+
+
+# v1-compatible aliases for registered type names
+gated_recurrent = grumemory
+__all__ += ["gated_recurrent"]

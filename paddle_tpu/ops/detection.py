@@ -1,0 +1,257 @@
+"""SSD detection math: prior boxes, IoU matching, box coding, NMS.
+
+Reference analog: paddle/gserver/layers/PriorBox.cpp,
+MultiBoxLossLayer.cpp, DetectionOutputLayer.cpp and DetectionUtil.cpp.
+
+TPU-native design: everything is fixed-shape and branch-free — matching is
+a dense [num_priors, num_gt] IoU argmax (no per-box loops), hard-negative
+mining is a top-k over masked losses, and NMS is a lax.fori_loop over a
+static max_keep budget. All of it jits and batches with vmap.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+# ---------------------------------------------------------------------------
+# prior (anchor) boxes
+# ---------------------------------------------------------------------------
+
+
+def prior_boxes(feat_h: int, feat_w: int, img_h: int, img_w: int,
+                min_sizes: Sequence[float], max_sizes: Sequence[float],
+                aspect_ratios: Sequence[float],
+                variances: Sequence[float] = (0.1, 0.1, 0.2, 0.2),
+                clip: bool = True) -> Tuple[np.ndarray, np.ndarray]:
+    """Static prior grid (PriorBoxLayer.cpp:forward analog).
+
+    Returns (boxes [P, 4] in normalized xmin/ymin/xmax/ymax, variances
+    [P, 4]). Priors per cell: one per min_size, one per sqrt(min*max),
+    two per extra aspect ratio (r and 1/r)."""
+    ars = [1.0]
+    for r in aspect_ratios:
+        if not any(abs(r - a) < 1e-6 for a in ars):
+            ars.append(float(r))
+            ars.append(1.0 / float(r))
+    boxes = []
+    for y in range(feat_h):
+        for x in range(feat_w):
+            cx = (x + 0.5) / feat_w
+            cy = (y + 0.5) / feat_h
+            for i, ms in enumerate(min_sizes):
+                # square min box
+                boxes.append([cx - ms / img_w / 2, cy - ms / img_h / 2,
+                              cx + ms / img_w / 2, cy + ms / img_h / 2])
+                if i < len(max_sizes):
+                    s = float(np.sqrt(ms * max_sizes[i]))
+                    boxes.append([cx - s / img_w / 2, cy - s / img_h / 2,
+                                  cx + s / img_w / 2, cy + s / img_h / 2])
+                for r in ars[1:]:
+                    rw = ms * float(np.sqrt(r))
+                    rh = ms / float(np.sqrt(r))
+                    boxes.append([cx - rw / img_w / 2, cy - rh / img_h / 2,
+                                  cx + rw / img_w / 2, cy + rh / img_h / 2])
+    out = np.asarray(boxes, np.float32)
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.tile(np.asarray(variances, np.float32)[None, :],
+                  (out.shape[0], 1))
+    return out, var
+
+
+def num_priors_per_cell(min_sizes, max_sizes, aspect_ratios) -> int:
+    ars = {1.0}
+    for r in aspect_ratios:
+        ars.add(float(r))
+        ars.add(1.0 / float(r))
+    return len(min_sizes) + min(len(max_sizes), len(min_sizes)) \
+        + len(min_sizes) * (len(ars) - 1)
+
+
+# ---------------------------------------------------------------------------
+# IoU / encode / decode (DetectionUtil.cpp jaccardOverlap/encodeBBox)
+# ---------------------------------------------------------------------------
+
+
+def iou_matrix(a: jax.Array, b: jax.Array) -> jax.Array:
+    """[Na, 4] x [Nb, 4] → [Na, Nb] IoU."""
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * jnp.maximum(
+        a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * jnp.maximum(
+        b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def encode_boxes(gt: jax.Array, priors: jax.Array,
+                 variances: jax.Array) -> jax.Array:
+    """Ground-truth → regression targets wrt priors (encodeBBoxWithVar)."""
+    pw = priors[:, 2] - priors[:, 0]
+    ph = priors[:, 3] - priors[:, 1]
+    pcx = (priors[:, 0] + priors[:, 2]) / 2
+    pcy = (priors[:, 1] + priors[:, 3]) / 2
+    gw = jnp.maximum(gt[:, 2] - gt[:, 0], 1e-8)
+    gh = jnp.maximum(gt[:, 3] - gt[:, 1], 1e-8)
+    gcx = (gt[:, 0] + gt[:, 2]) / 2
+    gcy = (gt[:, 1] + gt[:, 3]) / 2
+    t = jnp.stack([(gcx - pcx) / pw, (gcy - pcy) / ph,
+                   jnp.log(gw / pw), jnp.log(gh / ph)], axis=-1)
+    return t / variances
+
+
+def decode_boxes(loc: jax.Array, priors: jax.Array,
+                 variances: jax.Array) -> jax.Array:
+    """Regression preds → boxes (decodeBBoxWithVar analog)."""
+    pw = priors[:, 2] - priors[:, 0]
+    ph = priors[:, 3] - priors[:, 1]
+    pcx = (priors[:, 0] + priors[:, 2]) / 2
+    pcy = (priors[:, 1] + priors[:, 3]) / 2
+    v = variances
+    cx = v[:, 0] * loc[:, 0] * pw + pcx
+    cy = v[:, 1] * loc[:, 1] * ph + pcy
+    w = jnp.exp(v[:, 2] * loc[:, 2]) * pw
+    h = jnp.exp(v[:, 3] * loc[:, 3]) * ph
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                     axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# matching + multibox loss (MultiBoxLossLayer.cpp analog)
+# ---------------------------------------------------------------------------
+
+
+def match_priors(priors: jax.Array, gt_boxes: jax.Array,
+                 gt_valid: jax.Array, overlap_threshold: float = 0.5):
+    """Bipartite + per-prediction matching, dense.
+
+    gt_boxes [G, 4] with validity mask [G]. Returns (match_idx [P] int32 —
+    index into gt or -1, matched_iou [P])."""
+    iou = iou_matrix(priors, gt_boxes)                  # [P, G]
+    iou = jnp.where(gt_valid[None, :], iou, -1.0)
+    best_gt = jnp.argmax(iou, axis=1)                   # [P]
+    best_iou = jnp.max(iou, axis=1)
+    match = jnp.where(best_iou >= overlap_threshold, best_gt, -1)
+    # bipartite pass: every valid gt claims its best prior
+    best_prior = jnp.argmax(iou, axis=0)                # [G]
+    g_idx = jnp.arange(gt_boxes.shape[0])
+    has_any = jnp.max(iou, axis=0) > 0
+    claim = gt_valid & has_any
+    match = match.at[best_prior].set(
+        jnp.where(claim, g_idx, match[best_prior]))
+    return match.astype(jnp.int32), best_iou
+
+
+def multibox_loss(loc_pred: jax.Array, conf_pred: jax.Array,
+                  priors: jax.Array, prior_var: jax.Array,
+                  gt_boxes: jax.Array, gt_labels: jax.Array,
+                  gt_valid: jax.Array, num_classes: int,
+                  overlap_threshold: float = 0.5,
+                  neg_pos_ratio: float = 3.0,
+                  background_id: int = 0) -> jax.Array:
+    """Per-example SSD loss (conf xent + loc smooth-l1), hard-negative
+    mined at neg:pos ratio. Shapes: loc_pred [P,4], conf_pred [P,C],
+    gt_boxes [G,4], gt_labels [G] (excluding background), gt_valid [G]."""
+    P = priors.shape[0]
+    match, _ = match_priors(priors, gt_boxes, gt_valid, overlap_threshold)
+    pos = match >= 0
+    num_pos = jnp.sum(pos)
+
+    safe = jnp.maximum(match, 0)
+    target_box = encode_boxes(gt_boxes[safe], priors, prior_var)
+    diff = loc_pred - target_box
+    ad = jnp.abs(diff)
+    sl1 = jnp.where(ad < 1.0, 0.5 * diff * diff, ad - 0.5).sum(-1)
+    loc_loss = jnp.sum(jnp.where(pos, sl1, 0.0))
+
+    target_cls = jnp.where(pos, gt_labels[safe], background_id)
+    logp = jax.nn.log_softmax(conf_pred, axis=-1)
+    xent = -jnp.take_along_axis(logp, target_cls[:, None].astype(jnp.int32),
+                                axis=-1)[:, 0]
+    # hard negative mining: keep top (ratio * num_pos) negative losses
+    neg_score = jnp.where(pos, -jnp.inf, xent)
+    order = jnp.argsort(-neg_score)
+    rank = jnp.zeros(P, jnp.int32).at[order].set(jnp.arange(P, dtype=jnp.int32))
+    num_neg = jnp.minimum((neg_pos_ratio * num_pos).astype(jnp.int32),
+                          P - num_pos)
+    neg = (~pos) & (rank < num_neg)
+    conf_loss = jnp.sum(jnp.where(pos | neg, xent, 0.0))
+    denom = jnp.maximum(num_pos.astype(loc_loss.dtype), 1.0)
+    return (conf_loss + loc_loss) / denom
+
+
+# ---------------------------------------------------------------------------
+# NMS + detection output (DetectionOutputLayer.cpp analog)
+# ---------------------------------------------------------------------------
+
+
+def nms(boxes: jax.Array, scores: jax.Array, iou_threshold: float,
+        max_keep: int) -> Tuple[jax.Array, jax.Array]:
+    """Greedy NMS with a static keep budget.
+
+    Returns (keep_idx [max_keep] int32 (-1 padded), keep_mask [max_keep])."""
+    n = boxes.shape[0]
+    iou = iou_matrix(boxes, boxes)
+
+    def body(i, state):
+        alive, keep_idx, keep_ok = state
+        masked = jnp.where(alive, scores, -jnp.inf)
+        j = jnp.argmax(masked)
+        ok = masked[j] > -jnp.inf
+        keep_idx = keep_idx.at[i].set(jnp.where(ok, j, -1))
+        keep_ok = keep_ok.at[i].set(ok)
+        # kill j and everything overlapping it
+        kill = (iou[j] >= iou_threshold) | (jnp.arange(n) == j)
+        alive = alive & (~kill | ~ok)
+        return alive, keep_idx, keep_ok
+
+    alive0 = jnp.ones(n, bool)
+    keep0 = jnp.full(max_keep, -1, jnp.int32)
+    ok0 = jnp.zeros(max_keep, bool)
+    _, keep_idx, keep_ok = lax.fori_loop(0, max_keep, body,
+                                         (alive0, keep0, ok0))
+    return keep_idx, keep_ok
+
+
+def detection_output(loc_pred: jax.Array, conf_pred: jax.Array,
+                     priors: jax.Array, prior_var: jax.Array,
+                     num_classes: int, nms_threshold: float = 0.45,
+                     confidence_threshold: float = 0.01,
+                     keep_top_k: int = 100,
+                     background_id: int = 0) -> jax.Array:
+    """Per-example detections [keep_top_k, 6] = (label, score,
+    xmin, ymin, xmax, ymax); invalid rows have label -1."""
+    boxes = decode_boxes(loc_pred, priors, prior_var)      # [P, 4]
+    probs = jax.nn.softmax(conf_pred, axis=-1)             # [P, C]
+
+    per_class = keep_top_k
+
+    def one_class(c):
+        scores = jnp.where(probs[:, c] >= confidence_threshold,
+                           probs[:, c], -jnp.inf)
+        keep_idx, keep_ok = nms(boxes, scores, nms_threshold, per_class)
+        safe = jnp.maximum(keep_idx, 0)
+        det = jnp.concatenate([
+            jnp.full((per_class, 1), c, jnp.float32),
+            probs[safe, c][:, None],
+            boxes[safe]], axis=-1)
+        return jnp.where(keep_ok[:, None], det,
+                         jnp.full_like(det, -1.0))
+
+    cls_ids = [c for c in range(num_classes) if c != background_id]
+    dets = jnp.concatenate([one_class(c) for c in cls_ids], axis=0)
+    # global top keep_top_k by score
+    score = jnp.where(dets[:, 0] >= 0, dets[:, 1], -jnp.inf)
+    _, top = lax.top_k(score, keep_top_k)
+    out = dets[top]
+    return jnp.where(jnp.isfinite(score[top])[:, None], out,
+                     jnp.full_like(out, -1.0))

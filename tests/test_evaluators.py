@@ -1,0 +1,145 @@
+"""Evaluator tests (reference: gserver/tests/test_Evaluator.cpp)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import evaluator, layer
+from paddle_tpu.sequence import SequenceBatch
+from paddle_tpu.topology import Topology
+
+
+def run_metric(nodes, feeds):
+    topo = Topology(nodes if isinstance(nodes, list) else [nodes])
+    params = paddle.Parameters.from_topology(topo, seed=0)
+    state = topo.init_state()
+    outs, _ = topo.forward(params.as_dict(), state, feeds, train=False)
+    return [np.asarray(o.data if isinstance(o, SequenceBatch) else o)
+            for o in outs]
+
+
+def make_seq(data, lengths):
+    data = np.asarray(data, np.float32)
+    seg = np.concatenate([np.full(L, i, np.int32)
+                          for i, L in enumerate(lengths)])
+    return SequenceBatch(jnp.asarray(data), jnp.asarray(seg),
+                         jnp.asarray(np.asarray(lengths, np.int32)),
+                         max_len=max(lengths))
+
+
+def test_rankauc_perfect_and_random():
+    paddle.topology.reset_name_scope()
+    s = layer.data(name="s", type=paddle.data_type.dense_vector(1))
+    y = layer.data(name="y", type=paddle.data_type.integer_value(2))
+    m = evaluator.rankauc(s, y)
+    score = np.array([[0.9], [0.8], [0.2], [0.1]], np.float32)
+    lab = np.array([1, 1, 0, 0], np.int32)
+    (auc,) = run_metric(m, {"s": score, "y": lab})
+    assert abs(float(auc) - 1.0) < 1e-5
+    lab2 = np.array([0, 1, 0, 1], np.int32)
+    (auc2,) = run_metric(m, {"s": score, "y": lab2})
+    assert 0.0 <= float(auc2) <= 1.0 and float(auc2) < 1.0
+
+
+def test_chunk_f1_exact_match():
+    paddle.topology.reset_name_scope()
+    # IOB with 1 chunk type: B=0, I=1, O=2
+    pred = layer.data(name="p",
+                      type=paddle.data_type.integer_value_sequence(3))
+    lab = layer.data(name="l",
+                     type=paddle.data_type.integer_value_sequence(3))
+    m = evaluator.chunk(pred, lab, num_chunk_types=1)
+    tags = np.array([0, 1, 2, 0, 2], np.float32)  # [B I O B O]
+    sb_p = make_seq(tags, [5])
+    sb_l = make_seq(tags, [5])
+    (f1,) = run_metric(m, {"p": sb_p, "l": sb_l})
+    assert abs(float(f1) - 1.0) < 1e-5
+
+    # one of two chunks wrong
+    tags_bad = np.array([0, 2, 2, 0, 2], np.float32)   # first chunk truncated
+    (f1b,) = run_metric(m, {"p": make_seq(tags_bad, [5]), "l": sb_l})
+    assert float(f1b) < 1.0
+
+
+def test_ctc_edit_distance_zero_and_nonzero():
+    paddle.topology.reset_name_scope()
+    C = 4  # 3 symbols + blank(3)
+    probs = layer.data(name="probs",
+                       type=paddle.data_type.dense_vector_sequence(C))
+    lab = layer.data(name="lab",
+                     type=paddle.data_type.integer_value_sequence(3))
+    m = evaluator.ctc_edit_distance(probs, lab)
+
+    def onehot(ids):
+        x = np.full((len(ids), C), -5.0, np.float32)
+        for i, t in enumerate(ids):
+            x[i, t] = 5.0
+        return x
+
+    # path [1, blank, 2, 2] decodes to [1, 2]; label [1, 2] → distance 0
+    p = make_seq(onehot([1, 3, 2, 2]), [4])
+    l = make_seq(np.array([1, 2], np.float32), [2])
+    (d0,) = run_metric(m, {"probs": p, "lab": l})
+    assert abs(float(d0)) < 1e-5
+
+    l2 = make_seq(np.array([1, 0], np.float32), [2])
+    (d1,) = run_metric(m, {"probs": p, "lab": l2})
+    assert abs(float(d1) - 0.5) < 1e-5  # one substitution / len 2
+
+
+def test_detection_map_perfect():
+    paddle.topology.reset_name_scope()
+    K, MB = 4, 2
+    det = layer.data(name="det", type=paddle.data_type.dense_vector(K * 6))
+    gt = layer.data(name="gt", type=paddle.data_type.dense_vector(MB * 5))
+    m = evaluator.detection_map(det, gt, num_classes=3, keep_top_k=K,
+                                max_boxes=MB)
+    det_rows = np.full((1, K, 6), -1, np.float32)
+    det_rows[0, 0] = [1, 0.9, 0.1, 0.1, 0.4, 0.4]
+    det_rows[0, 1] = [2, 0.8, 0.5, 0.5, 0.9, 0.9]
+    gt_rows = np.array([[[1, 0.1, 0.1, 0.4, 0.4],
+                         [2, 0.5, 0.5, 0.9, 0.9]]], np.float32)
+    (mp,) = run_metric(m, {"det": det_rows.reshape(1, -1),
+                           "gt": gt_rows.reshape(1, -1)})
+    assert abs(float(mp) - 1.0) < 1e-4
+
+    # wrong class detection → mAP drops
+    det_rows[0, 1, 0] = 1
+    (mp2,) = run_metric(m, {"det": det_rows.reshape(1, -1),
+                            "gt": gt_rows.reshape(1, -1)})
+    assert float(mp2) < 1.0
+
+
+def test_printers_run(capsys):
+    paddle.topology.reset_name_scope()
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    y = layer.data(name="y", type=paddle.data_type.integer_value(4))
+    nodes = [evaluator.classification_error_printer(x, y),
+             evaluator.seq_text_printer(y),
+             evaluator.max_frame_printer(x)]
+    outs = run_metric(nodes, {"x": np.eye(4, dtype=np.float32),
+                              "y": np.arange(4, dtype=np.int32)})
+    for o in outs:
+        assert o.shape == (1,)
+
+
+def test_gradient_printer_passthrough():
+    import jax
+
+    paddle.topology.reset_name_scope()
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(3))
+    gp = evaluator.gradient_printer(x)
+    out = layer.fc(gp, size=1, bias_attr=False)
+    topo = Topology([out])
+    params = paddle.Parameters.from_topology(topo, seed=0)
+    state = topo.init_state()
+
+    def loss(p, xb):
+        outs, _ = topo.forward(p, state, {"x": xb}, train=False)
+        return jnp.sum(outs[0])
+
+    xb = np.ones((2, 3), np.float32)
+    g = jax.grad(loss)(params.as_dict(), xb)
+    w = np.asarray(params[out.name + ".w0"])
+    np.testing.assert_allclose(np.asarray(g[out.name + ".w0"]),
+                               np.full_like(w, 2.0), atol=1e-5)

@@ -1,0 +1,323 @@
+"""Tests for the completeness batch of v2 layers (prelu, tensor, multiplex,
+detection suite, 3-D convs, MDLSTM, ...).
+
+Reference analog: paddle/gserver/tests/test_LayerGrad.cpp — every layer is
+run forward and (for parametric layers) gradient-checked numerically.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import layer
+from paddle_tpu.sequence import SequenceBatch
+from paddle_tpu.topology import Topology
+
+
+def forward(out_layers, feeds, seed=0):
+    paddle.topology
+    topo = Topology(out_layers if isinstance(out_layers, list)
+                    else [out_layers])
+    params = paddle.Parameters.from_topology(topo, seed=seed)
+    state = topo.init_state()
+    outs, _ = topo.forward(params.as_dict(), state, feeds, train=False)
+    return outs, params, topo
+
+
+def numeric_grad_check(cost_node, feeds, rtol=5e-2, atol=5e-3, delta=1e-3):
+    """testLayerGrad analog: analytic d(cost)/d(param) vs central difference."""
+    topo = Topology([cost_node])
+    params = paddle.Parameters.from_topology(topo, seed=1)
+    state = topo.init_state()
+    pdict = {k: np.asarray(v, np.float64).astype(np.float32)
+             for k, v in params.as_dict().items()}
+
+    def loss_fn(p):
+        outs, _ = topo.forward(p, state, feeds, train=False)
+        return jnp.mean(outs[0])
+
+    analytic = jax.grad(loss_fn)(pdict)
+    for name, val in pdict.items():
+        flat = np.asarray(val).ravel()
+        take = min(4, flat.size)
+        idxs = np.linspace(0, flat.size - 1, take).astype(int)
+        for i in idxs:
+            pu = {k: np.array(v, np.float32) for k, v in pdict.items()}
+            pu[name].ravel()[i] += delta
+            up = float(loss_fn(pu))
+            pd_ = {k: np.array(v, np.float32) for k, v in pdict.items()}
+            pd_[name].ravel()[i] -= delta
+            down = float(loss_fn(pd_))
+            num = (up - down) / (2 * delta)
+            ana = float(np.asarray(analytic[name]).ravel()[i])
+            assert abs(num - ana) <= atol + rtol * abs(num), \
+                (name, i, num, ana)
+
+
+def make_seq(rng, lengths, dim, capacity=None):
+    total = sum(lengths)
+    capacity = capacity or total
+    data = np.zeros((capacity, dim), np.float32)
+    data[:total] = rng.randn(total, dim)
+    seg = np.full(capacity, len(lengths), np.int32)
+    pos = 0
+    for i, L in enumerate(lengths):
+        seg[pos:pos + L] = i
+        pos += L
+    return SequenceBatch(jnp.asarray(data), jnp.asarray(seg),
+                         jnp.asarray(np.asarray(lengths, np.int32)),
+                         max_len=max(lengths))
+
+
+def test_prelu_forward_and_grad(rng):
+    paddle.topology.reset_name_scope()
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(8))
+    out = layer.prelu(x, partial_sum=4)
+    cost = layer.mixed(input=layer.identity_projection(out), size=8)
+    feeds = {"x": rng.randn(3, 8).astype(np.float32)}
+    outs, params, _ = forward(out, feeds)
+    # slopes init: verify negative side scaled by slope
+    numeric_grad_check(out, feeds)
+
+
+def test_scale_shift_and_data_norm(rng):
+    paddle.topology.reset_name_scope()
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(5))
+    ss = layer.scale_shift(x)
+    dn = layer.data_norm(x, mean=np.ones(5, np.float32),
+                         std=2 * np.ones(5, np.float32))
+    xb = rng.randn(4, 5).astype(np.float32)
+    outs, params, _ = forward([ss, dn], {"x": xb})
+    w = float(params[ss.name + ".w"][0])
+    b = float(params[ss.name + ".b"][0])
+    np.testing.assert_allclose(np.asarray(outs[0]), xb * w + b, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(outs[1]), (xb - 1) / 2, atol=1e-5)
+
+
+def test_tensor_out_prod_cos_vm(rng):
+    paddle.topology.reset_name_scope()
+    a = layer.data(name="a", type=paddle.data_type.dense_vector(3))
+    b = layer.data(name="b", type=paddle.data_type.dense_vector(4))
+    t = layer.tensor(a, b, size=5)
+    op = layer.out_prod(a, b)
+    ab = rng.randn(2, 3).astype(np.float32)
+    bb = rng.randn(2, 4).astype(np.float32)
+    outs, params, _ = forward([t, op], {"a": ab, "b": bb})
+    w = np.asarray(params[t.name + ".w"])
+    expect_t = np.einsum("bi,kij,bj->bk", ab, w, bb)
+    np.testing.assert_allclose(np.asarray(outs[0]), expect_t, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(outs[1]),
+        np.einsum("bi,bj->bij", ab, bb).reshape(2, -1), atol=1e-5)
+
+    paddle.topology.reset_name_scope()
+    v = layer.data(name="v", type=paddle.data_type.dense_vector(3))
+    m = layer.data(name="m", type=paddle.data_type.dense_vector(6))
+    cv = layer.cos_vm(v, m, size=2)
+    vb = rng.randn(2, 3).astype(np.float32)
+    mb = rng.randn(2, 6).astype(np.float32)
+    outs, _, _ = forward(cv, {"v": vb, "m": mb})
+    mm = mb.reshape(2, 2, 3)
+    expect = np.einsum("bd,bmd->bm", vb, mm) / (
+        np.linalg.norm(vb, axis=1, keepdims=True)
+        * np.linalg.norm(mm, axis=2))
+    np.testing.assert_allclose(np.asarray(outs[0]), expect, atol=1e-5)
+
+
+def test_multiplex_and_conv_shift(rng):
+    paddle.topology.reset_name_scope()
+    idx = layer.data(name="idx", type=paddle.data_type.integer_value(2))
+    a = layer.data(name="a", type=paddle.data_type.dense_vector(4))
+    b = layer.data(name="b", type=paddle.data_type.dense_vector(4))
+    mx = layer.multiplex(idx, [a, b])
+    ab = rng.randn(3, 4).astype(np.float32)
+    bb = rng.randn(3, 4).astype(np.float32)
+    ib = np.array([0, 1, 0], np.int32)
+    outs, _, _ = forward(mx, {"idx": ib, "a": ab, "b": bb})
+    expect = np.where(ib[:, None] == 0, ab, bb)
+    np.testing.assert_allclose(np.asarray(outs[0]), expect, atol=1e-6)
+
+    paddle.topology.reset_name_scope()
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(5))
+    k = layer.data(name="k", type=paddle.data_type.dense_vector(3))
+    cs = layer.conv_shift(x, k)
+    xb = rng.randn(2, 5).astype(np.float32)
+    kb = rng.randn(2, 3).astype(np.float32)
+    outs, _, _ = forward(cs, {"x": xb, "k": kb})
+    expect = np.zeros((2, 5), np.float32)
+    for bi in range(2):
+        for m in range(5):
+            for j in range(3):
+                expect[bi, m] += xb[bi, (m + j - 1) % 5] * kb[bi, j]
+    np.testing.assert_allclose(np.asarray(outs[0]), expect, atol=1e-5)
+
+
+def test_linear_comb_featmap_expand_trans(rng):
+    paddle.topology.reset_name_scope()
+    w = layer.data(name="w", type=paddle.data_type.dense_vector(3))
+    v = layer.data(name="v", type=paddle.data_type.dense_vector(6))
+    lc = layer.linear_comb(w, v, size=2)
+    fe = layer.featmap_expand(w, num_filters=2)
+    wb = rng.randn(2, 3).astype(np.float32)
+    vb = rng.randn(2, 6).astype(np.float32)
+    outs, _, _ = forward([lc, fe], {"w": wb, "v": vb})
+    expect = np.einsum("bm,bmd->bd", wb, vb.reshape(2, 3, 2))
+    np.testing.assert_allclose(np.asarray(outs[0]), expect, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(outs[1]),
+                               np.tile(wb, (1, 2)), atol=1e-6)
+
+    paddle.topology.reset_name_scope()
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    tr = layer.trans(x)
+    xb = rng.randn(3, 4).astype(np.float32)
+    outs, _, _ = forward(tr, {"x": xb})
+    np.testing.assert_allclose(np.asarray(outs[0]), xb.T, atol=1e-6)
+
+
+def test_row_conv_and_subseq(rng):
+    paddle.topology.reset_name_scope()
+    seq = layer.data(name="s",
+                     type=paddle.data_type.dense_vector_sequence(3))
+    rc = layer.row_conv(seq, context_len=2)
+    sb = make_seq(rng, [3, 2], 3)
+    outs, params, _ = forward(rc, {"s": sb})
+    w = np.asarray(params[rc.name + ".w"])
+    x = np.asarray(sb.data)
+    # sequence 0 rows 0..2: y[i] = x[i]*w[0] + x[i+1]*w[1] (within seq)
+    y0 = x[0] * w[0] + x[1] * w[1]
+    y2 = x[2] * w[0]          # last row of seq 0: no lookahead
+    got = np.asarray(outs[0].data)
+    np.testing.assert_allclose(got[0], y0, atol=1e-5)
+    np.testing.assert_allclose(got[2], y2, atol=1e-5)
+
+    paddle.topology.reset_name_scope()
+    seq2 = layer.data(name="s2",
+                      type=paddle.data_type.dense_vector_sequence(3))
+    offs = layer.data(name="offs", type=paddle.data_type.integer_value(10))
+    sizes = layer.data(name="sizes", type=paddle.data_type.integer_value(10))
+    ss = layer.subseq(seq2, offs, sizes)
+    sb2 = make_seq(rng, [4, 3], 3)
+    outs, _, _ = forward(ss, {"s2": sb2,
+                              "offs": np.array([1, 0], np.int32),
+                              "sizes": np.array([2, 2], np.int32)})
+    out_sb = outs[0]
+    lens = np.asarray(out_sb.lengths)
+    np.testing.assert_array_equal(lens, [2, 2])
+
+
+def test_get_output_and_print(rng, capsys):
+    paddle.topology.reset_name_scope()
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    go = layer.get_output(x)
+    pr = layer.print_layer(go)
+    xb = rng.randn(2, 4).astype(np.float32)
+    outs, _, _ = forward(pr, {"x": xb})
+    np.testing.assert_allclose(np.asarray(outs[0]), xb, atol=1e-6)
+
+
+def test_switch_order(rng):
+    paddle.topology.reset_name_scope()
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(2 * 3 * 4),
+                   height=2, width=3)
+    so = layer.switch_order(x, reshape_to=("c", "h", "w"))
+    xb = rng.randn(1, 24).astype(np.float32)
+    outs, _, _ = forward(so, {"x": xb})
+    expect = xb.reshape(1, 2, 3, 4).transpose(0, 3, 1, 2).reshape(1, -1)
+    np.testing.assert_allclose(np.asarray(outs[0]), expect, atol=1e-6)
+
+
+def test_img_conv3d_pool3d(rng):
+    paddle.topology.reset_name_scope()
+    x = layer.data(name="x",
+                   type=paddle.data_type.dense_vector(4 * 4 * 4 * 2))
+    c3 = layer.img_conv3d(x, filter_size=3, num_filters=3, num_channels=2,
+                          padding=1, depth=4, height=4, width=4,
+                          act="relu")
+    p3 = layer.img_pool3d(c3, pool_size=2)
+    xb = rng.randn(2, 128).astype(np.float32)
+    outs, _, _ = forward(p3, {"x": xb})
+    assert np.asarray(outs[0]).shape == (2, 2 * 2 * 2 * 3)
+    assert c3.size == 4 * 4 * 4 * 3
+
+    paddle.topology.reset_name_scope()
+    xd = layer.data(name="xd", type=paddle.data_type.dense_vector(8 * 2))
+    d3 = layer.img_conv3d(xd, filter_size=2, num_filters=1, num_channels=2,
+                          stride=2, depth=2, height=2, width=2, trans=True)
+    xdb = rng.randn(1, 16).astype(np.float32)
+    outs, _, _ = forward(d3, {"xd": xdb})
+    assert np.asarray(outs[0]).shape == (1, 4 * 4 * 4 * 1)
+
+
+def test_mdlstm_forward_shape_and_grad(rng):
+    paddle.topology.reset_name_scope()
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(3 * 3 * 2))
+    md = layer.mdlstmemory(x, size=4, height=3, width=3)
+    xb = rng.randn(2, 18).astype(np.float32)
+    outs, _, _ = forward(md, {"x": xb})
+    assert np.asarray(outs[0]).shape == (2, 3 * 3 * 4)
+    numeric_grad_check(md, {"x": xb}, delta=5e-3, rtol=8e-2, atol=8e-3)
+
+
+def test_detection_suite(rng):
+    from paddle_tpu.ops import detection as pdet
+
+    # iou sanity
+    a = jnp.array([[0.0, 0.0, 0.5, 0.5]])
+    b = jnp.array([[0.25, 0.25, 0.75, 0.75], [0.6, 0.6, 0.9, 0.9]])
+    iou = np.asarray(pdet.iou_matrix(a, b))
+    np.testing.assert_allclose(iou[0, 0], 0.0625 / 0.4375, atol=1e-5)
+    assert iou[0, 1] == 0.0
+
+    # encode/decode roundtrip
+    priors = jnp.array([[0.1, 0.1, 0.4, 0.5], [0.3, 0.2, 0.9, 0.8]])
+    var = jnp.full((2, 4), 0.1)
+    gt = jnp.array([[0.15, 0.12, 0.45, 0.55], [0.28, 0.25, 0.85, 0.75]])
+    enc = pdet.encode_boxes(gt, priors, var)
+    dec = pdet.decode_boxes(enc, priors, var)
+    np.testing.assert_allclose(np.asarray(dec), np.asarray(gt), atol=1e-5)
+
+    # full layer path
+    paddle.topology.reset_name_scope()
+    feat = layer.data(name="feat",
+                      type=paddle.data_type.dense_vector(2 * 2 * 4),
+                      height=2, width=2)
+    pb = layer.priorbox(feat, image_size=64, min_size=16, max_size=32,
+                        aspect_ratio=(2.0,))
+    P = pb.num_priors
+    loc = layer.data(name="loc", type=paddle.data_type.dense_vector(P * 4))
+    conf = layer.data(name="conf",
+                      type=paddle.data_type.dense_vector(P * 3))
+    gt_l = layer.data(name="gt", type=paddle.data_type.dense_vector(2 * 5))
+    loss = layer.multibox_loss(loc, conf, pb, gt_l, num_classes=3,
+                               max_boxes=2)
+    det = layer.detection_output(loc, conf, pb, num_classes=3,
+                                 keep_top_k=5)
+    B = 2
+    feeds = {
+        "feat": rng.randn(B, 16).astype(np.float32),
+        "loc": np.zeros((B, P * 4), np.float32),
+        "conf": rng.randn(B, P * 3).astype(np.float32) * 0.1,
+        "gt": np.tile(np.array([[1, 0.1, 0.1, 0.45, 0.5,
+                                 -1, 0, 0, 0, 0]], np.float32), (B, 1)),
+    }
+    outs, _, _ = forward([loss, det], feeds)
+    lv = np.asarray(outs[0])
+    assert lv.shape == (B, 1) and np.all(np.isfinite(lv)) and np.all(lv > 0)
+    dv = np.asarray(outs[1]).reshape(B, 5, 6)
+    # at least one detection slot filled, scores in [0,1]
+    filled = dv[dv[:, :, 0] >= 0]
+    assert filled.size > 0
+    assert np.all(filled[:, 1] >= 0) and np.all(filled[:, 1] <= 1)
+
+
+def test_nms_suppresses_overlaps():
+    from paddle_tpu.ops import detection as pdet
+    boxes = jnp.array([[0.0, 0.0, 0.4, 0.4],
+                       [0.02, 0.02, 0.42, 0.42],   # overlaps box 0
+                       [0.6, 0.6, 0.9, 0.9]])
+    scores = jnp.array([0.9, 0.8, 0.7])
+    keep, ok = pdet.nms(boxes, scores, iou_threshold=0.5, max_keep=3)
+    kept = set(np.asarray(keep)[np.asarray(ok)].tolist())
+    assert kept == {0, 2}
