@@ -344,11 +344,18 @@ def _pool2d(ins, attrs, ctx):
                           attrs.get("pooling_type", "max"))]}
 
 
-@register("pool2d_with_index", family="pool", no_grad=True)
+@register("pool2d_with_index", family="pool")
 def _pool2d_with_index(ins, attrs, ctx):
     x = _dat(_one(ins, "X"))
     k, s = _pair(attrs.get("ksize", 2)), _pair(attrs.get("strides", 1))
     p = _pair(attrs.get("paddings", 0))
+    window = (1, 1) + k
+    stride = (1, 1) + s
+    pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    # Out through the differentiable single-operand reduce_window; the index
+    # Mask through a stop_gradient variadic pass (its transpose is undefined)
+    out = lax.reduce_window(x, -jnp.inf, lax.max, window, stride, pads)
+
     n, c, h, w = x.shape
     flat_idx = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
     flat_idx = jnp.broadcast_to(flat_idx, x.shape)
@@ -359,12 +366,10 @@ def _pool2d_with_index(ins, attrs, ctx):
         take = cv > av
         return jnp.where(take, cv, av), jnp.where(take, ci, ai)
 
-    window = (1, 1) + k
-    stride = (1, 1) + s
-    pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
-    out, idx = lax.reduce_window((x, flat_idx), (-jnp.inf, -1.0),
-                                 sel, window, stride, pads)
-    return {"Out": [out], "Mask": [idx.astype(jnp.int32)]}
+    _, idx = lax.reduce_window(
+        (lax.stop_gradient(x), flat_idx), (-jnp.inf, -1.0),
+        sel, window, stride, pads)
+    return {"Out": [out], "Mask": [lax.stop_gradient(idx).astype(jnp.int32)]}
 
 
 # ---------------------------------------------------------------------------

@@ -1684,7 +1684,15 @@ def multi_binary_label_cross_entropy_cost(input, label,
     name = name or unique_name("multi_binary_label_xent")
 
     def compute(ctx, p, ins):
-        return _per_example(ploss.multi_binary_label_cross_entropy, ins[0], ins[1])
+        def f(lg, lb):
+            # an integer [B] label against [B, 1] logits must not broadcast
+            # to [B, B]
+            if lb.size == lg.size:
+                lb = lb.reshape(lg.shape)
+            return ploss.multi_binary_label_cross_entropy(
+                lg, lb.astype(lg.dtype))
+
+        return _per_example(f, ins[0], ins[1])
 
     return _cost_node(name, "multi_binary_label_xent", [input, label], compute)
 
@@ -2536,6 +2544,20 @@ def priorbox(input, image_size, min_size, max_size=(), aspect_ratio=(2.0,),
     return node
 
 
+def _gather_ssd_preds(ins, k, num_classes):
+    """Concat per-feature-map loc/conf predictions + split the prior blob
+    (shared by multibox_loss and detection_output so train-time matching
+    and inference-time decoding can never disagree on packing)."""
+    loc = jnp.concatenate(
+        [_data_of(v).reshape(_data_of(v).shape[0], -1, 4)
+         for v in ins[:k]], axis=1)
+    conf = jnp.concatenate(
+        [_data_of(v).reshape(_data_of(v).shape[0], -1, num_classes)
+         for v in ins[k:2 * k]], axis=1)
+    pb = _data_of(ins[2 * k])[0]
+    return loc, conf, pb
+
+
 def _split_priors(pb_flat, num_p):
     boxes = pb_flat[: num_p * 4].reshape(num_p, 4)
     var = pb_flat[num_p * 4:].reshape(num_p, 4)
@@ -2559,13 +2581,7 @@ def multibox_loss(input_loc, input_conf, priorbox, label, num_classes: int,
 
     def compute(ctx, p, ins):
         k = len(locs)
-        loc = jnp.concatenate(
-            [_data_of(v).reshape(_data_of(v).shape[0], -1, 4)
-             for v in ins[:k]], axis=1)
-        conf = jnp.concatenate(
-            [_data_of(v).reshape(_data_of(v).shape[0], -1, num_classes)
-             for v in ins[k:2 * k]], axis=1)
-        pb = _data_of(ins[2 * k])[0]
+        loc, conf, pb = _gather_ssd_preds(ins, k, num_classes)
         gt = _data_of(ins[2 * k + 1]).reshape(loc.shape[0], max_boxes, 5)
         boxes, var = _split_priors(pb, num_p)
 
@@ -2601,13 +2617,7 @@ def detection_output(input_loc, input_conf, priorbox, num_classes: int,
 
     def compute(ctx, p, ins):
         k = len(locs)
-        loc = jnp.concatenate(
-            [_data_of(v).reshape(_data_of(v).shape[0], -1, 4)
-             for v in ins[:k]], axis=1)
-        conf = jnp.concatenate(
-            [_data_of(v).reshape(_data_of(v).shape[0], -1, num_classes)
-             for v in ins[k:2 * k]], axis=1)
-        pb = _data_of(ins[2 * k])[0]
+        loc, conf, pb = _gather_ssd_preds(ins, k, num_classes)
         boxes, var = _split_priors(pb, num_p)
 
         def one(loc_i, conf_i):

@@ -1,0 +1,67 @@
+"""DeepFM CTR model — the sparse/embedding-distribution gate model.
+
+Reference analog: the wide-and-deep / CTR workloads the reference's sparse
+parameter-server path existed for (large_model_dist_train.md; the v1 ctr
+demo family). Built on the v2 layer DSL; the embedding tables are the
+parameters one row-shards with parallel/sparse.py at scale.
+
+Architecture (Guo et al. 2017): for F categorical fields over a shared
+vocab: first-order weights w[field_id], second-order FM term
+0.5*((Σv_f)² − Σv_f²) over k-dim factor embeddings, and a deep MLP over
+the concatenated embeddings. Output: logistic CTR probability.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from paddle_tpu import layer
+from paddle_tpu.attr import ParamAttr
+
+
+def build(num_fields: int = 8, vocab_size: int = 1024, factor_dim: int = 8,
+          deep_layers: Tuple[int, ...] = (64, 32)):
+    """Returns (field_inputs, label, prob, cost).
+
+    Each field is an integer_value input (one id per field per example);
+    all fields share one vocab/embedding table pair — the standard packed
+    layout for row-sharded tables."""
+    from paddle_tpu import data_type
+
+    fields = [layer.data(name=f"field_{i}",
+                         type=data_type.integer_value(vocab_size))
+              for i in range(num_fields)]
+    label = layer.data(name="label", type=data_type.integer_value(2))
+
+    # shared tables: first-order [vocab, 1], factors [vocab, k]
+    w_attr = ParamAttr(name="deepfm.w1")
+    v_attr = ParamAttr(name="deepfm.v")
+    firsts = [layer.embedding(f, size=1, param_attr=w_attr) for f in fields]
+    embeds = [layer.embedding(f, size=factor_dim, param_attr=v_attr)
+              for f in fields]
+
+    first_order = layer.addto(firsts, bias_attr=True)
+
+    # FM second order: 0.5 * ((Σv)^2 - Σ v^2) summed over k
+    sum_v = layer.addto(embeds)
+    sum_sq = layer.dotmul(sum_v, sum_v)
+    sq_sum = layer.addto([layer.dotmul(e, e) for e in embeds])
+    from paddle_tpu.initializer import Constant
+    second = layer.mixed(
+        input=layer.identity_projection(sum_sq + layer.slope_intercept(
+            sq_sum, slope=-1.0)), size=factor_dim)
+    second_order = layer.fc(second, size=1, bias_attr=False,
+                            param_attr=ParamAttr(initializer=Constant(0.5)))
+
+    deep = layer.concat(embeds)
+    for width in deep_layers:
+        deep = layer.fc(deep, size=width, act="relu")
+    deep_out = layer.fc(deep, size=1, bias_attr=False)
+
+    logit = layer.addto([first_order, second_order, deep_out])
+    prob = layer.mixed(input=layer.identity_projection(logit), size=1,
+                       act="sigmoid")
+    # the BCE cost takes LOGITS (sigmoid applied inside, stable form)
+    cost = layer.multi_binary_label_cross_entropy_cost(input=logit,
+                                                       label=label)
+    return fields, label, prob, cost

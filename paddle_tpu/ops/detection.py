@@ -11,7 +11,7 @@ static max_keep budget. All of it jits and batches with vmap.
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -141,13 +141,16 @@ def match_priors(priors: jax.Array, gt_boxes: jax.Array,
     best_gt = jnp.argmax(iou, axis=1)                   # [P]
     best_iou = jnp.max(iou, axis=1)
     match = jnp.where(best_iou >= overlap_threshold, best_gt, -1)
-    # bipartite pass: every valid gt claims its best prior
+    # bipartite pass: every valid gt claims its best prior. Non-claiming
+    # gts are routed to an out-of-range index and dropped — a stale write
+    # from an invalid gt must not clobber a real claim (scatter with
+    # duplicate indices is order-undefined)
     best_prior = jnp.argmax(iou, axis=0)                # [G]
     g_idx = jnp.arange(gt_boxes.shape[0])
     has_any = jnp.max(iou, axis=0) > 0
     claim = gt_valid & has_any
-    match = match.at[best_prior].set(
-        jnp.where(claim, g_idx, match[best_prior]))
+    tgt = jnp.where(claim, best_prior, priors.shape[0])
+    match = match.at[tgt].set(g_idx, mode="drop")
     return match.astype(jnp.int32), best_iou
 
 
@@ -195,12 +198,15 @@ def multibox_loss(loc_pred: jax.Array, conf_pred: jax.Array,
 
 
 def nms(boxes: jax.Array, scores: jax.Array, iou_threshold: float,
-        max_keep: int) -> Tuple[jax.Array, jax.Array]:
-    """Greedy NMS with a static keep budget.
+        max_keep: int, iou: Optional[jax.Array] = None
+        ) -> Tuple[jax.Array, jax.Array]:
+    """Greedy NMS with a static keep budget. Pass a precomputed ``iou``
+    matrix when suppressing the same boxes for many classes.
 
     Returns (keep_idx [max_keep] int32 (-1 padded), keep_mask [max_keep])."""
     n = boxes.shape[0]
-    iou = iou_matrix(boxes, boxes)
+    if iou is None:
+        iou = iou_matrix(boxes, boxes)
 
     def body(i, state):
         alive, keep_idx, keep_ok = state
@@ -232,13 +238,15 @@ def detection_output(loc_pred: jax.Array, conf_pred: jax.Array,
     xmin, ymin, xmax, ymax); invalid rows have label -1."""
     boxes = decode_boxes(loc_pred, priors, prior_var)      # [P, 4]
     probs = jax.nn.softmax(conf_pred, axis=-1)             # [P, C]
+    iou = iou_matrix(boxes, boxes)       # class-invariant: computed once
 
     per_class = keep_top_k
 
     def one_class(c):
         scores = jnp.where(probs[:, c] >= confidence_threshold,
                            probs[:, c], -jnp.inf)
-        keep_idx, keep_ok = nms(boxes, scores, nms_threshold, per_class)
+        keep_idx, keep_ok = nms(boxes, scores, nms_threshold, per_class,
+                                iou=iou)
         safe = jnp.maximum(keep_idx, 0)
         det = jnp.concatenate([
             jnp.full((per_class, 1), c, jnp.float32),
