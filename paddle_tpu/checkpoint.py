@@ -1,0 +1,124 @@
+"""Checkpoint/resume with optimizer state.
+
+Reference analog: per-pass parameter dirs ``pass-%05d`` written by
+ParamUtil::saveParameters (trainer/ParamUtil.h:77-96), resume via
+--start_pass/--init_model_path (ParamUtil.h:108-111), and the Gen-cloud
+optimizer-state-inclusive checkpoints with md5+meta written atomically
+(go/pserver/service.go:76-152, OptimizerConfig.proto *OptimizerState).
+
+Layout per pass::
+
+    <dir>/pass-00007/
+        params.tar      # weights (v2 Parameters tar format)
+        state.pkl       # optimizer slots + model state (np arrays)
+        meta.json       # pass id, md5 of both blobs, timestamp
+
+Writes are atomic (tmp + rename) like the Go pserver's checkpoint path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import re
+import tempfile
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from paddle_tpu.parameters import Parameters
+from paddle_tpu.platform.enforce import EnforceError, enforce_that
+
+_PASS_RE = re.compile(r"^pass-(\d{5})$")
+
+
+def _to_numpy_tree(tree):
+    import jax
+    return jax.tree.map(lambda x: np.asarray(x), tree)
+
+
+def _md5(path: str) -> str:
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _atomic_write(path: str, writer) -> None:
+    d = os.path.dirname(path)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            writer(f)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def pass_dir(root: str, pass_id: int) -> str:
+    return os.path.join(root, f"pass-{pass_id:05d}")
+
+
+def save_checkpoint(root: str, pass_id: int, parameters: Parameters,
+                    opt_state: Any = None, model_state: Any = None,
+                    extra_meta: Optional[Dict] = None) -> str:
+    d = pass_dir(root, pass_id)
+    os.makedirs(d, exist_ok=True)
+    params_path = os.path.join(d, "params.tar")
+    state_path = os.path.join(d, "state.pkl")
+    _atomic_write(params_path, parameters.to_tar)
+    _atomic_write(state_path, lambda f: pickle.dump(
+        {"opt_state": _to_numpy_tree(opt_state),
+         "model_state": _to_numpy_tree(model_state)}, f))
+    meta = {"pass_id": pass_id,
+            "params_md5": _md5(params_path),
+            "state_md5": _md5(state_path),
+            "timestamp": time.time()}
+    meta.update(extra_meta or {})
+    _atomic_write(os.path.join(d, "meta.json"),
+                  lambda f: f.write(json.dumps(meta).encode()))
+    return d
+
+
+def latest_pass(root: str) -> Optional[int]:
+    if not os.path.isdir(root):
+        return None
+    best = None
+    for name in os.listdir(root):
+        m = _PASS_RE.match(name)
+        if m and os.path.exists(os.path.join(root, name, "meta.json")):
+            p = int(m.group(1))
+            best = p if best is None else max(best, p)
+    return best
+
+
+def load_checkpoint(root: str, pass_id: Optional[int] = None
+                    ) -> Tuple[Parameters, Any, Any, Dict]:
+    """Returns (parameters, opt_state, model_state, meta). Verifies md5
+    integrity (the etcd-meta check of the Go pserver)."""
+    if pass_id is None:
+        pass_id = latest_pass(root)
+        enforce_that(pass_id is not None, f"no checkpoints under {root}",
+                     context="checkpoint")
+    d = pass_dir(root, pass_id)
+    with open(os.path.join(d, "meta.json")) as f:
+        meta = json.load(f)
+    params_path = os.path.join(d, "params.tar")
+    state_path = os.path.join(d, "state.pkl")
+    if _md5(params_path) != meta["params_md5"]:
+        raise EnforceError(f"corrupt checkpoint params {params_path}",
+                           context="checkpoint")
+    if _md5(state_path) != meta["state_md5"]:
+        raise EnforceError(f"corrupt checkpoint state {state_path}",
+                           context="checkpoint")
+    with open(params_path, "rb") as f:
+        params = Parameters.from_tar(f)
+    with open(state_path, "rb") as f:
+        st = pickle.load(f)
+    return params, st["opt_state"], st["model_state"], meta

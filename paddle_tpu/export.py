@@ -1,0 +1,123 @@
+"""Merged-model export: one self-contained inference artifact.
+
+Reference analog: ``paddle merge_model`` (trainer/MergeModel.cpp) packs
+ModelConfig proto + weights into a single file consumed by the C
+inference API (paddle/capi gradient_machine loading).
+
+TPU-native design: instead of a config proto + a C++ engine to interpret
+it, the whole forward graph is compiled and serialized as **StableHLO**
+via ``jax.export`` with the trained weights baked in as constants. The
+artifact is a zip with the serialized executable plus a json manifest of
+input/output specs. Loading needs no layer library at all — any PJRT
+runtime (incl. the C API used by capi_runtime.cpp) can execute it, which
+is the capability the reference's merged model + capi pair provided.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from paddle_tpu.parameters import Parameters
+from paddle_tpu.platform.enforce import enforce_that
+from paddle_tpu.topology import LayerOutput, Topology
+
+_FORMAT_VERSION = 1
+
+
+def merge_model(output_layers, parameters: Parameters, path: str,
+                batch_size: Optional[int] = None) -> None:
+    """Compile forward(feeds) with weights baked in and write ``path``.
+
+    ``batch_size=None`` exports with a symbolic batch dimension (any
+    batch size at load time); an int pins it."""
+    import jax
+    from jax import export as jexport
+
+    outs = output_layers if isinstance(output_layers, (list, tuple)) \
+        else [output_layers]
+    topo = Topology(list(outs))
+    state = topo.init_state()
+    params = {k: np.asarray(v) for k, v in parameters.as_dict().items()}
+
+    data_nodes = [n for n in topo.nodes if n.layer_type == "data"]
+    data_nodes.sort(key=lambda n: getattr(n, "declare_idx", 0))
+    feed_specs = []
+    for n in data_nodes:
+        enforce_that(not n.is_sequence,
+                     "merge_model currently exports dense-input graphs "
+                     "(sequence feeds carry host-side ragged metadata)",
+                     context="export")
+        if "INTEGER" in str(getattr(n.input_type, "kind", "")).upper() \
+                or getattr(n.input_type, "dtype", None) == "int32":
+            dtype = "int32"
+            shape: Tuple = ()
+        else:
+            dtype = "float32"
+            shape = (n.size,)
+        feed_specs.append({"name": n.name, "dtype": dtype,
+                           "feature_shape": list(shape)})
+
+    if batch_size is None:
+        (b,) = jexport.symbolic_shape("b")
+    else:
+        b = int(batch_size)
+
+    args = tuple(
+        jax.ShapeDtypeStruct((b,) + tuple(s["feature_shape"]),
+                             np.dtype(s["dtype"]))
+        for s in feed_specs)
+
+    def forward(*feed_vals):
+        feeds = {s["name"]: v for s, v in zip(feed_specs, feed_vals)}
+        outs_v, _ = topo.forward(params, state, feeds, train=False)
+        return tuple(o.data if hasattr(o, "segment_ids") else o
+                     for o in outs_v)
+
+    exported = jexport.export(jax.jit(forward))(*args)
+    blob = exported.serialize()
+
+    manifest = {
+        "format_version": _FORMAT_VERSION,
+        "inputs": feed_specs,
+        "outputs": [n.name for n in outs],
+        "symbolic_batch": batch_size is None,
+    }
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("manifest.json", json.dumps(manifest))
+        z.writestr("model.stablehlo", blob)
+
+
+class MergedModel:
+    """Loaded merged model: ``infer(feeds)`` with no layer library needed
+    (the capi paddle_gradient_machine_create_for_inference analog)."""
+
+    def __init__(self, path: str):
+        from jax import export as jexport
+
+        with zipfile.ZipFile(path) as z:
+            self.manifest = json.loads(z.read("manifest.json"))
+            enforce_that(
+                self.manifest.get("format_version") == _FORMAT_VERSION,
+                "unsupported merged-model version", context="export")
+            self._exported = jexport.deserialize(z.read("model.stablehlo"))
+        self.input_names = [s["name"] for s in self.manifest["inputs"]]
+        self.output_names = self.manifest["outputs"]
+
+    def infer(self, feeds: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        args = []
+        for spec in self.manifest["inputs"]:
+            enforce_that(spec["name"] in feeds,
+                         f"missing feed {spec['name']!r}", context="export")
+            args.append(np.asarray(feeds[spec["name"]],
+                                   dtype=np.dtype(spec["dtype"])))
+        outs = self._exported.call(*args)
+        return [np.asarray(o) for o in outs]
+
+
+def load_merged_model(path: str) -> MergedModel:
+    return MergedModel(path)

@@ -5,3 +5,7 @@ from paddle_tpu.models import lenet
 from paddle_tpu.models import alexnet
 from paddle_tpu.models import resnet
 from paddle_tpu.models import text_lstm
+from paddle_tpu.models import seq2seq
+from paddle_tpu.models import deepfm
+from paddle_tpu.models import gan
+from paddle_tpu.models import vae

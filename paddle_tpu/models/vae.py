@@ -1,0 +1,71 @@
+"""Variational autoencoder.
+
+Reference analog: v1_api_demo/vae/vae_train.py + vae_conf.py (MLP
+encoder/decoder, reparameterised gaussian latent, BCE reconstruction +
+KL). The reparameterisation noise comes from the per-step rng stream the
+trainer already threads through the graph (ctx.rng_for), so the whole
+model stays one pure jitted function.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import data_type, layer
+from paddle_tpu.topology import LayerOutput, unique_name
+
+
+def _gaussian_sample(mu, logvar):
+    """z = mu + eps * exp(0.5*logvar), eps ~ N(0, I) from the step rng."""
+    name = unique_name("vae_sample")
+
+    def compute(ctx, p, ins):
+        m, lv = ins[0], ins[1]
+        md = m.data if hasattr(m, "segment_ids") else m
+        lvd = lv.data if hasattr(lv, "segment_ids") else lv
+        eps = jax.random.normal(ctx.rng_for(name), md.shape, md.dtype)
+        return md + eps * jnp.exp(0.5 * lvd)
+
+    return LayerOutput(name=name, layer_type="gaussian_sample",
+                       inputs=[mu, logvar], fn=compute, size=mu.size)
+
+
+def _kl_cost(mu, logvar):
+    """KL(q(z|x) || N(0,I)) per example."""
+    name = unique_name("vae_kl")
+
+    def compute(ctx, p, ins):
+        m, lv = ins[0], ins[1]
+        return -0.5 * jnp.sum(1.0 + lv - jnp.square(m) - jnp.exp(lv),
+                              axis=-1)
+
+    node = LayerOutput(name=name, layer_type="vae_kl",
+                       inputs=[mu, logvar], fn=compute, size=1)
+    node.is_cost = True
+    return node
+
+
+def build(data_dim: int = 32, hidden: Tuple[int, ...] = (64,),
+          latent_dim: int = 8):
+    """Returns (x, recon, cost) — cost = BCE(recon, x) + KL."""
+    x = layer.data(name="pixel", type=data_type.dense_vector(data_dim))
+    h = x
+    for i, d in enumerate(hidden):
+        h = layer.fc(h, size=d, act="relu", name=f"vae_enc{i}")
+    mu = layer.fc(h, size=latent_dim, name="vae_mu")
+    logvar = layer.fc(h, size=latent_dim, name="vae_logvar")
+    z = _gaussian_sample(mu, logvar)
+    g = z
+    for i, d in enumerate(reversed(hidden)):
+        g = layer.fc(g, size=d, act="relu", name=f"vae_dec{i}")
+    recon_logit = layer.fc(g, size=data_dim, name="vae_recon")
+    recon = layer.mixed(input=layer.identity_projection(recon_logit),
+                        size=data_dim, act="sigmoid")
+    bce = layer.multi_binary_label_cross_entropy_cost(input=recon_logit,
+                                                      label=x)
+    cost = layer.addto([bce, _kl_cost(mu, logvar)])
+    cost.is_cost = True
+    return x, recon, cost

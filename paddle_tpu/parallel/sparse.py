@@ -89,14 +89,26 @@ def adagrad_update_rows(table: jax.Array, accum: jax.Array,
                         grad: SelectedRows, lr: float,
                         epsilon: float = 1e-6
                         ) -> Tuple[jax.Array, jax.Array]:
-    """Row-sparse Adagrad. Note: duplicate ids within one batch are
-    pre-combined so the accumulator sees each row once."""
-    dense_rows = jnp.zeros_like(table).at[grad.ids].add(grad.rows)
-    touched = jnp.zeros((table.shape[0], 1), bool).at[grad.ids].set(True)
-    accum_new = jnp.where(touched, accum + jnp.square(dense_rows), accum)
-    step = jnp.where(touched,
-                     lr * dense_rows / (jnp.sqrt(accum_new) + epsilon), 0.0)
-    return table - step, accum_new
+    """Row-sparse Adagrad: O(n_rows * dim) work, no dense temporaries.
+
+    Duplicate ids are pre-combined (segment-sum over the deduped slots)
+    so the accumulator sees each touched row exactly once."""
+    n = grad.ids.shape[0]
+    uniq, inv = jnp.unique(grad.ids, size=n, fill_value=-1,
+                           return_inverse=True)
+    pad = uniq < 0
+    safe = jnp.clip(uniq, 0, table.shape[0] - 1)
+    combined = jax.ops.segment_sum(grad.rows, inv.reshape(-1),
+                                   num_segments=n)
+    combined = jnp.where(pad[:, None], 0.0, combined)
+    acc_rows = jnp.take(accum, safe, axis=0) + jnp.square(combined)
+    step = lr * combined / (jnp.sqrt(acc_rows) + epsilon)
+    tab_rows = jnp.take(table, safe, axis=0) - jnp.where(
+        pad[:, None], 0.0, step)
+    acc_keep = jnp.where(pad[:, None], jnp.take(accum, safe, axis=0),
+                         acc_rows)
+    return (table.at[safe].set(tab_rows),
+            accum.at[safe].set(acc_keep))
 
 
 # ---------------------------------------------------------------------------

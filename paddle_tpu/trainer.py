@@ -149,19 +149,39 @@ class SGD:
     # ------------------------------------------------------------------
 
     def train(self, reader, num_passes: int = 1, event_handler=None,
-              feeding=None, test_reader=None) -> None:
+              feeding=None, test_reader=None, save_dir: Optional[str] = None,
+              start_pass: int = 0, saving_period: int = 1) -> None:
+        """``save_dir``/``start_pass``/``saving_period`` are the
+        --save_dir/--start_pass/--saving_period flags of the reference
+        trainer (ParamUtil.h:77-111): checkpoints (params + optimizer
+        state) land in save_dir/pass-%05d every ``saving_period`` passes,
+        and ``start_pass`` resumes from an existing one if present."""
         if event_handler is None:
             event_handler = _default_event_handler
         feeder = self._make_feeder(feeding)
         if self._step_fn is None:
             self._step_fn = self._build_step()
 
+        if save_dir is not None and start_pass > 0:
+            import os
+
+            from paddle_tpu import checkpoint as ckpt
+            # resume from exactly pass start_pass-1 (newer checkpoints may
+            # exist when re-branching; silently training from fresh init
+            # would overwrite them with garbage)
+            want = start_pass - 1
+            enforce_that(os.path.isdir(ckpt.pass_dir(save_dir, want)),
+                         f"start_pass={start_pass} but no checkpoint "
+                         f"pass-{want:05d} under {save_dir}",
+                         context="trainer")
+            self.load_checkpoint(save_dir, want)
+
         params = self.parameters.as_dict()
         opt_state = self.opt_state
         mstate = self.model_state
         log = plog.logger()
 
-        for pass_id in range(num_passes):
+        for pass_id in range(start_pass, start_pass + num_passes):
             event_handler(v2_event.BeginPass(pass_id))
             # host-side floats; device scalars buffer in `pending` and flush
             # with ONE stacked transfer per stream per log window
@@ -211,6 +231,8 @@ class SGD:
                 event_handler(v2_event.EndPass(pass_id, tr.metrics, self.parameters))
             else:
                 event_handler(v2_event.EndPass(pass_id, result_metrics, self.parameters))
+            if save_dir is not None and (pass_id + 1) % saving_period == 0:
+                self.save_checkpoint(save_dir, pass_id)
 
         self.parameters.update_from(params)
         self.opt_state = opt_state
@@ -241,6 +263,149 @@ class SGD:
     def save_parameter_to_tar(self, f) -> None:
         self.parameters.to_tar(f)
 
+    # ------------------------------------------------------------------
+    # checkpoint/resume incl. optimizer state (ParamUtil + go/pserver
+    # checkpoint analogs — see paddle_tpu/checkpoint.py)
+    # ------------------------------------------------------------------
+
+    def save_checkpoint(self, root: str, pass_id: int) -> str:
+        from paddle_tpu import checkpoint as ckpt
+        return ckpt.save_checkpoint(root, pass_id, self.parameters,
+                                    opt_state=self.opt_state,
+                                    model_state=self.model_state)
+
+    def load_checkpoint(self, root: str, pass_id: Optional[int] = None) -> None:
+        from paddle_tpu import checkpoint as ckpt
+        params, opt_state, model_state, meta = ckpt.load_checkpoint(
+            root, pass_id)
+        self.parameters.update_from(params.as_dict())
+        if opt_state is not None:
+            self.opt_state = opt_state
+        if model_state is not None:
+            self.model_state = model_state
+
 
 def _default_event_handler(ev) -> None:
     pass
+
+
+# ---------------------------------------------------------------------------
+# Multi-task / alternating training (the GAN capability)
+# ---------------------------------------------------------------------------
+
+
+class TaskSpec:
+    """One optimization task: a cost node, its optimizer, and a predicate
+    naming which parameters it updates (v1_api_demo/gan/gan_trainer.py
+    analog — two networks, alternating training)."""
+
+    def __init__(self, name: str, cost, update_equation: Optimizer,
+                 trainable=None):
+        self.name = name
+        self.cost = cost
+        self.optimizer = update_equation
+        if trainable is None:
+            self.trainable = lambda pname: True
+        elif isinstance(trainable, str):
+            prefix = trainable
+            self.trainable = lambda pname: pname.startswith(prefix)
+        elif isinstance(trainable, (list, tuple, set, frozenset)):
+            names = set(trainable)
+            self.trainable = lambda pname: pname in names
+        else:
+            self.trainable = trainable
+
+
+class MultiTaskTrainer:
+    """Alternating training of several cost graphs over ONE shared
+    parameter store — the reference's GAN loop (gan_trainer.py: generator
+    and discriminator configs trained alternately against shared
+    parameters) without its separate GradientMachines: each task is its
+    own jitted step that masks gradients to its parameter subset.
+
+    Usage::
+
+        t = MultiTaskTrainer([
+            TaskSpec("d", d_cost, Adam(2e-4), trainable="dis_"),
+            TaskSpec("g", g_cost, Adam(2e-4), trainable="gen_"),
+        ], parameters)
+        d_loss = t.step("d", {"pixel": real, "noise": z})
+        g_loss = t.step("g", {"noise": z})
+    """
+
+    def __init__(self, tasks: Sequence[TaskSpec], parameters: Parameters,
+                 mesh=None):
+        enforce_that(len(tasks) > 0, "need at least one task",
+                     context="MultiTaskTrainer")
+        self.tasks = {t.name: t for t in tasks}
+        self.parameters = parameters
+        self.mesh = mesh
+        self._topos: Dict[str, Topology] = {}
+        self._opt_states: Dict[str, Any] = {}
+        self._model_states: Dict[str, Any] = {}
+        self._step_fns: Dict[str, Any] = {}
+        self._rng = jax.random.PRNGKey(FLAGS.seed or 0)
+        self._counts: Dict[str, int] = {}
+        for t in tasks:
+            topo = Topology([t.cost])
+            self._topos[t.name] = topo
+            t.optimizer.set_param_specs(topo.param_specs())
+            subset = {k: v for k, v in parameters.as_dict().items()
+                      if t.trainable(k)}
+            enforce_that(len(subset) > 0,
+                         f"task {t.name!r} trains no parameters",
+                         context="MultiTaskTrainer")
+            self._opt_states[t.name] = t.optimizer.init_state(subset)
+            self._model_states[t.name] = topo.init_state()
+            self._counts[t.name] = 0
+
+    def _build(self, name: str):
+        task = self.tasks[name]
+        topo = self._topos[name]
+        optimizer = task.optimizer
+        trainable = task.trainable
+
+        def step(params, opt_state, model_state, rng, feeds):
+            def loss_fn(p):
+                outs, new_state = topo.forward(p, model_state, feeds,
+                                               train=True, rng=rng)
+                return _reduce_cost(outs[0]), new_state
+
+            (loss, new_mstate), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            sub_p = {k: v for k, v in params.items() if trainable(k)}
+            sub_g = {k: grads[k] for k in sub_p}
+            new_sub, new_opt = optimizer.apply(sub_p, sub_g, opt_state)
+            new_params = dict(params)
+            new_params.update(new_sub)
+            return loss, new_params, new_opt, new_mstate
+
+        return jax.jit(step, donate_argnums=(1,))
+
+    def step(self, name: str, feeds: Dict[str, Any]) -> float:
+        """Run one optimization step of the named task; other tasks'
+        parameters flow through the graph but are not updated."""
+        enforce_that(name in self.tasks, f"unknown task {name!r}",
+                     context="MultiTaskTrainer")
+        fn = self._step_fns.get(name)
+        if fn is None:
+            fn = self._step_fns[name] = self._build(name)
+        self._rng, sub = jax.random.split(self._rng)
+        loss, new_params, new_opt, new_mstate = fn(
+            self.parameters.as_dict(), self._opt_states[name],
+            self._model_states[name], sub, feeds)
+        self.parameters.update_from(new_params)
+        self._opt_states[name] = new_opt
+        self._model_states[name] = new_mstate
+        # stateful slots (batch-norm stats) shared across task graphs by
+        # node name: propagate updates into the other tasks' state maps
+        for other, st in self._model_states.items():
+            if other != name:
+                for node_name, slots in new_mstate.items():
+                    if node_name in st:
+                        st[node_name] = slots
+        self._counts[name] += 1
+        return float(loss)
+
+    def steps_run(self, name: str) -> int:
+        return self._counts[name]
