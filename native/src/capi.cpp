@@ -1,0 +1,152 @@
+// C inference ABI over merged models.
+//
+// Reference analog: paddle/capi — the pure-C surface embedded apps link
+// against (paddle_gradient_machine_create_for_inference_with_parameters,
+// _forward; capi/gradient_machine.h:36-112) driving the C++ engine on a
+// merged single-file model.
+//
+// TPU-native design: the merged model is a serialized StableHLO program
+// (paddle_tpu/export.py); this C ABI hosts an embedded CPython running
+// the PJRT-backed loader, the same way the reference's engine embedded
+// Python for data providers (utils/PythonUtil). Embedders get plain
+// float-in / float-out calls and never see Python.
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+namespace {
+
+std::once_flag g_init_once;
+
+struct Model {
+  PyObject* model = nullptr;  // paddle_tpu.export.MergedModel
+};
+
+void ensure_python() {
+  std::call_once(g_init_once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // drop the GIL the init thread holds, or every other embedder
+      // thread deadlocks in PyGILState_Ensure
+      PyEval_SaveThread();
+    }
+  });
+}
+
+}  // namespace
+
+extern "C" {
+
+// Load a merged model file. Returns a handle or nullptr.
+void* ptpu_model_load(const char* path) {
+  ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Model* out = nullptr;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.export");
+  if (mod) {
+    PyObject* loader = PyObject_GetAttrString(mod, "load_merged_model");
+    if (loader) {
+      PyObject* m = PyObject_CallFunction(loader, "s", path);
+      if (m) {
+        out = new Model();
+        out->model = m;
+      }
+      Py_DECREF(loader);
+    }
+    Py_DECREF(mod);
+  }
+  if (!out) PyErr_Print();
+  PyGILState_Release(gil);
+  return out;
+}
+
+// Single dense float input -> first output. Returns 0 on success.
+// out_rows/out_cols receive the result shape; out must hold
+// out_capacity floats.
+int ptpu_infer(void* handle, const char* input_name, const float* data,
+               int64_t batch, int64_t dim, float* out, int64_t out_capacity,
+               int64_t* out_rows, int64_t* out_cols) {
+  auto* m = static_cast<Model*>(handle);
+  if (!m || !m->model) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  // build a python list-of-lists (no numpy C API dependency here; the
+  // loader converts via np.asarray)
+  PyObject* rows = PyList_New(batch);
+  for (int64_t r = 0; r < batch; ++r) {
+    PyObject* row = PyList_New(dim);
+    for (int64_t c = 0; c < dim; ++c)
+      PyList_SET_ITEM(row, c, PyFloat_FromDouble(data[r * dim + c]));
+    PyList_SET_ITEM(rows, r, row);
+  }
+  PyObject* feeds = PyDict_New();
+  PyDict_SetItemString(feeds, input_name, rows);
+  Py_DECREF(rows);
+
+  PyObject* outs = PyObject_CallMethod(m->model, "infer", "O", feeds);
+  Py_DECREF(feeds);
+  if (outs) {
+    PyObject* first = PySequence_GetItem(outs, 0);
+    if (first) {
+      PyObject* lst =
+          PyObject_CallMethod(first, "tolist", nullptr);  // ndarray -> lists
+      if (lst) {
+        int64_t n_rows = PySequence_Size(lst);
+        int64_t n_cols = 1;
+        bool flat = false;  // 1-D output: tolist() rows are floats
+        if (n_rows > 0) {
+          PyObject* r0 = PySequence_GetItem(lst, 0);
+          if (PySequence_Check(r0)) {
+            n_cols = PySequence_Size(r0);
+          } else {
+            flat = true;
+            PyErr_Clear();
+          }
+          Py_DECREF(r0);
+        }
+        if (n_rows >= 0 && n_cols >= 0 &&
+            n_rows * n_cols <= out_capacity) {
+          for (int64_t r = 0; r < n_rows; ++r) {
+            if (flat) {
+              PyObject* v = PySequence_GetItem(lst, r);
+              out[r] = static_cast<float>(PyFloat_AsDouble(v));
+              Py_DECREF(v);
+              continue;
+            }
+            PyObject* row = PySequence_GetItem(lst, r);
+            for (int64_t c = 0; c < n_cols; ++c) {
+              PyObject* v = PySequence_GetItem(row, c);
+              out[r * n_cols + c] = static_cast<float>(PyFloat_AsDouble(v));
+              Py_DECREF(v);
+            }
+            Py_DECREF(row);
+          }
+          *out_rows = n_rows;
+          *out_cols = flat ? 1 : n_cols;
+          rc = 0;
+        }
+        Py_DECREF(lst);
+      }
+      Py_DECREF(first);
+    }
+    Py_DECREF(outs);
+  }
+  if (rc != 0) PyErr_Print();
+  PyGILState_Release(gil);
+  return rc;
+}
+
+void ptpu_model_release(void* handle) {
+  auto* m = static_cast<Model*>(handle);
+  if (!m) return;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(m->model);
+  PyGILState_Release(gil);
+  delete m;
+}
+
+}  // extern "C"

@@ -1,0 +1,123 @@
+// Native recordio: length-prefixed record files, C ABI.
+//
+// Reference analog: the recordio chunk library the Go master partitions
+// datasets with (go/master/service.go:106) and the C++ DataProvider file
+// readers (gserver/dataproviders/). Format matches
+// paddle_tpu/master/recordio.py: per record an 8-byte LE u64 length then
+// the payload — Python writes, C++ reads, and vice versa.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "recordio_format.h"
+
+using ptn::read_u64;
+using ptn::write_u64;
+
+namespace {
+
+struct Buf {
+  std::vector<std::string> records;
+};
+
+struct Writer {
+  FILE* f = nullptr;
+  uint64_t count = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---- writer ---------------------------------------------------------------
+
+void* ptn_write_open(const char* path) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  auto* w = new Writer();
+  w->f = f;
+  return w;
+}
+
+int ptn_write_record(void* handle, const char* data, uint64_t len) {
+  auto* w = static_cast<Writer*>(handle);
+  if (!w || !w->f) return -1;
+  if (!write_u64(w->f, len)) return -1;
+  if (len && fwrite(data, 1, len, w->f) != len) return -1;
+  w->count++;
+  return 0;
+}
+
+// Returns the record count, or UINT64_MAX if the final flush failed
+// (full disk surfaces here — stdio buffers until fclose).
+uint64_t ptn_write_close(void* handle) {
+  auto* w = static_cast<Writer*>(handle);
+  uint64_t n = w->count;
+  int rc = fclose(w->f);
+  delete w;
+  return rc == 0 ? n : UINT64_MAX;
+}
+
+// ---- index ----------------------------------------------------------------
+
+// Returns a malloc'd array of record byte offsets; caller frees with
+// ptn_free_offsets. n_out receives the count; returns 0 on success.
+int ptn_index(const char* path, uint64_t** offsets_out, uint64_t* n_out) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  std::vector<uint64_t> offs;
+  uint64_t pos = 0, len = 0;
+  while (read_u64(f, &len)) {
+    offs.push_back(pos);
+    if (fseek(f, static_cast<long>(len), SEEK_CUR) != 0) break;
+    pos += 8 + len;
+  }
+  fclose(f);
+  auto* arr = static_cast<uint64_t*>(malloc(offs.size() * sizeof(uint64_t)));
+  memcpy(arr, offs.data(), offs.size() * sizeof(uint64_t));
+  *offsets_out = arr;
+  *n_out = offs.size();
+  return 0;
+}
+
+void ptn_free_offsets(uint64_t* offsets) { free(offsets); }
+
+// ---- chunk reader ---------------------------------------------------------
+
+void* ptn_read_chunk(const char* path, uint64_t offset, uint64_t count) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  if (fseek(f, static_cast<long>(offset), SEEK_SET) != 0) {
+    fclose(f);
+    return nullptr;
+  }
+  auto* buf = new Buf();
+  uint64_t len = 0;
+  for (uint64_t i = 0; i < count && read_u64(f, &len); ++i) {
+    std::string rec(len, '\0');
+    if (len && fread(&rec[0], 1, len, f) != len) break;
+    buf->records.push_back(std::move(rec));
+  }
+  fclose(f);
+  return buf;
+}
+
+uint64_t ptn_buf_count(void* handle) {
+  return static_cast<Buf*>(handle)->records.size();
+}
+
+int ptn_buf_get(void* handle, uint64_t i, const char** data_out,
+                uint64_t* len_out) {
+  auto* buf = static_cast<Buf*>(handle);
+  if (i >= buf->records.size()) return -1;
+  *data_out = buf->records[i].data();
+  *len_out = buf->records[i].size();
+  return 0;
+}
+
+void ptn_buf_free(void* handle) { delete static_cast<Buf*>(handle); }
+
+}  // extern "C"

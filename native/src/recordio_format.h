@@ -1,0 +1,28 @@
+// Shared recordio wire helpers: 8-byte little-endian u64 length prefix
+// (must match paddle_tpu/master/recordio.py struct "<Q").
+#pragma once
+
+#include <cstdint>
+#include <cstdio>
+
+namespace ptn {
+
+inline bool read_u64(FILE* f, uint64_t* out) {
+  unsigned char b[8];
+  if (fread(b, 1, 8, f) != 8) return false;
+  uint64_t v = 0;
+  for (int i = 7; i >= 0; --i) v = (v << 8) | b[i];
+  *out = v;
+  return true;
+}
+
+inline bool write_u64(FILE* f, uint64_t v) {
+  unsigned char b[8];
+  for (int i = 0; i < 8; ++i) {
+    b[i] = static_cast<unsigned char>(v & 0xff);
+    v >>= 8;
+  }
+  return fwrite(b, 1, 8, f) == 8;
+}
+
+}  // namespace ptn

@@ -1,0 +1,114 @@
+// Async shuffling record pool — the native data-loader.
+//
+// Reference analog: PyDataProvider2's async pool thread filling a shuffle
+// buffer ahead of the trainer (gserver/dataproviders/PyDataProvider2.cpp:
+// 195,334-400) and DataProvider's double-buffered getNextBatch
+// (DataProvider.h:292). A background producer thread streams records from
+// recordio files into a bounded shuffle buffer; the consumer draws
+// uniformly from the buffer (the classic shuffle-window), overlapping disk
+// IO with device compute.
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "recordio_format.h"
+
+using ptn::read_u64;
+
+namespace {
+
+struct Pool {
+  std::vector<std::string> paths;
+  size_t window;
+  std::mt19937_64 rng;
+
+  std::mutex mu;
+  std::condition_variable not_empty, not_full;
+  std::vector<std::string> buffer;   // shuffle window
+  bool producer_done = false;
+  bool stop = false;
+  std::thread producer;
+
+  // handed-out record storage (stable address until next pop)
+  std::string current;
+
+  void produce() {
+    for (const auto& path : paths) {
+      FILE* f = fopen(path.c_str(), "rb");
+      if (!f) continue;
+      uint64_t len = 0;
+      while (read_u64(f, &len)) {
+        std::string rec(len, '\0');
+        if (len && fread(&rec[0], 1, len, f) != len) break;
+        {
+          std::unique_lock<std::mutex> lk(mu);
+          not_full.wait(lk, [&] { return buffer.size() < window || stop; });
+          if (stop) {
+            fclose(f);
+            return;
+          }
+          buffer.push_back(std::move(rec));
+        }
+        not_empty.notify_one();
+      }
+      fclose(f);
+    }
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      producer_done = true;
+    }
+    not_empty.notify_all();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ptn_pool_create(const char** paths, uint64_t n_paths, uint64_t window,
+                      uint64_t seed) {
+  auto* p = new Pool();
+  for (uint64_t i = 0; i < n_paths; ++i) p->paths.emplace_back(paths[i]);
+  p->window = window < 1 ? 1 : window;
+  p->rng.seed(seed);
+  p->producer = std::thread([p] { p->produce(); });
+  return p;
+}
+
+// Pops one record (uniform over the current shuffle window).
+// Returns 1 with (*data,*len) set, or 0 at end of data.
+// The pointer stays valid until the next ptn_pool_next / destroy.
+int ptn_pool_next(void* handle, const char** data, uint64_t* len) {
+  auto* p = static_cast<Pool*>(handle);
+  std::unique_lock<std::mutex> lk(p->mu);
+  p->not_empty.wait(lk, [&] { return !p->buffer.empty() || p->producer_done; });
+  if (p->buffer.empty()) return 0;
+  size_t i = p->rng() % p->buffer.size();
+  std::swap(p->buffer[i], p->buffer.back());
+  p->current = std::move(p->buffer.back());
+  p->buffer.pop_back();
+  lk.unlock();
+  p->not_full.notify_one();
+  *data = p->current.data();
+  *len = p->current.size();
+  return 1;
+}
+
+void ptn_pool_destroy(void* handle) {
+  auto* p = static_cast<Pool*>(handle);
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->stop = true;
+  }
+  p->not_full.notify_all();
+  if (p->producer.joinable()) p->producer.join();
+  delete p;
+}
+
+}  // extern "C"
