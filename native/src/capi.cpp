@@ -64,9 +64,9 @@ void* ptpu_model_load(const char* path) {
   return out;
 }
 
-// Single dense float input -> first output. Returns 0 on success.
-// out_rows/out_cols receive the result shape; out must hold
-// out_capacity floats.
+// Single dense float input -> first output. Returns 0 on success,
+// -2 when out_capacity is too small (out_rows/out_cols then hold the
+// required shape so the caller can resize and retry), -1 on failure.
 int ptpu_infer(void* handle, const char* input_name, const float* data,
                int64_t batch, int64_t dim, float* out, int64_t out_capacity,
                int64_t* out_rows, int64_t* out_cols) {
@@ -108,8 +108,12 @@ int ptpu_infer(void* handle, const char* input_name, const float* data,
           }
           Py_DECREF(r0);
         }
-        if (n_rows >= 0 && n_cols >= 0 &&
-            n_rows * n_cols <= out_capacity) {
+        if (n_rows >= 0 && n_cols >= 0) {
+          *out_rows = n_rows;
+          *out_cols = flat ? 1 : n_cols;
+          if (n_rows * n_cols > out_capacity) {
+            rc = -2;  // caller can resize using *out_rows / *out_cols
+          } else {
           for (int64_t r = 0; r < n_rows; ++r) {
             if (flat) {
               PyObject* v = PySequence_GetItem(lst, r);
@@ -125,9 +129,8 @@ int ptpu_infer(void* handle, const char* input_name, const float* data,
             }
             Py_DECREF(row);
           }
-          *out_rows = n_rows;
-          *out_cols = flat ? 1 : n_cols;
-          rc = 0;
+            rc = 0;
+          }
         }
         Py_DECREF(lst);
       }
@@ -135,7 +138,7 @@ int ptpu_infer(void* handle, const char* input_name, const float* data,
     }
     Py_DECREF(outs);
   }
-  if (rc != 0) PyErr_Print();
+  if (rc == -1 && PyErr_Occurred()) PyErr_Print();
   PyGILState_Release(gil);
   return rc;
 }

@@ -90,6 +90,8 @@ void ptn_free_offsets(uint64_t* offsets) { free(offsets); }
 void* ptn_read_chunk(const char* path, uint64_t offset, uint64_t count) {
   FILE* f = fopen(path, "rb");
   if (!f) return nullptr;
+  fseek(f, 0, SEEK_END);
+  const uint64_t file_size = static_cast<uint64_t>(ftell(f));
   if (fseek(f, static_cast<long>(offset), SEEK_SET) != 0) {
     fclose(f);
     return nullptr;
@@ -97,6 +99,7 @@ void* ptn_read_chunk(const char* path, uint64_t offset, uint64_t count) {
   auto* buf = new Buf();
   uint64_t len = 0;
   for (uint64_t i = 0; i < count && read_u64(f, &len); ++i) {
+    if (len > file_size) break;  // corrupt prefix: no giant allocation
     std::string rec(len, '\0');
     if (len && fread(&rec[0], 1, len, f) != len) break;
     buf->records.push_back(std::move(rec));

@@ -33,6 +33,7 @@ struct Pool {
   std::vector<std::string> buffer;   // shuffle window
   bool producer_done = false;
   bool stop = false;
+  bool error = false;                // unopenable file / corrupt record
   std::thread producer;
 
   // handed-out record storage (stable address until next pop)
@@ -41,9 +42,22 @@ struct Pool {
   void produce() {
     for (const auto& path : paths) {
       FILE* f = fopen(path.c_str(), "rb");
-      if (!f) continue;
+      if (!f) {
+        // a missing file must fail loudly, not shrink the dataset
+        std::lock_guard<std::mutex> lk(mu);
+        error = true;
+        break;
+      }
+      fseek(f, 0, SEEK_END);
+      const uint64_t file_size = static_cast<uint64_t>(ftell(f));
+      fseek(f, 0, SEEK_SET);
       uint64_t len = 0;
       while (read_u64(f, &len)) {
+        if (len > file_size) {  // corrupt length prefix: don't alloc 2^63
+          std::lock_guard<std::mutex> lk(mu);
+          error = true;
+          break;
+        }
         std::string rec(len, '\0');
         if (len && fread(&rec[0], 1, len, f) != len) break;
         {
@@ -58,6 +72,10 @@ struct Pool {
         not_empty.notify_one();
       }
       fclose(f);
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        if (error) break;
+      }
     }
     {
       std::lock_guard<std::mutex> lk(mu);
@@ -82,13 +100,14 @@ void* ptn_pool_create(const char** paths, uint64_t n_paths, uint64_t window,
 }
 
 // Pops one record (uniform over the current shuffle window).
-// Returns 1 with (*data,*len) set, or 0 at end of data.
+// Returns 1 with (*data,*len) set, 0 at end of data, -1 on IO error
+// (missing file / corrupt record stream).
 // The pointer stays valid until the next ptn_pool_next / destroy.
 int ptn_pool_next(void* handle, const char** data, uint64_t* len) {
   auto* p = static_cast<Pool*>(handle);
   std::unique_lock<std::mutex> lk(p->mu);
   p->not_empty.wait(lk, [&] { return !p->buffer.empty() || p->producer_done; });
-  if (p->buffer.empty()) return 0;
+  if (p->buffer.empty()) return p->error ? -1 : 0;
   size_t i = p->rng() % p->buffer.size();
   std::swap(p->buffer[i], p->buffer.back());
   p->current = std::move(p->buffer.back());

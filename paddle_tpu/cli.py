@@ -1,0 +1,171 @@
+"""Command-line driver: ``python -m paddle_tpu <cmd>``.
+
+Reference analog: the ``paddle`` wrapper script and its subcommands
+(paddle/scripts/submit_local.sh.in:96-104 — train / pserver /
+merge_model / dump_config / version; TrainerMain.cpp).
+
+Config convention (the config_parser analog): ``--config`` names a
+python file that, when executed, defines at module level:
+
+- ``cost``       — the cost LayerOutput (required for train/dump/merge)
+- ``outputs``    — inference output LayerOutput(s) (merge_model; falls
+                   back to ``cost``'s inputs[0])
+- ``reader``     — a no-arg callable yielding sample tuples (train)
+- ``optimizer``  — a paddle_tpu.optimizer.Optimizer (train; default Adam)
+- ``batch_size`` — int (default 32)
+
+The pserver subcommand maps to the elastic-input master service (the
+pserver's parameter-hosting role is absorbed by mesh sharding; what
+remains centralized is task dispatch — go/master)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import runpy
+import sys
+from typing import Optional
+
+
+def _load_config(path: str) -> dict:
+    import paddle_tpu as paddle
+
+    paddle.topology.reset_name_scope()
+    return runpy.run_path(path)
+
+
+def cmd_train(args) -> int:
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer as opt_mod
+    from paddle_tpu import trainer
+
+    cfg = _load_config(args.config)
+    cost = cfg["cost"]
+    reader = cfg.get("reader")
+    if reader is None:
+        print("config must define reader() for train", file=sys.stderr)
+        return 2
+    optimizer = cfg.get("optimizer") or opt_mod.Adam(learning_rate=1e-3)
+    batch_size = int(cfg.get("batch_size", 32))
+
+    paddle.init()
+    params = paddle.Parameters.from_topology(
+        paddle.topology.Topology([cost]))
+    sgd = trainer.SGD(cost=cost, parameters=params,
+                      update_equation=optimizer)
+    sgd.train(paddle.batch(reader, batch_size),
+              num_passes=args.num_passes,
+              save_dir=args.save_dir, start_pass=args.start_pass,
+              saving_period=args.saving_period)
+    return 0
+
+
+def cmd_dump_config(args) -> int:
+    from paddle_tpu import utils
+    from paddle_tpu.topology import Topology
+
+    cfg = _load_config(args.config)
+    topo = Topology([cfg["cost"]])
+    if args.format == "dot":
+        print(utils.make_model_diagram(topo))
+    else:
+        print(utils.dump_config(topo))
+    return 0
+
+
+def cmd_merge_model(args) -> int:
+    import paddle_tpu as paddle
+    from paddle_tpu import checkpoint as ckpt
+    from paddle_tpu import export as pexport
+
+    cfg = _load_config(args.config)
+    outputs = cfg.get("outputs") or cfg["cost"].inputs[0]
+    if args.model_dir:
+        params, _, _, _ = ckpt.load_checkpoint(args.model_dir)
+    elif args.model_tar:
+        with open(args.model_tar, "rb") as f:
+            params = paddle.Parameters.from_tar(f)
+    else:
+        print("need --model_dir or --model_tar", file=sys.stderr)
+        return 2
+    pexport.merge_model(outputs, params, args.output)
+    print(f"wrote {args.output}")
+    return 0
+
+
+def cmd_master(args) -> int:
+    from paddle_tpu.master.server import MasterServer
+
+    srv = MasterServer(host=args.host, port=args.port)
+    srv.start()
+    print(f"master serving on {srv.address}", flush=True)
+    if args.dataset:
+        srv.service.set_dataset(args.dataset)
+    try:
+        import time
+
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+def cmd_version(args) -> int:
+    import jax
+
+    import paddle_tpu
+
+    print(f"paddle_tpu {paddle_tpu.__version__} "
+          f"(jax {jax.__version__}, backend "
+          f"{jax.default_backend()})")
+    return 0
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu",
+        description="TPU-native trainer CLI (the `paddle` script analog)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    t = sub.add_parser("train", help="train a config")
+    t.add_argument("--config", required=True)
+    t.add_argument("--num_passes", type=int, default=1)
+    t.add_argument("--save_dir", default=None)
+    t.add_argument("--start_pass", type=int, default=0)
+    t.add_argument("--saving_period", type=int, default=1)
+    t.set_defaults(fn=cmd_train)
+
+    d = sub.add_parser("dump_config", help="print the model config")
+    d.add_argument("--config", required=True)
+    d.add_argument("--format", choices=["json", "dot"], default="json")
+    d.set_defaults(fn=cmd_dump_config)
+
+    m = sub.add_parser("merge_model",
+                       help="pack config+weights into one inference file")
+    m.add_argument("--config", required=True)
+    m.add_argument("--model_dir", default=None,
+                   help="checkpoint dir (latest pass)")
+    m.add_argument("--model_tar", default=None, help="params tar file")
+    m.add_argument("--output", required=True)
+    m.set_defaults(fn=cmd_merge_model)
+
+    s = sub.add_parser("master", help="run the elastic-input master")
+    s.add_argument("--host", default="127.0.0.1")
+    s.add_argument("--port", type=int, default=0)
+    s.add_argument("--dataset", nargs="*", default=None,
+                   help="recordio paths to partition")
+    s.set_defaults(fn=cmd_master)
+
+    v = sub.add_parser("version")
+    v.set_defaults(fn=cmd_version)
+
+    args = p.parse_args(argv)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:   # `paddle_tpu dump_config | head` etc.
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

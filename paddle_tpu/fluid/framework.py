@@ -339,3 +339,53 @@ GRAD_SUFFIX = "@GRAD"
 
 def grad_name(name: str) -> str:
     return name + GRAD_SUFFIX
+
+
+def prune(program: Program, targets) -> Program:
+    """Dead-op elimination: a new Program keeping only ops/vars the target
+    variables depend on (framework/prune.cc analog). Grad/optimize ops are
+    dropped unless a target depends on them — the inference-program
+    extraction path."""
+    names = {t.name if isinstance(t, Variable) else str(t) for t in targets}
+    src = program.global_block()
+    needed = set(names)
+    keep: List[Operator] = []
+    for op in reversed(src.ops):
+        if any(n in needed for n in op.output_names()):
+            keep.append(op)
+            needed.update(op.input_names())
+    keep.reverse()
+
+    def copy_op(op: Operator) -> Operator:
+        # inner name lists/attrs must not be shared: later mutation of the
+        # pruned program must never corrupt the source program
+        return Operator(op.type,
+                        {k: list(v) for k, v in op.inputs.items()},
+                        {k: list(v) for k, v in op.outputs.items()},
+                        {k: (list(v) if isinstance(v, list) else v)
+                         for k, v in op.attrs.items()})
+
+    out = Program()
+    out.random_seed = program.random_seed
+    dst = out.global_block()
+    block_map = {0: 0}
+    for op in keep:
+        if "sub_block" in op.attrs:
+            sub = program.blocks[int(op.attrs["sub_block"])]
+            nb = Block(out, len(out.blocks), parent_idx=0)
+            nb.vars = dict(sub.vars)   # Variables are structural leaves
+            nb.ops = [copy_op(sop) for sop in sub.ops]
+            out.blocks.append(nb)
+            block_map[sub.idx] = nb.idx
+            for sop in sub.ops:
+                needed.update(sop.input_names())
+    for name in needed:
+        if name in src.vars:
+            dst.vars[name] = src.vars[name]
+    for op in keep:
+        new_op = copy_op(op)
+        if "sub_block" in new_op.attrs:
+            new_op.attrs["sub_block"] = block_map[
+                int(new_op.attrs["sub_block"])]
+        dst.ops.append(new_op)
+    return out

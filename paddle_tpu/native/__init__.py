@@ -32,6 +32,12 @@ def _sources() -> List[str]:
                                             "shuffle_pool.cpp")]
 
 
+def _deps() -> List[str]:
+    import glob
+
+    return _sources() + glob.glob(os.path.join(_SRC, "*.h"))
+
+
 def build(force: bool = False) -> str:
     """Compile native/src → native/build/libptn.so (no python linkage —
     the capi library builds separately via build_capi)."""
@@ -39,7 +45,7 @@ def build(force: bool = False) -> str:
     srcs = _sources()
     if (not force and os.path.exists(_LIB_PATH)
             and all(os.path.getmtime(_LIB_PATH) >= os.path.getmtime(s)
-                    for s in srcs)):
+                    for s in _deps())):
         return _LIB_PATH
     cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
            "-o", _LIB_PATH] + srcs + ["-lpthread"]
@@ -194,8 +200,12 @@ class ShufflePool:
         data = ctypes.c_char_p()
         length = ctypes.c_uint64()
         while True:
-            if not self._lib.ptn_pool_next(self._h, ctypes.byref(data),
-                                           ctypes.byref(length)):
+            rc = self._lib.ptn_pool_next(self._h, ctypes.byref(data),
+                                         ctypes.byref(length))
+            if rc < 0:
+                raise OSError("shuffle pool IO error (missing file or "
+                              "corrupt record stream)")
+            if rc == 0:
                 return
             yield ctypes.string_at(data, length.value)
 

@@ -1,0 +1,133 @@
+"""User utilities: config dump, model diagram, torch parameter import.
+
+Reference analog: python/paddle/utils — make_model_diagram.py (graphviz
+dot export of a ModelConfig), dump_config.py / show_pb.py, and
+torch2paddle.py (import torch-trained weights).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from paddle_tpu.parameters import Parameters
+from paddle_tpu.platform.enforce import enforce_that
+from paddle_tpu.topology import Topology
+
+
+def topology_to_config(topology: Topology) -> Dict:
+    """Serialize a Topology to a JSON-able dict — the ModelConfig proto
+    analog (config_parser output). Structural only: layer graph, sizes,
+    parameter shapes; compute stays in python (the jit'd forward)."""
+    layers: List[Dict] = []
+    name_to_param = {}
+    for node in topology.nodes:
+        entry = {
+            "name": node.name,
+            "type": node.layer_type,
+            "size": node.size,
+            "inputs": [i.name for i in node.inputs],
+            "is_sequence": bool(node.is_sequence),
+        }
+        if getattr(node, "img_shape", None):
+            entry["img_shape"] = list(node.img_shape)
+        if node.params:
+            entry["params"] = {}
+            for pname, spec in node.params.items():
+                full = spec.attr.name or f"{node.name}.{pname}"
+                entry["params"][pname] = {"name": full,
+                                          "shape": list(spec.shape)}
+                name_to_param[full] = list(spec.shape)
+        layers.append(entry)
+    return {
+        "format": "paddle_tpu_model_config_v1",
+        "layers": layers,
+        "parameters": [{"name": k, "shape": v}
+                       for k, v in sorted(name_to_param.items())],
+        "input_layers": [n.name for n in topology.data_nodes],
+        "output_layers": [n.name for n in topology.outputs],
+    }
+
+
+def dump_config(topology: Topology, indent: int = 2) -> str:
+    """config dump (reference: paddle dump_config / utils.dump_v2_config)."""
+    return json.dumps(topology_to_config(topology), indent=indent)
+
+
+def make_model_diagram(topology: Topology,
+                       graph_name: str = "model") -> str:
+    """Graphviz dot text of the layer graph (reference:
+    python/paddle/utils/make_model_diagram.py)."""
+    cfg = topology_to_config(topology)
+    lines = [f"digraph {graph_name} {{", "  rankdir=TB;"]
+    for lay in cfg["layers"]:
+        shape = "box"
+        if lay["type"] == "data":
+            shape = "oval"
+        elif lay["name"] in cfg["output_layers"]:
+            shape = "doubleoctagon"
+        label = f"{lay['name']}\\n{lay['type']}"
+        if lay["size"]:
+            label += f" [{lay['size']}]"
+        lines.append(f'  "{lay["name"]}" [shape={shape}, label="{label}"];')
+    for lay in cfg["layers"]:
+        for src in lay["inputs"]:
+            lines.append(f'  "{src}" -> "{lay["name"]}";')
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def torch2paddle(state_dict, parameters: Parameters,
+                 name_map: Optional[Dict[str, str]] = None,
+                 transpose_linear: bool = True) -> List[str]:
+    """Import a torch ``state_dict`` into ``parameters``
+    (reference: python/paddle/utils/torch2paddle.py).
+
+    Matching is by ``name_map`` (torch name -> our param name) when given,
+    else by identical name, else by unique shape match. torch Linear
+    weights are [out, in]; ours are [in, out] (``transpose_linear``).
+    Returns the list of imported parameter names."""
+    ours = {k: np.asarray(v) for k, v in parameters.items()}
+    imported: List[str] = []
+    by_shape: Dict[tuple, List[str]] = {}
+    for k, v in ours.items():
+        by_shape.setdefault(tuple(v.shape), []).append(k)
+
+    for tname, tval in state_dict.items():
+        arr = np.asarray(tval.detach().cpu().numpy()
+                         if hasattr(tval, "detach") else tval)
+        target = None
+        if name_map and tname in name_map:
+            target = name_map[tname]
+        elif tname in ours:
+            target = tname
+        else:
+            cands = by_shape.get(tuple(arr.shape), [])
+            cands_t = by_shape.get(tuple(arr.T.shape), []) \
+                if arr.ndim == 2 else []
+            if len(cands) == 1:
+                target = cands[0]
+            elif not cands and len(cands_t) == 1 and transpose_linear:
+                target = cands_t[0]
+        if target is None:
+            continue
+        dst_shape = ours[target].shape
+        if arr.shape != dst_shape:
+            if transpose_linear and arr.ndim == 2 \
+                    and arr.T.shape == dst_shape:
+                arr = arr.T
+            else:
+                continue
+        elif (transpose_linear and arr.ndim == 2
+              and arr.shape[0] == arr.shape[1]
+              and tname.rsplit(".", 1)[-1] == "weight"):
+            # square torch Linear weights match both ways; torch stores
+            # [out, in] so '*.weight' still needs the transpose (square
+            # embedding tables named '.weight' would be misflipped — pass
+            # an explicit name_map for those)
+            arr = arr.T
+        parameters[target] = arr.astype(ours[target].dtype)
+        imported.append(target)
+    return imported

@@ -1,0 +1,126 @@
+"""CLI + utils tests (dump_config golden check, diagram, torch import).
+
+Reference analog: the `paddle` subcommand surface
+(scripts/submit_local.sh.in:96-104), trainer_config_helpers' golden
+config snapshot tests (tests/configs + ProtobufEqualMain.cpp), and
+python/paddle/utils (make_model_diagram, torch2paddle).
+"""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import cli, layer, utils
+from paddle_tpu.topology import Topology
+
+CONFIG = """
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import layer, optimizer
+
+x = layer.data(name="x", type=paddle.data_type.dense_vector(8))
+y = layer.data(name="y", type=paddle.data_type.integer_value(3))
+hidden = layer.fc(x, size=16, act="relu", name="hidden")
+logits = layer.fc(hidden, size=3, name="logits")
+cost = layer.classification_cost(input=logits, label=y)
+outputs = logits
+optimizer = optimizer.Sgd(learning_rate=0.1)
+batch_size = 16
+
+_rng = np.random.RandomState(0)
+_data = []
+for _ in range(64):
+    _y = int(_rng.randint(0, 3))
+    _x = (_rng.randn(8) * 0.2).astype(np.float32)
+    _x[_y * 2] += 1.0
+    _data.append((_x, _y))
+
+
+def reader():
+    return iter(_data)
+"""
+
+
+@pytest.fixture
+def config_file(tmp_path):
+    p = tmp_path / "conf.py"
+    p.write_text(CONFIG)
+    return str(p)
+
+
+def test_dump_config_structure(config_file, capsys):
+    assert cli.main(["dump_config", "--config", config_file]) == 0
+    cfg = json.loads(capsys.readouterr().out)
+    types = {l["name"]: l["type"] for l in cfg["layers"]}
+    assert types["x"] == "data" and types["hidden"] == "fc"
+    pnames = {p["name"] for p in cfg["parameters"]}
+    assert "hidden.w0" in pnames and "logits.b" in pnames
+    assert cfg["input_layers"] == ["x", "y"]
+
+    # golden-snapshot style determinism: two dumps are identical
+    paddle.topology.reset_name_scope()
+    assert cli.main(["dump_config", "--config", config_file]) == 0
+    cfg2 = json.loads(capsys.readouterr().out)
+    assert cfg == cfg2
+
+
+def test_model_diagram_dot(config_file, capsys):
+    assert cli.main(["dump_config", "--config", config_file,
+                     "--format", "dot"]) == 0
+    dot = capsys.readouterr().out
+    assert "digraph" in dot and '"hidden" -> "logits"' in dot
+
+
+def test_cli_train_and_merge(config_file, tmp_path, capsys):
+    save = str(tmp_path / "ckpt")
+    assert cli.main(["train", "--config", config_file,
+                     "--num_passes", "2", "--save_dir", save]) == 0
+    out_model = str(tmp_path / "m.ptm")
+    assert cli.main(["merge_model", "--config", config_file,
+                     "--model_dir", save, "--output", out_model]) == 0
+    from paddle_tpu import export as pexport
+    m = pexport.load_merged_model(out_model)
+    (probs,) = m.infer({"x": np.zeros((2, 8), np.float32)})
+    assert probs.shape == (2, 3)
+
+
+def test_cli_version(capsys):
+    assert cli.main(["version"]) == 0
+    assert "paddle_tpu" in capsys.readouterr().out
+
+
+def test_torch2paddle_import(rng):
+    torch = pytest.importorskip("torch")
+    from paddle_tpu.platform.flags import FLAGS
+
+    paddle.topology.reset_name_scope()
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(6))
+    out = layer.fc(x, size=4, name="lin")
+    topo = Topology([out])
+    params = paddle.Parameters.from_topology(topo, seed=0)
+
+    tmod = torch.nn.Linear(6, 4)
+    imported = utils.torch2paddle(
+        tmod.state_dict(), params,
+        name_map={"weight": "lin.w0", "bias": "lin.b"})
+    assert set(imported) == {"lin.w0", "lin.b"}
+    np.testing.assert_allclose(
+        np.asarray(params["lin.w0"]),
+        tmod.weight.detach().numpy().T, atol=1e-6)
+
+    # forward parity with torch (f32 kernels for an exact comparison)
+    old = FLAGS.use_bf16
+    FLAGS.use_bf16 = False
+    try:
+        xb = rng.randn(3, 6).astype(np.float32)
+        state = topo.init_state()
+        got, _ = topo.forward(params.as_dict(), state, {"x": xb},
+                              train=False)
+        expect = tmod(torch.from_numpy(xb)).detach().numpy()
+        np.testing.assert_allclose(np.asarray(got[0]), expect, atol=1e-4)
+    finally:
+        FLAGS.use_bf16 = old

@@ -163,3 +163,35 @@ def test_program_printing_and_prune():
         loss = layers.mean(h)
     s = prog.to_string()
     assert "mul" in s and "param" in s
+
+
+def test_program_prune_drops_backward():
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        x = layers.data("x", [4])
+        h = layers.fc(x, size=3, act="relu")
+        loss = layers.mean(h)
+        optimizer.SGDOptimizer(0.1).minimize(loss)
+    n_ops_full = len(prog.global_block().ops)
+    from paddle_tpu.fluid.framework import prune
+    inf = prune(prog, [h])
+    kinds = [op.type for op in inf.global_block().ops]
+    assert "sgd" not in kinds and not any(k.endswith("_grad") for k in kinds)
+    assert len(kinds) < n_ops_full
+    # pruned program still runs
+    exe = fluid.Executor()
+    import numpy as _np
+    (out,) = exe.run(inf, feed={"x": _np.ones((2, 4), _np.float32)},
+                     fetch_list=[h], scope=fluid.Scope())
+    assert out.shape == (2, 3)
+
+
+def test_ploter_headless():
+    from paddle_tpu.plot import Ploter
+    pl = Ploter("train", "test")
+    pl.append("train", 0, 1.0)
+    pl.append("train", 1, 0.5)
+    pl.plot()
+    assert pl.data["train"][1] == [1.0, 0.5]
+    pl.reset()
+    assert pl.data["train"][0] == []
