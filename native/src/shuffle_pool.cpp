@@ -59,7 +59,12 @@ struct Pool {
           break;
         }
         std::string rec(len, '\0');
-        if (len && fread(&rec[0], 1, len, f) != len) break;
+        if (len && fread(&rec[0], 1, len, f) != len) {
+          // truncated payload: fail loudly like the corrupt-length path
+          std::lock_guard<std::mutex> lk(mu);
+          error = true;
+          break;
+        }
         {
           std::unique_lock<std::mutex> lk(mu);
           not_full.wait(lk, [&] { return buffer.size() < window || stop; });

@@ -349,12 +349,17 @@ def prune(program: Program, targets) -> Program:
     names = {t.name if isinstance(t, Variable) else str(t) for t in targets}
     src = program.global_block()
     needed = set(names)
-    keep: List[Operator] = []
-    for op in reversed(src.ops):
+    kept: List[tuple] = []          # (old_idx, op)
+    for idx in range(len(src.ops) - 1, -1, -1):
+        op = src.ops[idx]
         if any(n in needed for n in op.output_names()):
-            keep.append(op)
+            kept.append((idx, op))
             needed.update(op.input_names())
-    keep.reverse()
+    kept.reverse()
+    keep = [op for _, op in kept]
+    # grad ops bind to their forward op positionally; dropping earlier ops
+    # shifts indices, so fwd_idx must be remapped into the pruned program
+    old_to_new = {old: new for new, (old, _) in enumerate(kept)}
 
     def copy_op(op: Operator) -> Operator:
         # inner name lists/attrs must not be shared: later mutation of the
@@ -387,5 +392,12 @@ def prune(program: Program, targets) -> Program:
         if "sub_block" in new_op.attrs:
             new_op.attrs["sub_block"] = block_map[
                 int(new_op.attrs["sub_block"])]
+        if "fwd_idx" in new_op.attrs:
+            old = int(new_op.attrs["fwd_idx"])
+            enforce_that(old in old_to_new,
+                         f"grad op {new_op.type} survives pruning but its "
+                         f"forward op (idx {old}) was pruned",
+                         context="fluid")
+            new_op.attrs["fwd_idx"] = old_to_new[old]
         dst.ops.append(new_op)
     return out

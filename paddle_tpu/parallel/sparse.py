@@ -101,14 +101,14 @@ def adagrad_update_rows(table: jax.Array, accum: jax.Array,
     combined = jax.ops.segment_sum(grad.rows, inv.reshape(-1),
                                    num_segments=n)
     combined = jnp.where(pad[:, None], 0.0, combined)
-    acc_rows = jnp.take(accum, safe, axis=0) + jnp.square(combined)
+    acc_delta = jnp.square(combined)   # pad rows already zeroed above
+    acc_rows = jnp.take(accum, safe, axis=0) + acc_delta
     step = lr * combined / (jnp.sqrt(acc_rows) + epsilon)
-    tab_rows = jnp.take(table, safe, axis=0) - jnp.where(
-        pad[:, None], 0.0, step)
-    acc_keep = jnp.where(pad[:, None], jnp.take(accum, safe, axis=0),
-                         acc_rows)
-    return (table.at[safe].set(tab_rows),
-            accum.at[safe].set(acc_keep))
+    tab_delta = jnp.where(pad[:, None], 0.0, -step)
+    # pad slots are clipped to index 0; scatter-add with zeroed deltas is
+    # well-defined under that collision (set would drop row 0's update)
+    return (table.at[safe].add(tab_delta),
+            accum.at[safe].add(acc_delta))
 
 
 # ---------------------------------------------------------------------------

@@ -181,7 +181,10 @@ class SGD:
         mstate = self.model_state
         log = plog.logger()
 
-        for pass_id in range(start_pass, start_pass + num_passes):
+        # reference flag semantics (ParamUtil.h): num_passes is the TOTAL
+        # pass count; resuming at start_pass runs passes [start_pass,
+        # num_passes), not num_passes additional ones
+        for pass_id in range(start_pass, num_passes):
             event_handler(v2_event.BeginPass(pass_id))
             # host-side floats; device scalars buffer in `pending` and flush
             # with ONE stacked transfer per stream per log window

@@ -88,8 +88,9 @@ def test_resume_continues_identically(tmp_path, rng):
             sgd2 = trainer.SGD(cost=cost2, parameters=params2,
                                update_equation=optimizer.Momentum(
                                    momentum=0.9, learning_rate=0.05))
-            sgd2.train(reader, num_passes=passes_b, save_dir=save_dir,
-                       start_pass=passes_a)
+            # num_passes is the TOTAL pass count (reference --num_passes)
+            sgd2.train(reader, num_passes=passes_a + passes_b,
+                       save_dir=save_dir, start_pass=passes_a)
             return params2
         return params
 

@@ -101,13 +101,13 @@ def test_detection_map_perfect():
                          [2, 0.5, 0.5, 0.9, 0.9]]], np.float32)
     (mp,) = run_metric(m, {"det": det_rows.reshape(1, -1),
                            "gt": gt_rows.reshape(1, -1)})
-    assert abs(float(mp) - 1.0) < 1e-4
+    assert abs(float(np.ravel(mp)[0]) - 1.0) < 1e-4
 
     # wrong class detection → mAP drops
     det_rows[0, 1, 0] = 1
     (mp2,) = run_metric(m, {"det": det_rows.reshape(1, -1),
                             "gt": gt_rows.reshape(1, -1)})
-    assert float(mp2) < 1.0
+    assert float(np.ravel(mp2)[0]) < 1.0
 
 
 def test_printers_run(capsys):
