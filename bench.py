@@ -1,75 +1,363 @@
 #!/usr/bin/env python
-"""Benchmark: AlexNet bs=128 train step on one TPU chip vs the reference's
-headline number (PaddlePaddle on K40m: 334 ms/batch — BASELINE.md,
-reference benchmark/README.md:33-38).
+"""Benchmark suite. Prints exactly ONE JSON line.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "ms/batch", "vs_baseline": N}
-vs_baseline > 1 means faster than the reference by that factor.
+Primary metric: ResNet-50 224x224 training throughput, images/sec/chip,
+with achieved FLOP/s and MFU (BASELINE.json's north-star metric). The
+reference publishes no ResNet-50 number, so ``vs_baseline`` is computed
+from the one apples-to-apples headline it does publish: AlexNet bs=128
+train ms/batch (PaddlePaddle on K40m: 334 ms — reference
+benchmark/README.md:33-38). vs_baseline > 1 means faster by that factor.
+
+Also measured (reported as extra fields on the same line):
+  - alexnet_ms_per_batch       (vs 334 ms, K40m)
+  - lstm_ms_per_batch          IMDB 2xLSTM h=512 bs=64 seq=100
+                               (vs 184 ms, K40m — benchmark/README.md:114-119)
+  - scaling_virtual8           1-vs-8-device step-time ratio at FIXED global
+                               batch on a serialized virtual CPU mesh: pure
+                               collective/partition overhead (compute is
+                               identical), the tracked scaling-efficiency
+                               number until multi-chip hardware exists.
+
+Robustness (round-1 postmortem: the TPU tunnel can HANG in jax.devices(),
+not just raise UNAVAILABLE): every measurement runs in a subprocess with
+its own timeout; init is retried with backoff while the global deadline
+allows; one JSON line is ALWAYS emitted, with an error record if the
+hardware never came up.
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import numpy as np
+GLOBAL_DEADLINE_S = 560.0
+ALEXNET_BASELINE_MS = 334.0   # reference Paddle, AlexNet bs=128, K40m
+LSTM_BASELINE_MS = 184.0      # reference Paddle, IMDB LSTM h=512 bs=64, K40m
+
+# bf16 peak FLOP/s per chip (compute path runs bf16 matmuls, fp32 accum)
+PEAK_FLOPS = {
+    "TPU v2": 45e12, "TPU v3": 123e12, "TPU v4": 275e12,
+    "TPU v5 lite": 197e12, "TPU v5e": 197e12, "TPU v5": 197e12,
+    "TPU v5p": 459e12, "TPU v6 lite": 918e12, "TPU v6e": 918e12,
+}
 
 
-def main():
-    import jax
+def _peak_for(kind: str) -> float:
+    for k, v in PEAK_FLOPS.items():
+        if kind.lower().startswith(k.lower()):
+            return v
+    return 197e12
 
-    import paddle_tpu as paddle
-    from paddle_tpu import optimizer, trainer
-    from paddle_tpu.models import alexnet
 
-    paddle.init()
-    batch_size = 128
-    img_size = 227
-
-    paddle.topology.reset_name_scope()
-    images, label, logits, cost = alexnet.build(img_size=img_size)
-    topo = paddle.topology.Topology([cost])
-    params = paddle.Parameters.from_topology(topo, seed=0)
-    sgd = trainer.SGD(cost=cost, parameters=params,
-                      update_equation=optimizer.Momentum(momentum=0.9,
-                                                         learning_rate=0.01))
-
-    rng = np.random.RandomState(0)
-    feeds_np = [
-        (rng.randn(3 * img_size * img_size).astype(np.float32), int(rng.randint(1000)))
-        for _ in range(batch_size)
-    ]
-    feeder = sgd._make_feeder(None)
-    feeds = feeder.feed(feeds_np)
-
-    step = sgd._build_step()
-    p = params.as_dict()
-    opt_state = sgd.opt_state
-    mstate = sgd.model_state
-    key = jax.random.PRNGKey(0)
-
-    # warmup / compile; a concrete value fetch is the only reliable
-    # completion barrier over the remote-TPU relay (block_until_ready
-    # returns optimistically there)
+def _time_steps(step, args, iters):
+    """Time ``iters`` chained train steps; a concrete value fetch is the
+    completion barrier (block_until_ready is optimistic over the relay)."""
+    p, opt_state, mstate, key, feeds = args
+    loss, p, opt_state, mstate, _ = step(p, opt_state, mstate, key, feeds)
+    float(loss)  # compile + warmup
     loss, p, opt_state, mstate, _ = step(p, opt_state, mstate, key, feeds)
     float(loss)
-
-    iters = 50
     start = time.perf_counter()
-    for i in range(iters):
+    for _ in range(iters):
         loss, p, opt_state, mstate, _ = step(p, opt_state, mstate, key, feeds)
-    float(loss)  # forces the whole dependent step chain to complete
-    elapsed = time.perf_counter() - start
-    ms_per_batch = elapsed / iters * 1000.0
+    float(loss)
+    return (time.perf_counter() - start) / iters
 
-    baseline_ms = 334.0  # reference Paddle, AlexNet bs=128, K40m
+
+def _init_paddle():
+    import paddle_tpu as paddle
+
+    paddle.init()
+    return paddle
+
+
+def _make_sgd(cost, params, opt=None):
+    from paddle_tpu import optimizer, trainer
+
+    return trainer.SGD(cost=cost, parameters=params,
+                       update_equation=opt or optimizer.Momentum(
+                           momentum=0.9, learning_rate=0.01))
+
+
+def _dense_feeds(sgd, batch, dim, n_classes, seed=0):
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    samples = [(rng.randn(dim).astype(np.float32), int(rng.randint(n_classes)))
+               for _ in range(batch)]
+    return sgd._make_feeder(None).feed(samples)
+
+
+def _step_args(sgd, feeds):
+    import jax
+
+    return (sgd.parameters.as_dict(), sgd.opt_state, sgd.model_state,
+            jax.random.PRNGKey(0), feeds)
+
+
+def _compiled_flops(step, args):
+    """Compiler-reported FLOPs for one train step (falls back to None)."""
+    try:
+        cost = step.lower(*args).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        f = float(cost.get("flops", 0.0))
+        return f if f > 0 else None
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# workers — each prints one JSON line on success
+# ---------------------------------------------------------------------------
+
+
+def worker_resnet50():
+    import jax
+
+    paddle = _init_paddle()
+    from paddle_tpu.models import resnet
+
+    batch, img = 128, 224
+    paddle.topology.reset_name_scope()
+    images, label, logits, cost = resnet.build(depth=50, img_size=img,
+                                               num_classes=1000)
+    topo = paddle.topology.Topology([cost])
+    params = paddle.Parameters.from_topology(topo, seed=0)
+    sgd = _make_sgd(cost, params)
+    feeds = _dense_feeds(sgd, batch, 3 * img * img, 1000)
+    step = sgd._build_step()
+    args = _step_args(sgd, feeds)
+
+    flops = _compiled_flops(step, args)
+    flops_source = "xla_cost_analysis"
+    if flops is None:
+        # analytic: ResNet-50 fwd ~4.09 GFLOP/img (2*MACs); train ~3x fwd
+        flops = 3 * 4.089e9 * batch
+        flops_source = "analytic"
+
+    sec = _time_steps(step, args, iters=20)
+    kind = jax.devices()[0].device_kind
+    peak = _peak_for(kind)
+    achieved = flops / sec
     print(json.dumps({
-        "metric": "alexnet_bs128_train_ms_per_batch",
-        "value": round(ms_per_batch, 3),
-        "unit": "ms/batch",
-        "vs_baseline": round(baseline_ms / ms_per_batch, 3),
+        "resnet50_images_per_sec_per_chip": round(batch / sec, 1),
+        "resnet50_ms_per_batch": round(sec * 1000, 2),
+        "resnet50_achieved_tflops": round(achieved / 1e12, 2),
+        "resnet50_mfu": round(achieved / peak, 4),
+        "resnet50_flops_per_step": flops,
+        "flops_source": flops_source,
+        "device_kind": kind,
+        "peak_tflops_assumed": peak / 1e12,
+        "batch": batch,
     }))
 
 
+def worker_alexnet():
+    paddle = _init_paddle()
+    from paddle_tpu.models import alexnet
+
+    batch, img = 128, 227
+    paddle.topology.reset_name_scope()
+    images, label, logits, cost = alexnet.build(img_size=img)
+    topo = paddle.topology.Topology([cost])
+    params = paddle.Parameters.from_topology(topo, seed=0)
+    sgd = _make_sgd(cost, params)
+    feeds = _dense_feeds(sgd, batch, 3 * img * img, 1000)
+    sec = _time_steps(sgd._build_step(), _step_args(sgd, feeds), iters=30)
+    print(json.dumps({"alexnet_ms_per_batch": round(sec * 1000, 3)}))
+
+
+def worker_lstm():
+    """IMDB benchmark config: 2xLSTM h=512 + fc, bs=64, seq len 100,
+    dict 30k (reference benchmark/paddle/rnn/rnn.py)."""
+    import numpy as np
+
+    paddle = _init_paddle()
+    from paddle_tpu.models import text_lstm
+
+    batch, seq_len, hidden = 64, 100, 512
+    paddle.topology.reset_name_scope()
+    words, label, logits, cost = text_lstm.build(hidden=hidden)
+    topo = paddle.topology.Topology([cost])
+    params = paddle.Parameters.from_topology(topo, seed=0)
+    sgd = _make_sgd(cost, params)
+    rng = np.random.RandomState(0)
+    samples = [(rng.randint(0, 30000, size=seq_len).tolist(),
+                int(rng.randint(2))) for _ in range(batch)]
+    feeds = sgd._make_feeder(None).feed(samples)
+    sec = _time_steps(sgd._build_step(), _step_args(sgd, feeds), iters=20)
+    print(json.dumps({"lstm_ms_per_batch": round(sec * 1000, 3),
+                      "lstm_config": f"h={hidden} bs={batch} seq={seq_len}"}))
+
+
+def worker_scaling():
+    """Fixed-GLOBAL-batch 1-vs-8-device step time on a serialized virtual
+    CPU mesh. Total compute is identical, so t1/t8 isolates partition +
+    collective overhead (≈ scaling efficiency upper bound)."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import layer
+    from paddle_tpu.parallel import make_mesh
+
+    batch = 2048
+
+    def build_and_time(mesh):
+        paddle.topology.reset_name_scope()
+        x = layer.data(name="x", type=paddle.data_type.dense_vector(512))
+        y = layer.data(name="y", type=paddle.data_type.integer_value(10))
+        h = layer.fc(input=x, size=2048, act="relu")
+        h = layer.fc(input=h, size=2048, act="relu")
+        cost = layer.classification_cost(
+            input=layer.fc(input=h, size=10), label=y)
+        params = paddle.Parameters.from_topology(
+            paddle.topology.Topology([cost]), seed=0)
+        from paddle_tpu import optimizer, trainer
+
+        sgd = trainer.SGD(cost=cost, parameters=params,
+                          update_equation=optimizer.Momentum(
+                              momentum=0.9, learning_rate=0.01),
+                          mesh=mesh)
+        feeds = sgd._shard_feeds(_dense_feeds(sgd, batch, 512, 10))
+        return _time_steps(sgd._build_step(), _step_args(sgd, feeds),
+                           iters=10)
+
+    devs = jax.devices()
+    assert len(devs) >= 8, f"need 8 virtual devices, have {len(devs)}"
+    t1 = build_and_time(None)
+    t8 = build_and_time(make_mesh((8,), ("data",), devs[:8]))
+    print(json.dumps({
+        "scaling_virtual8": {
+            "t_step_1dev_ms": round(t1 * 1000, 3),
+            "t_step_8dev_ms": round(t8 * 1000, 3),
+            "efficiency_fixed_global_batch": round(t1 / t8, 3),
+        }}))
+
+
+def worker_probe():
+    """Fast TPU liveness check: init + one tiny matmul."""
+    import jax
+    import jax.numpy as jnp
+
+    kind = jax.devices()[0].device_kind
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    v = float((x @ x).sum())
+    print(json.dumps({"probe_device_kind": kind, "probe_ok": v > 0}))
+
+
+WORKERS = {
+    "probe": worker_probe,
+    "resnet50": worker_resnet50,
+    "alexnet": worker_alexnet,
+    "lstm": worker_lstm,
+    "scaling": worker_scaling,
+}
+
+
+# ---------------------------------------------------------------------------
+# orchestrator
+# ---------------------------------------------------------------------------
+
+
+def _run_worker(name, deadline, cpu=False, attempt_timeout=420,
+                max_attempts=3):
+    """Run one worker in a subprocess with retry/backoff under the global
+    deadline. Returns (dict-or-None, error-string-or-None)."""
+    last_err = None
+    attempt = 0
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining < 30:
+            return None, last_err or "global deadline exhausted"
+        attempt += 1
+        env = dict(os.environ)
+        if cpu:
+            from paddle_tpu.platform.virtual import virtual_cpu_env
+
+            env = virtual_cpu_env(
+                env, 8,
+                extra_pythonpath=os.path.dirname(os.path.abspath(__file__)))
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--worker", name],
+                env=env, timeout=min(remaining - 10, attempt_timeout),
+                capture_output=True, text=True)
+        except subprocess.TimeoutExpired:
+            last_err = f"{name}: timeout (attempt {attempt})"
+            if attempt >= max_attempts:
+                return None, last_err
+            continue
+        if r.returncode == 0:
+            for line in reversed(r.stdout.strip().splitlines()):
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        return json.loads(line), None
+                    except json.JSONDecodeError:
+                        pass
+            last_err = f"{name}: no JSON in output"
+        else:
+            tail = (r.stderr or r.stdout or "").strip().splitlines()[-3:]
+            last_err = f"{name}: rc={r.returncode} {' | '.join(tail)}"
+        if attempt >= max_attempts:
+            return None, last_err
+        # transient backend unavailability: back off before retrying
+        time.sleep(min(15 * attempt, max(0.0, deadline - time.monotonic())))
+
+
+def main():
+    deadline = time.monotonic() + GLOBAL_DEADLINE_S
+    record = {}
+    errors = {}
+
+    # cheap + hardware-independent first: never starved by a dead tunnel
+    out, err = _run_worker("scaling", deadline, cpu=True,
+                           attempt_timeout=240, max_attempts=2)
+    if out:
+        record.update(out)
+    else:
+        errors["scaling"] = err
+
+    # fast liveness probe: a dead TPU tunnel HANGS (round-1 failure mode);
+    # fail it fast rather than crawling through per-model retries
+    probe, perr = _run_worker("probe", deadline, attempt_timeout=120,
+                              max_attempts=3)
+    if probe:
+        record.update(probe)
+        for name in ("resnet50", "alexnet", "lstm"):
+            out, err = _run_worker(name, deadline)
+            if out:
+                record.update(out)
+            else:
+                errors[name] = err
+    else:
+        errors["tpu"] = f"unreachable: {perr}"
+
+    value = record.get("resnet50_images_per_sec_per_chip")
+    alex = record.get("alexnet_ms_per_batch")
+    result = {
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": value if value is not None else 0.0,
+        "unit": "images/sec/chip",
+        # only published reference headline: AlexNet bs=128, 334 ms on K40m
+        "vs_baseline": (round(ALEXNET_BASELINE_MS / alex, 3)
+                        if alex else 0.0),
+        "vs_baseline_basis": "alexnet_bs128_ms_per_batch_K40m_334ms",
+    }
+    if record.get("lstm_ms_per_batch"):
+        result["lstm_vs_baseline"] = round(
+            LSTM_BASELINE_MS / record["lstm_ms_per_batch"], 3)
+    result.update(record)
+    if errors:
+        result["errors"] = errors
+    print(json.dumps(result))
+    return 0
+
+
 if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
+        WORKERS[sys.argv[2]]()
+        sys.exit(0)
     sys.exit(main())

@@ -12,6 +12,33 @@ from typing import Any, Optional, Sequence
 
 
 @dataclass
+class HookAttr:
+    """Parameter updater hook (reference ParameterUpdaterHook.cpp:39-104,
+    configured via ParameterConfig.proto update_hooks).
+
+    ``type='pruning'``: a static mask is generated once from the initial
+    weights (keep the largest (1 - sparsity_ratio) fraction by |value|)
+    and applied to the value and every subsequent update."""
+
+    type: str = "pruning"
+    sparsity_ratio: float = 0.6
+
+    @staticmethod
+    def to_hooks(arg) -> "list[HookAttr]":
+        if arg is None:
+            return []
+        if isinstance(arg, HookAttr):
+            return [arg]
+        if isinstance(arg, dict):
+            return [HookAttr(**arg)]
+        return [HookAttr(**h) if isinstance(h, dict) else h for h in arg]
+
+
+# the reference's name for the same concept
+HookAttribute = HookAttr
+
+
+@dataclass
 class ParamAttr:
     """Per-parameter attributes.
 
@@ -30,6 +57,7 @@ class ParamAttr:
     gradient_clipping_threshold: float = 0.0
     sharding: Optional[Sequence[Optional[str]]] = None
     dtype: Any = None                # parameter dtype override
+    update_hooks: Any = None         # HookAttr / list (pruning masks etc.)
 
     @staticmethod
     def to_attr(arg) -> "ParamAttr":

@@ -9,7 +9,7 @@ program transform adding grad ops; ``Executor`` traces the whole program into
 one jit-compiled XLA function (grad ops via jax.vjp of the forward computes).
 """
 
-from paddle_tpu.fluid import backward, layers, optimizer, ops
+from paddle_tpu.fluid import backward, io, layers, optimizer, ops
 from paddle_tpu.fluid.backward import append_backward
 from paddle_tpu.fluid.executor import Executor, Scope, global_scope
 from paddle_tpu.fluid.framework import (Block, Operator, Parameter, Program,
@@ -19,7 +19,7 @@ from paddle_tpu.fluid.framework import (Block, Operator, Parameter, Program,
 from paddle_tpu.fluid.ops import LoDArray, registered_ops
 
 __all__ = [
-    "backward", "layers", "optimizer", "ops", "append_backward",
+    "backward", "io", "layers", "optimizer", "ops", "append_backward",
     "Executor", "Scope", "global_scope", "Block", "Operator", "Parameter",
     "Program", "Variable", "default_main_program", "grad_name",
     "program_guard", "reset_default_program", "LoDArray", "registered_ops",

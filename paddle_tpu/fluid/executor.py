@@ -127,6 +127,17 @@ class Executor:
         fetch_names = [f.name if isinstance(f, Variable) else str(f)
                        for f in fetch_list]
 
+        io_ops = [op for op in program.global_block().ops
+                  if not op.type.endswith("_grad")
+                  and op_lib.get(op.type).family == "io"]
+        if io_ops:
+            enforce_that(
+                len(io_ops) == len(program.global_block().ops),
+                "save/restore programs must be IO-only (build them with "
+                "fluid.io.save_vars/load_vars)", context="fluid")
+            self._run_io(program, scope)
+            return []
+
         self._materialize_params(program, scope)
         persist_names = self._persistable_names(program, scope)
         persist_vals = {n: scope.values[n] for n in persist_names}
@@ -147,6 +158,31 @@ class Executor:
             fetches = [np.asarray(f.data) if isinstance(f, LoDArray)
                        else np.asarray(f) for f in fetches]
         return fetches
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _run_io(program: Program, scope: Scope) -> None:
+        """Host-side save/restore (save_restore_op.cc analog): one .npy
+        per variable under the op's ``path`` directory."""
+        import os
+
+        for op in program.global_block().ops:
+            path = str(op.attrs["path"])
+            if op.type == "save":
+                os.makedirs(path, exist_ok=True)
+                for name in op.inputs.get("X", []):
+                    v = scope.find_var(name)
+                    enforce_that(v is not None,
+                                 f"save: no value for {name}",
+                                 context="fluid")
+                    np.save(os.path.join(path, name + ".npy"),
+                            np.asarray(v))
+            else:  # restore
+                for name in op.outputs.get("Out", []):
+                    f = os.path.join(path, name + ".npy")
+                    enforce_that(os.path.exists(f),
+                                 f"restore: missing {f}", context="fluid")
+                    scope.set_var(name, np.load(f))
 
     # ------------------------------------------------------------------
     def _materialize_params(self, program: Program, scope: Scope) -> None:

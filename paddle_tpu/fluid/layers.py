@@ -582,3 +582,165 @@ class StaticRNN:
                    "step_outputs": [v.name for v in self._step_outputs],
                    "param_names": [v.name for v in used]})
         return outs[0] if len(outs) == 1 else outs
+
+
+def _collect_outer_vars(program, sub_blocks):
+    """Parent-block vars read by sub-block ops, routed through the op's
+    input slots so program-level autodiff reaches them (StaticRNN-style)."""
+    used, seen = [], set()
+    for sub in sub_blocks:
+        local = set(sub.vars)
+        for op in sub.ops:
+            for n in op.input_names():
+                if n not in local and n not in seen:
+                    seen.add(n)
+                    used.append(program.global_block().var(n))
+    return used
+
+
+def cond(pred: Variable, true_fn, false_fn):
+    """Dynamic if-else (cond_op.h analog): ``pred`` is a per-row [N] mask;
+    row i of the output comes from ``true_fn``'s graph where pred[i] else
+    ``false_fn``'s. Both branch graphs are built as sub-blocks; on TPU both
+    run on the full batch and a masked merge selects rows (static shapes —
+    see the cond op docstring in ops.py). Each fn takes no args, reads
+    enclosing vars, and returns one Variable (or a list, matched 1:1)."""
+    prog = default_main_program()
+    tb = prog.create_block()
+    t_out = true_fn()
+    prog.rollback()
+    fb = prog.create_block()
+    f_out = false_fn()
+    prog.rollback()
+    t_outs = t_out if isinstance(t_out, (list, tuple)) else [t_out]
+    f_outs = f_out if isinstance(f_out, (list, tuple)) else [f_out]
+    enforce_that(len(t_outs) == len(f_outs),
+                 "cond branches must return the same number of outputs",
+                 context="cond")
+    used = _collect_outer_vars(prog, [tb, fb])
+    outs = [prog.global_block().create_var(
+        name=prog.unique_name("cond_out"), shape=o.shape, dtype=o.dtype)
+        for o in t_outs]
+    prog.global_block().append_op(
+        "cond",
+        inputs={"Cond": pred, "Xs": used},
+        outputs={"Out": outs},
+        attrs={"true_block": tb.idx, "false_block": fb.idx,
+               "true_outputs": [v.name for v in t_outs],
+               "false_outputs": [v.name for v in f_outs],
+               "x_names": [v.name for v in used]})
+    return outs[0] if len(outs) == 1 else outs
+
+
+class DynamicRNN:
+    """Variable-length RNN over a LoD input (dynamic_recurrent_op analog).
+
+    Same shape as StaticRNN but ``step_input`` takes a lod_level-1 var
+    (ragged rows); the op packs it to padded time-major once, scans with
+    mask-gated memories, and returns a LoD output in the input's order::
+
+        drnn = DynamicRNN()
+        with drnn.step():
+            x_t = drnn.step_input(x)              # x: LoD rows [R, D]
+            h_prev = drnn.memory(shape=(B, H))
+            h = some_layers(x_t, h_prev)
+            drnn.update_memory(h_prev, h)
+            drnn.step_output(h)
+        out = drnn()                              # LoD rows [R, H]
+    """
+
+    def __init__(self, reverse: bool = False):
+        self.program = default_main_program()
+        self.sub_block = None
+        self.reverse = reverse
+        self._seq_input: Optional[Variable] = None
+        self._step_in: Optional[Variable] = None
+        self._init_states: List[Variable] = []
+        self._state_in: List[Variable] = []
+        self._state_out: List[Optional[Variable]] = []
+        self._step_outputs: List[Variable] = []
+        self._built = False
+
+    class _Guard:
+        def __init__(self, rnn):
+            self.rnn = rnn
+
+        def __enter__(self):
+            self.rnn.sub_block = self.rnn.program.create_block()
+            return self.rnn
+
+        def __exit__(self, *exc):
+            self.rnn.program.rollback()
+            return False
+
+    def step(self):
+        return self._Guard(self)
+
+    def step_input(self, x: Variable) -> Variable:
+        enforce_that(self._seq_input is None,
+                     "DynamicRNN supports one sequence input",
+                     context="DynamicRNN")
+        enforce_that(x.lod_level >= 1, "DynamicRNN input must be LoD",
+                     context="DynamicRNN")
+        self._seq_input = x
+        v = self.sub_block.create_var(
+            name=self.program.unique_name("drnn_step_in"),
+            shape=x.shape, dtype=x.dtype)
+        self._step_in = v
+        return v
+
+    def memory(self, init: Optional[Variable] = None, shape=None,
+               init_value: float = 0.0, dtype="float32") -> Variable:
+        if init is None:
+            enforce_that(shape is not None, "memory needs init or shape",
+                         context="DynamicRNN")
+            g = self.program.global_block()
+            init = g.create_var(
+                name=self.program.unique_name("drnn_init"),
+                shape=shape, dtype=dtype, persistable=True)
+            init.initializer = {"type": "constant", "value": init_value}
+        self._init_states.append(init)
+        v = self.sub_block.create_var(
+            name=self.program.unique_name("drnn_mem"),
+            shape=init.shape, dtype=init.dtype)
+        self._state_in.append(v)
+        self._state_out.append(None)
+        return v
+
+    def update_memory(self, mem: Variable, new: Variable) -> None:
+        i = self._state_in.index(mem)
+        self._state_out[i] = new
+
+    def step_output(self, o: Variable) -> None:
+        self._step_outputs.append(o)
+
+    def __call__(self):
+        enforce_that(not self._built, "DynamicRNN already finalized",
+                     context="DynamicRNN")
+        enforce_that(self._seq_input is not None, "no step_input",
+                     context="DynamicRNN")
+        enforce_that(all(s is not None for s in self._state_out),
+                     "every memory needs update_memory", context="DynamicRNN")
+        self._built = True
+        used = _collect_outer_vars(self.program, [self.sub_block])
+        outs = [self.program.global_block().create_var(
+            name=self.program.unique_name("drnn_out"), dtype=o.dtype,
+            shape=(-1,) + tuple(o.shape[1:]), lod_level=1)
+            for o in self._step_outputs]
+        finals = [self.program.global_block().create_var(
+            name=self.program.unique_name("drnn_final"), dtype=s.dtype)
+            for s in self._state_out]
+        self.program.global_block().append_op(
+            "dynamic_recurrent",
+            inputs={"Inputs": self._seq_input,
+                    "InitStates": self._init_states,
+                    "Parameters": used},
+            outputs={"Outputs": outs, "FinalStates": finals},
+            attrs={"sub_block": self.sub_block.idx,
+                   "step_inputs": [self._step_in.name],
+                   "step_states_in": [v.name for v in self._state_in],
+                   "step_states_out": [v.name for v in self._state_out],
+                   "step_outputs": [v.name for v in self._step_outputs],
+                   "param_names": [v.name for v in used],
+                   "reverse": self.reverse})
+        return outs[0] if len(outs) == 1 else outs

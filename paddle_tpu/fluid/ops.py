@@ -824,18 +824,30 @@ def _sequence_pool(ins, attrs, ctx):
     if ptype == "SUM":
         out = seg @ data
     elif ptype == "AVERAGE":
-        out = (seg @ data) / jnp.sum(seg, axis=1, keepdims=True)
+        # clamp: a zero-length sequence pools to 0, not 0/0 -> NaN
+        cnt = jnp.maximum(jnp.sum(seg, axis=1, keepdims=True), 1.0)
+        out = (seg @ data) / cnt
     elif ptype == "SQRT":
-        out = (seg @ data) / jnp.sqrt(jnp.sum(seg, axis=1, keepdims=True))
+        cnt = jnp.maximum(jnp.sum(seg, axis=1, keepdims=True), 1.0)
+        out = (seg @ data) / jnp.sqrt(cnt)
     elif ptype == "MAX":
         big = jnp.where(seg[:, :, None] > 0, data[None, :, :], -jnp.inf)
         out = jnp.max(big, axis=1)
+        # a zero-length sequence has every row masked: pool to 0, not -inf
+        empty = jnp.sum(seg, axis=1, keepdims=True) == 0
+        out = jnp.where(empty, 0.0, out)
     elif ptype == "LAST":
-        offs = np.asarray(x.lod[-1][1:]) - 1
-        out = data[jnp.asarray(offs)]
+        lod = np.asarray(x.lod[-1])
+        offs = lod[1:] - 1
+        empty = lod[1:] == lod[:-1]   # off-by-one would grab a neighbor row
+        out = data[jnp.asarray(np.where(empty, 0, offs))]
+        out = jnp.where(jnp.asarray(empty)[:, None], 0.0, out)
     elif ptype == "FIRST":
-        offs = np.asarray(x.lod[-1][:-1])
+        lod = np.asarray(x.lod[-1])
+        offs = np.minimum(lod[:-1], data.shape[0] - 1)
+        empty = lod[1:] == lod[:-1]
         out = data[jnp.asarray(offs)]
+        out = jnp.where(jnp.asarray(empty)[:, None], 0.0, out)
     else:
         raise EnforceError(f"bad pooltype {ptype}", context="fluid")
     return {"Out": [out.reshape((out.shape[0],) + x.data.shape[1:])]}
@@ -926,6 +938,127 @@ def _recurrent(ins, attrs, ctx):
     carry, ys = lax.scan(body, tuple(init_states), tuple(xs),
                          reverse=bool(attrs.get("reverse", False)))
     return {"Outputs": list(ys), "FinalStates": list(carry)}
+
+
+# ---------------------------------------------------------------------------
+# control flow — cond (cond_op.h:28-46) and dynamic_recurrent
+# (dynamic_recurrent_op.cc) analogs
+# ---------------------------------------------------------------------------
+
+
+@register("cond", family="control_flow")
+def _cond(ins, attrs, ctx):
+    """Dynamic if-else (reference cond_op.h:28-46: gather the true/false
+    row subsets, run each subnet on its subset, scatter-merge).
+
+    TPU-native: subset gather/scatter means dynamic shapes, which kill XLA
+    tiling — instead BOTH sub-blocks run on the full batch and a per-row
+    mask selects each output. Statically shaped, fully fusable; costs at
+    most 2x branch FLOPs, which a masked-merge wins back by never leaving
+    the compiled program."""
+    enforce_that(ctx.trace_block is not None,
+                 "cond op needs executor trace hook", context="fluid")
+    cond = _dat(_one(ins, "Cond"))
+    names = list(attrs.get("x_names", []))
+    env0 = dict(zip(names, ins.get("Xs", [])))
+    env_t = ctx.trace_block(int(attrs["true_block"]), dict(env0))
+    env_f = ctx.trace_block(int(attrs["false_block"]), dict(env0))
+    outs = []
+    for tn, fn in zip(attrs["true_outputs"], attrs["false_outputs"]):
+        t, f = _dat(env_t[tn]), _dat(env_f[fn])
+        enforce_that(t.shape == f.shape,
+                     f"cond branch shapes differ: {t.shape} vs {f.shape}",
+                     context="fluid")
+        m = cond.reshape((-1,) + (1,) * (t.ndim - 1)).astype(bool)
+        outs.append(jnp.where(m, t, f))
+    return {"Out": outs}
+
+
+@register("dynamic_recurrent", family="rnn")
+def _dynamic_recurrent(ins, attrs, ctx):
+    """Variable-length RNN over a LoD batch (dynamic_recurrent_op.cc
+    analog). The reference packs per-step TensorArrays and launches the
+    step net T times; here the ragged batch is packed ONCE to padded
+    time-major [T, B, ...] with host-side indices (the LoD is static per
+    trace), a single ``lax.scan`` runs the step with mask-gated carries,
+    and rows scatter back to LoD order. ``reverse=True`` packs each
+    sequence back-to-front so the same forward scan IS the backward
+    recurrence."""
+    enforce_that(ctx.trace_block is not None,
+                 "dynamic_recurrent needs executor trace hook",
+                 context="fluid")
+    x = _one(ins, "Inputs")
+    enforce_that(isinstance(x, LoDArray),
+                 "dynamic_recurrent needs a LoD input", context="fluid")
+    init_states = [_dat(v) for v in ins.get("InitStates", [])]
+    params = list(ins.get("Parameters", []))
+    step_in = attrs["step_inputs"][0]
+    st_in = list(attrs["step_states_in"])
+    st_out = list(attrs["step_states_out"])
+    step_out = list(attrs["step_outputs"])
+    param_names = list(attrs.get("param_names", []))
+    sub_idx = int(attrs["sub_block"])
+    reverse = bool(attrs.get("reverse", False))
+
+    lod = np.asarray(x.lod[-1])
+    starts, lens = lod[:-1], lod[1:] - lod[:-1]
+    n_seq, t_max = len(lens), int(lens.max()) if len(lens) else 0
+    rows = x.data.reshape(x.data.shape[0], -1)
+
+    # host-side pack/unpack index plans (LoD is trace-static)
+    tb_idx = np.zeros((t_max, n_seq), np.int32)
+    mask = np.zeros((t_max, n_seq), np.float32)
+    flat_pos = np.zeros(int(lod[-1]), np.int64)
+    for b in range(n_seq):
+        for t in range(int(lens[b])):
+            tt = int(lens[b]) - 1 - t if reverse else t
+            tb_idx[tt, b] = starts[b] + t
+            mask[tt, b] = 1.0
+            flat_pos[starts[b] + t] = tt * n_seq + b
+
+    xt = jnp.take(rows, jnp.asarray(tb_idx.reshape(-1)), axis=0)
+    xt = xt.reshape(t_max, n_seq, -1)
+    mask_d = jnp.asarray(mask)
+
+    def body(carry, inp):
+        x_t, m_t = inp
+        env = {step_in: x_t}
+        env.update(zip(st_in, carry))
+        env.update(zip(param_names, params))
+        env = ctx.trace_block(sub_idx, env)
+        new_carry = []
+        for c, n in zip(carry, st_out):
+            nv = _dat(env[n])
+            gate = m_t.reshape((-1,) + (1,) * (nv.ndim - 1))
+            # finished sequences hold their final state (mask-gated carry)
+            new_carry.append(gate * nv + (1.0 - gate) * c)
+        outs = tuple(_dat(env[n]) for n in step_out)
+        return tuple(new_carry), outs
+
+    carry, ys = lax.scan(body, tuple(init_states), (xt, mask_d))
+    pos = jnp.asarray(flat_pos)
+    out_arrays = []
+    for y in ys:
+        flat = y.reshape(t_max * n_seq, *y.shape[2:])
+        out_arrays.append(LoDArray(jnp.take(flat, pos, axis=0), x.lod))
+    return {"Outputs": out_arrays, "FinalStates": list(carry)}
+
+
+# ---------------------------------------------------------------------------
+# checkpoint IO — save_restore_op.cc analog. These never enter the traced
+# program: the Executor runs IO-only programs eagerly on the host (file IO
+# inside an XLA program is nonsense); see Executor.run.
+# ---------------------------------------------------------------------------
+
+
+def _io_never_traced(ins, attrs, ctx):
+    raise EnforceError(
+        "save/restore are host-side ops: the Executor must run them "
+        "eagerly, never trace them", context="fluid")
+
+
+register("save", family="io", no_grad=True)(_io_never_traced)
+register("restore", family="io", no_grad=True)(_io_never_traced)
 
 
 # ---------------------------------------------------------------------------

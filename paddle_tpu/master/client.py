@@ -63,6 +63,8 @@ class MasterClient:
         self._poll = poll_interval_s
         self._records: List[bytes] = []
         self._task_id: Optional[int] = None
+        self._slot: Optional[int] = None
+        self._token: Optional[str] = None
 
     # -- dataset / records ---------------------------------------------------
 
@@ -84,6 +86,35 @@ class MasterClient:
             self._t.call("task_failed", task_id=self._task_id)
             self._task_id = None
             self._records = []
+
+    # -- membership (etcd Register/lease analog) -----------------------------
+
+    def register(self, ttl_s: Optional[float] = None) -> int:
+        """Join the job: claim a trainer slot under a lease. Tasks fetched
+        afterwards are owned by this slot and requeue promptly if the
+        lease lapses (go/pserver/etcd_client.go:67-166)."""
+        got = self._t.call("register", ttl_s=ttl_s)
+        self._slot, self._token = got["slot"], got["token"]
+        return self._slot
+
+    def heartbeat(self, ttl_s: Optional[float] = None) -> bool:
+        """Renew the lease. False means this trainer was declared dead
+        (lease lapsed — even if the slot number was since reclaimed by a
+        new trainer, the token mismatch rejects the zombie) — it must
+        re-register and resume from its last checkpoint."""
+        if self._slot is None:
+            return False
+        ok = self._t.call("heartbeat", slot=self._slot, token=self._token,
+                          ttl_s=ttl_s)
+        if not ok:
+            self._slot = None
+            self._token = None
+            self._task_id = None
+            self._records = []
+        return ok
+
+    def members(self) -> List[int]:
+        return self._t.call("members")
 
     # -- pass control --------------------------------------------------------
 
@@ -118,7 +149,7 @@ class MasterClient:
             self._t.call("task_finished", task_id=self._task_id)
             self._task_id = None
         while True:
-            task = self._t.call("get_task")
+            task = self._t.call("get_task", owner=self._slot)
             if task is not None:
                 break
             if self._t.call("all_done"):

@@ -67,6 +67,18 @@ class Service:
         self._pass_no = 0
         # save-model dedup: time until which save requests are "taken"
         self._save_until = 0.0
+        # trainer membership: the etcd Register/lease analog
+        # (go/pserver/etcd_client.go:67-166 — each trainer holds an index
+        # slot under a TTL lease; a missed heartbeat frees the slot and
+        # requeues the trainer's in-flight tasks)
+        self.lease_ttl_s = 3 * self.timeout_s if self.timeout_s else 180.0
+        # slot -> (lease deadline, lease token). The token is the etcd
+        # lease-id analog: slots are REUSED after expiry, so a zombie
+        # trainer renewing by slot number alone could hijack the slot's
+        # new owner — heartbeats must present the token they registered with
+        self._members: Dict[int, Tuple[float, str]] = {}
+        # task id -> owner slot (for prompt requeue on lease expiry)
+        self._owners: Dict[int, Optional[int]] = {}
 
         if snapshot_path and os.path.exists(snapshot_path):
             self._recover(snapshot_path)
@@ -103,24 +115,88 @@ class Service:
             self._snapshot()
             return len(tasks)
 
+    # ---- membership (etcd Register/lease analog) ---------------------------
+
+    def register(self, ttl_s: Optional[float] = None) -> Tuple[int, str]:
+        """Claim the smallest free trainer slot under a lease
+        (etcd_client.go:67-166's idx-slot transaction). Returns
+        (slot, lease_token); heartbeats must present both. Re-registering
+        after a crash gets a fresh slot+token; the dead slot's lease
+        expires on its own and its tasks requeue."""
+        import secrets
+
+        with self._lock:
+            self._expire_members()
+            slot = 0
+            while slot in self._members:
+                slot += 1
+            token = secrets.token_hex(8)
+            self._members[slot] = (self._time() + float(
+                ttl_s or self.lease_ttl_s), token)
+            return slot, token
+
+    def heartbeat(self, slot: int, token: str,
+                  ttl_s: Optional[float] = None) -> bool:
+        """Renew a lease. False = this trainer's lease is gone (expired, or
+        the slot was reclaimed by a new owner) — it was declared dead and
+        must re-register and resume from checkpoint."""
+        with self._lock:
+            self._expire_members()
+            ent = self._members.get(slot)
+            if ent is None or ent[1] != token:
+                return False
+            self._members[slot] = (self._time() + float(
+                ttl_s or self.lease_ttl_s), token)
+            return True
+
+    def members(self) -> List[int]:
+        with self._lock:
+            self._expire_members()
+            return sorted(self._members)
+
+    def _expire_members(self) -> None:
+        now = self._time()
+        dead = [s for s, (dl, _) in self._members.items() if dl <= now]
+        for slot in dead:
+            del self._members[slot]
+            # a dead trainer's tasks go back to the FRONT of todo: the
+            # pass re-runs them promptly, preserving task order for the
+            # surviving trainers (crash-resume determinism)
+            held = [tid for tid, owner in self._owners.items()
+                    if owner == slot and tid in self._pending]
+            for tid in sorted(held, reverse=True):
+                task, _ = self._pending.pop(tid)
+                task.num_failures += 1
+                if task.num_failures >= self.max_failures:
+                    self._done.append(task)
+                    self._maybe_new_pass()
+                else:
+                    self._todo.insert(0, task)
+        if dead:
+            self._snapshot()
+
     # ---- task lifecycle ----------------------------------------------------
 
-    def get_task(self) -> Optional[Task]:
+    def get_task(self, owner: Optional[int] = None) -> Optional[Task]:
         """Pop a todo task into pending (with deadline). Returns None when
         nothing is available right now — caller should retry or treat an
-        all-done pass as end-of-data (see all_done)."""
+        all-done pass as end-of-data (see all_done). ``owner`` ties the
+        lease to the task so a dead trainer's work requeues immediately."""
         with self._lock:
             self._check_timeouts()
+            self._expire_members()
             if not self._todo:
                 return None
             task = self._todo.pop(0)
             self._pending[task.id] = (task, self._time() + self.timeout_s)
+            self._owners[task.id] = owner
             self._snapshot()
             return task
 
     def task_finished(self, task_id: int) -> bool:
         with self._lock:
             ent = self._pending.pop(task_id, None)
+            self._owners.pop(task_id, None)
             if ent is None:
                 return False
             task = ent[0]
@@ -246,7 +322,8 @@ def dispatch(svc: "Service", method, params):
     if method == "set_dataset":
         return svc.set_dataset(params["paths"])
     if method == "get_task":
-        task = svc.get_task()
+        owner = params.get("owner")
+        task = svc.get_task(None if owner is None else int(owner))
         if task is None:
             return None
         return {"id": task.id, "epoch": task.epoch,
@@ -263,6 +340,14 @@ def dispatch(svc: "Service", method, params):
         return True
     if method == "request_save_model":
         return svc.request_save_model(float(params.get("block_s", 60.0)))
+    if method == "register":
+        slot, token = svc.register(params.get("ttl_s"))
+        return {"slot": slot, "token": token}
+    if method == "heartbeat":
+        return svc.heartbeat(int(params["slot"]), str(params["token"]),
+                             params.get("ttl_s"))
+    if method == "members":
+        return svc.members()
     if method == "ping":
         return "pong"
     raise ValueError(f"unknown method {method!r}")

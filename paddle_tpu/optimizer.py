@@ -115,7 +115,34 @@ class Optimizer:
         if self.model_average is not None:
             state["avg"] = {k: jnp.array(v) for k, v in params.items()}
             state["avg_count"] = jnp.zeros(())
+        masks = self._make_prune_masks(params)
+        if masks:
+            state["prune_masks"] = masks
         return state
+
+    def _make_prune_masks(self, params) -> Dict[str, jax.Array]:
+        """Static pruning masks from initial weights (StaticPruningHook,
+        ParameterUpdaterHook.cpp:39-104): keep the largest
+        (1 - sparsity_ratio) fraction by |value|. The reference partial-sorts
+        on the host; a quantile threshold is the O(n) XLA-friendly analog."""
+        from paddle_tpu.attr import HookAttr
+
+        masks = {}
+        for name, p in params.items():
+            attr = self._attr(name)
+            if attr is None:
+                continue
+            for hook in HookAttr.to_hooks(getattr(attr, "update_hooks", None)):
+                enforce_that(hook.type == "pruning",
+                             f"unknown update hook {hook.type!r}",
+                             context="optimizer")
+                thresh = jnp.quantile(jnp.abs(p).astype(jnp.float32).ravel(),
+                                      float(hook.sparsity_ratio))
+                masks[name] = (jnp.abs(p) >= thresh).astype(p.dtype)
+        return masks
+
+    def prune_mask(self, state, name: str):
+        return state.get("prune_masks", {}).get(name)
 
     # -- update ------------------------------------------------------------
 
@@ -124,10 +151,19 @@ class Optimizer:
                 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
         raise NotImplementedError
 
+    # optional scalar recursions computed once per apply (SparseMomentum's
+    # alpha/beta/tau); default: stateless
+    def _pre_update(self, state, base_lr):
+        return None
+
+    def _post_update(self, new_state, aux) -> None:
+        pass
+
     def apply(self, params: Dict[str, jax.Array], grads: Dict[str, jax.Array],
               state: Dict[str, Any]) -> Tuple[Dict[str, jax.Array], Dict[str, Any]]:
         step = state["step"]
         base_lr = self.learning_rate * self.schedule(step.astype(jnp.float32))
+        self._aux = self._pre_update(state, base_lr)
 
         # global-norm clipping (reference: OptimizerWithGradientClipping used
         # per-parameter thresholds; pjit-era default is global norm, and
@@ -163,14 +199,25 @@ class Optimizer:
                 g = g + l2 * p
             if l1:
                 g = g + l1 * jnp.sign(p)
+            mask = self.prune_mask(state, name)
+            if mask is not None:
+                # StaticPruningHook.update: grad *= mask before the rule
+                g = g * mask
             lr = base_lr * (attr.learning_rate if attr is not None else 1.0)
             slots = {s: state["slots"][s][name] for s in self.slot_names()}
             np_, ns = self._update(name, p, g.astype(p.dtype), slots, lr, step)
+            if mask is not None:
+                # and value *= mask (the hook's init masking, re-asserted so
+                # weight decay/averaging can never resurrect pruned weights)
+                np_ = np_ * mask
             new_params[name] = np_
             for s in self.slot_names():
                 new_slots[s][name] = ns[s]
 
         new_state = {"step": step + 1, "slots": new_slots}
+        if "prune_masks" in state:
+            new_state["prune_masks"] = state["prune_masks"]
+        self._post_update(new_state, self._aux)
         if self.model_average is not None:
             w = self.model_average.average_window
             decay = jnp.minimum(state["avg_count"] / (state["avg_count"] + 1.0),
@@ -211,6 +258,74 @@ class Momentum(Optimizer):
     def _update(self, name, p, g, slots, lr, step):
         m = self.momentum * slots["momentum"] - lr * g
         return p + m, {"momentum": m}
+
+
+class SparseMomentum(Optimizer):
+    """Lazy-momentum scheme (reference SparseMomentumParameterOptimizer,
+    FirstOrderOptimizer.h:61-125 / .cpp:30-115): momentum refactored into
+    two additive accumulators u, v plus scalar recursions
+
+        tau_t = tau_{t-1} + beta_t / alpha_t
+        alpha_t = alpha_{t-1} / k,   beta_t = beta_{t-1} / (1 + lambda*lr)
+        u_t = u_{t-1} - alpha_t*lr*g_t,   v_t = v_{t-1} + tau_t*alpha_t*lr*g_t
+        theta_t = (tau_t/beta_t + 1/alpha_t)*u_t + v_t/beta_t
+
+    so untouched (sparse) rows need no per-step work. Mathematically equal
+    to heavy-ball momentum for decay_rate=0 (verified in
+    tests/test_optimizers_hooks.py). alpha grows as k^-t, so past the
+    reference's 1e6 threshold the scalars restart (u /= alpha, v = theta) —
+    here as a jit-friendly masked select instead of a special traversal."""
+
+    def __init__(self, momentum: float = 0.9, decay_rate: float = 0.0,
+                 threshold: float = 1e6, **kw):
+        super().__init__(**kw)
+        enforce_that(0.0 < momentum < 1.0,
+                     "SparseMomentum needs 0 < momentum < 1",
+                     context="optimizer")
+        self.momentum = momentum
+        self.decay_rate = decay_rate
+        self.threshold = threshold
+
+    def slot_names(self):
+        return ("u", "v")
+
+    def init_state(self, params):
+        state = super().init_state(params)
+        # v_0 = theta_0 (the reference's first-touch assign, t0Vec_)
+        state["slots"]["v"] = {k: jnp.array(v) for k, v in params.items()}
+        state["sm"] = {"alpha": jnp.ones(()), "beta": jnp.ones(()),
+                       "tau": -jnp.ones(())}
+        return state
+
+    def _pre_update(self, state, base_lr):
+        sm = state["sm"]
+        tau = sm["tau"] + sm["beta"] / sm["alpha"]
+        alpha = sm["alpha"] / self.momentum
+        beta = sm["beta"] / (1.0 + self.decay_rate * base_lr)
+        return {"tau": tau, "alpha": alpha, "beta": beta, "lr": base_lr}
+
+    def _update(self, name, p, g, slots, lr, step):
+        a = self._aux
+        tau, alpha, beta = a["tau"], a["alpha"], a["beta"]
+        # per-param lr multipliers scale g via lr/base_lr
+        scale = lr / jnp.maximum(a["lr"], 1e-30)
+        u = slots["u"] - alpha * a["lr"] * scale * g
+        v = slots["v"] + tau * alpha * a["lr"] * scale * g
+        theta = (tau / beta + 1.0 / alpha) * u + v / beta
+        # numeric restart (needSpecialTraversal): alpha ~ k^-t diverges
+        restart = alpha > self.threshold
+        u = jnp.where(restart, u / alpha, u)
+        v = jnp.where(restart, theta, v)
+        return theta, {"u": u, "v": v}
+
+    def _post_update(self, new_state, aux) -> None:
+        restart = aux["alpha"] > self.threshold
+        one = jnp.ones(())
+        new_state["sm"] = {
+            "alpha": jnp.where(restart, one, aux["alpha"]),
+            "beta": jnp.where(restart, one, aux["beta"]),
+            "tau": jnp.where(restart, -one, aux["tau"]),
+        }
 
 
 class Adagrad(Optimizer):

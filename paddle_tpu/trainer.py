@@ -94,6 +94,13 @@ class SGD:
         n_costs = self._n_costs
         metric_names = list(self.metrics.keys())
 
+        # grad stats ride in the same compiled step (TrainerInternal.cpp:
+        # 80-110 computes avgAbsGrad/maxAbsGrad in the update callback).
+        # captured once at build time: the compiled step and the logging
+        # cadence must agree even if the flag changes later
+        self._stats_period = int(FLAGS.show_parameter_stats_period or 0)
+        stats_on = self._stats_period > 0
+
         def step(params, opt_state, model_state, rng, feeds):
             def loss_fn(p):
                 outs, new_state = topo.forward(p, model_state, feeds,
@@ -107,6 +114,11 @@ class SGD:
             (loss, (new_mstate, metric_vals)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
             new_params, new_opt = optimizer.apply(params, grads, opt_state)
+            if stats_on:
+                metric_vals = dict(metric_vals)
+                metric_vals["__param_stats__"] = {
+                    k: (jnp.mean(jnp.abs(g)), jnp.max(jnp.abs(g)))
+                    for k, g in grads.items()}
             return loss, new_params, new_opt, new_mstate, metric_vals
 
         # With mesh-sharded (NamedSharding) inputs, jit partitions the whole
@@ -209,6 +221,14 @@ class SGD:
                 with stats.timer("trainOneBatch"):
                     loss, params, opt_state, mstate, metric_vals = self._step_fn(
                         params, opt_state, mstate, key, feeds)
+                pstats = metric_vals.pop("__param_stats__", None)
+                period = getattr(self, "_stats_period", 0)
+                if pstats is not None and period > 0 \
+                        and (batch_id + 1) % period == 0:
+                    for k in sorted(pstats):
+                        avg_abs, max_abs = pstats[k]
+                        log.info("Param %s avgAbsGrad=%.6g maxAbsGrad=%.6g",
+                                 k, float(avg_abs), float(max_abs))
                 # no host sync per batch (the device round-trip costs more
                 # than the step); events convert lazily via properties
                 pending.append(loss)

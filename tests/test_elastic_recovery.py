@@ -1,0 +1,208 @@
+"""Elastic membership + kill-a-trainer failure injection.
+
+Reference analogs: go/pserver/etcd_client.go:67-166 (Register under a TTL
+lease, idx-slot transaction), go/master/service.go:313-448 (timeout
+requeue), and the fault-tolerance design docs' kill/recover story. The
+reference tests these with in-process servers
+(paddle/pserver/test/test_ParameterServer2.cpp:554-560); we do the same
+with an injectable clock.
+"""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import checkpoint as ckpt
+from paddle_tpu import layer, optimizer, trainer
+from paddle_tpu.master.client import MasterClient
+from paddle_tpu.master.recordio import recordio_write
+from paddle_tpu.master.service import Service
+
+
+class Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# membership protocol
+# ---------------------------------------------------------------------------
+
+
+def test_register_assigns_smallest_free_slot():
+    clk = Clock()
+    svc = Service(time_fn=clk)
+    a, b, c = svc.register(), svc.register(), svc.register()
+    assert (a, b, c) == (0, 1, 2)
+    # b dies -> slot 1 frees after lease; next register reclaims it
+    clk.t += 1.0
+    assert svc.heartbeat(0, ttl_s=1e6) and svc.heartbeat(2, ttl_s=1e6)
+    clk.t += svc.lease_ttl_s  # b's lease lapses (0/2 renewed long)
+    assert svc.heartbeat(0, ttl_s=1e6) and svc.heartbeat(2, ttl_s=1e6)
+    assert svc.members() == [0, 2]
+    assert svc.register() == 1
+    assert not svc.heartbeat(5), "unknown slot must not heartbeat"
+
+
+def test_dead_trainer_tasks_requeue_to_front(tmp_path):
+    clk = Clock()
+    svc = Service(chunks_per_task=2, timeout_s=1e6, time_fn=clk)
+    p = str(tmp_path / "data")
+    recordio_write(p, [f"r{i}".encode() for i in range(8)])  # 4 tasks
+    svc.set_dataset([p])
+
+    dead = svc.register(ttl_s=10.0)
+    live = svc.register(ttl_s=1e6)
+    t0 = svc.get_task(owner=dead)       # dead trainer holds task 0
+    t1 = svc.get_task(owner=live)
+    assert t0.id == 0 and t1.id == 1
+
+    clk.t += 11.0                        # dead's lease lapses
+    nxt = svc.get_task(owner=live)       # requeued task 0 comes FIRST
+    assert nxt.id == 0, "dead trainer's task must be redelivered first"
+    assert svc.members() == [live]
+    # the task timeout itself did NOT fire (timeout_s huge): this was
+    # lease-driven requeue, the faster path
+    assert t1.id in svc._pending
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: kill a trainer mid-pass, resume from checkpoint, converge
+# ---------------------------------------------------------------------------
+
+
+def _build_model():
+    paddle.topology.reset_name_scope()
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(8))
+    y = layer.data(name="y", type=paddle.data_type.integer_value(2))
+    cost = layer.classification_cost(
+        input=layer.fc(input=layer.fc(input=x, size=16, act="relu"), size=2),
+        label=y)
+    return cost
+
+
+def _make_sgd():
+    cost = _build_model()
+    params = paddle.Parameters.from_topology(
+        paddle.topology.Topology([cost]), seed=5)
+    return trainer.SGD(cost=cost, parameters=params,
+                       update_equation=optimizer.Momentum(
+                           momentum=0.9, learning_rate=0.1))
+
+
+def _write_dataset(path, rng, n=96):
+    """Linearly-separable records 'x1,...,x8|label'."""
+    w = rng.randn(8)
+    recs = []
+    for _ in range(n):
+        x = rng.randn(8).astype(np.float32)
+        recs.append((",".join(f"{v:.6f}" for v in x)
+                     + f"|{int(x @ w > 0)}").encode())
+    recordio_write(path, recs)
+
+
+def _parse(rec):
+    xs, label = rec.decode().split("|")
+    return (np.asarray([float(v) for v in xs.split(",")], np.float32),
+            int(label))
+
+
+def _train_tasks(sgd, client, max_tasks=None,
+                 save_dir=None, die_after=None):
+    """Consume master tasks; one SGD step per task-chunk batch. Returns
+    the number of tasks completed. ``die_after`` stops WITHOUT reporting
+    task_finished (the crash)."""
+    import jax
+
+    done = 0
+    while True:
+        if max_tasks is not None and done >= max_tasks:
+            return done
+        if not client._fetch_task():
+            return done
+        batch = [_parse(r) for r in client._records]
+        client._records = []
+        if die_after is not None and done >= die_after:
+            return done  # crash: in-flight task never reported
+        feeder = sgd._make_feeder(None)
+        feeds = feeder.feed(batch)
+        if sgd._step_fn is None:
+            sgd._step_fn = sgd._build_step()
+        p = sgd.parameters.as_dict()
+        loss, p, sgd.opt_state, sgd.model_state, _ = sgd._step_fn(
+            p, sgd.opt_state, sgd.model_state, jax.random.PRNGKey(done),
+            feeds)
+        sgd.parameters.update_from(p)
+        done += 1
+        if save_dir is not None:
+            sgd.save_checkpoint(save_dir, done - 1)
+
+
+def test_kill_trainer_resume_parity(tmp_path):
+    """Trainer A processes 2 tasks (checkpointing each), crashes holding
+    task 3; its lease lapses; trainer B registers, restores A's last
+    checkpoint, and finishes the pass. Final params must EQUAL a straight
+    single-trainer run over the same task sequence (the
+    test_TrainerOnePass.cpp determinism bar, extended to the crash path)."""
+    rng = np.random.RandomState(0)
+    data_path = str(tmp_path / "train.recordio")
+    _write_dataset(data_path, rng)
+
+    clk = Clock()
+
+    def fresh(save_dir=None):
+        svc = Service(chunks_per_task=16, timeout_s=1e6, time_fn=clk)
+        svc.set_dataset([data_path])   # 96 recs / 16 = 6 tasks
+        return svc
+
+    # ---- straight run: one trainer, whole pass ----
+    svc = fresh()
+    c = MasterClient(service=svc)
+    c.register(ttl_s=1e9)
+    sgd_ref = _make_sgd()
+    n = _train_tasks(sgd_ref, c)
+    assert n == 6
+    ref = {k: np.asarray(sgd_ref.parameters[k])
+           for k in sgd_ref.parameters.names()}
+
+    # ---- crash run ----
+    svc = fresh()
+    ck_dir = str(tmp_path / "ckpt")
+    ca = MasterClient(service=svc)
+    ca.register(ttl_s=10.0)
+    sgd_a = _make_sgd()
+    # A: completes tasks 0,1 (checkpointing), takes task 2 and dies
+    done_a = _train_tasks(sgd_a, ca, max_tasks=3, save_dir=ck_dir,
+                          die_after=2)
+    assert done_a == 2
+
+    clk.t += 11.0   # A's lease lapses -> task 2 requeues to the front
+
+    cb = MasterClient(service=svc)
+    cb.register(ttl_s=1e9)
+    sgd_b = _make_sgd()
+    sgd_b.load_checkpoint(ck_dir)      # latest = after A's task 1
+    # B's step counter must continue where A stopped (rng stream parity);
+    # replay continuation: tasks 2..5 with step ids 2..5
+    import jax
+    done = 2
+    while True:
+        if not cb._fetch_task():
+            break
+        batch = [_parse(r) for r in cb._records]
+        cb._records = []
+        if sgd_b._step_fn is None:
+            sgd_b._step_fn = sgd_b._build_step()
+        p = sgd_b.parameters.as_dict()
+        loss, p, sgd_b.opt_state, sgd_b.model_state, _ = sgd_b._step_fn(
+            p, sgd_b.opt_state, sgd_b.model_state, jax.random.PRNGKey(done),
+            feeds=sgd_b._make_feeder(None).feed(batch))
+        sgd_b.parameters.update_from(p)
+        done += 1
+    assert done == 6, f"B finished at {done}, expected 6 tasks total"
+
+    for k in ref:
+        np.testing.assert_allclose(np.asarray(sgd_b.parameters[k]), ref[k],
+                                   rtol=2e-5, atol=2e-6, err_msg=k)

@@ -1,0 +1,252 @@
+"""Fluid control-flow (cond, dynamic_recurrent) + save/restore IO tests.
+
+Reference analogs: paddle/operators/cond_op.h (if-else over row subsets),
+dynamic_recurrent_op.cc (LoD-aware RNN), save_restore_op.cc (+ its python
+test test_save_restore_op.py roundtrip).
+"""
+
+import os
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers, optimizer
+from paddle_tpu.fluid.ops import LoDArray
+
+
+# ---------------------------------------------------------------------------
+# cond
+# ---------------------------------------------------------------------------
+
+
+def test_cond_forward():
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        x = layers.data("x", [3])
+        pred = layers.data("pred", [1])
+        out = layers.cond(pred,
+                          lambda: layers.scale(x, scale=2.0),
+                          lambda: layers.scale(x, scale=0.5))
+
+    exe = fluid.Executor()
+    xb = np.arange(12, dtype=np.float32).reshape(4, 3)
+    pb = np.array([1.0, 0.0, 1.0, 0.0], np.float32)
+    (o,) = exe.run(prog, feed={"x": xb, "pred": pb}, fetch_list=[out],
+                   scope=fluid.Scope())
+    want = np.where(pb[:, None] > 0, xb * 2.0, xb * 0.5)
+    np.testing.assert_allclose(o, want, rtol=1e-6)
+
+
+def test_cond_trains_both_branches():
+    """Gradients flow into parameters used by BOTH branches (masked-merge
+    semantics: each row trains the branch its pred selected)."""
+    rng = np.random.RandomState(0)
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        x = layers.data("x", [4])
+        pred = layers.data("pred", [1])
+        y = layers.data("y", [1])
+        out = layers.cond(pred,
+                          lambda: layers.fc(x, size=1, bias_attr=True),
+                          lambda: layers.fc(x, size=1, bias_attr=True))
+        loss = layers.mean(layers.square_error_cost(out, y))
+        optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    # rows with pred=1 follow w_true, rows with pred=0 follow w_false
+    w_t = rng.randn(4, 1).astype(np.float32)
+    w_f = -w_t
+    losses = []
+    for _ in range(80):
+        xb = rng.randn(16, 4).astype(np.float32)
+        pb = (rng.rand(16) > 0.5).astype(np.float32)
+        yb = np.where(pb[:, None] > 0, xb @ w_t, xb @ w_f)
+        (l,) = exe.run(prog, feed={"x": xb, "pred": pb, "y": yb},
+                       fetch_list=[loss], scope=scope)
+        losses.append(float(l))
+    assert losses[-1] < 0.1 * losses[0], losses[::20]
+
+
+# ---------------------------------------------------------------------------
+# dynamic_recurrent
+# ---------------------------------------------------------------------------
+
+
+def _ragged_input(rng, lens, dim):
+    offs = np.concatenate([[0], np.cumsum(lens)])
+    data = rng.randn(int(offs[-1]), dim).astype(np.float32)
+    return LoDArray(data, (tuple(int(o) for o in offs),))
+
+
+def test_dynamic_recurrent_matches_oracle():
+    """Running-sum RNN over ragged sequences == per-sequence numpy scan."""
+    rng = np.random.RandomState(0)
+    lens = [3, 1, 4, 2]
+    dim = 5
+    x_lod = _ragged_input(rng, lens, dim)
+
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        x = layers.data("x", [dim], lod_level=1)
+        drnn = layers.DynamicRNN()
+        with drnn.step():
+            x_t = drnn.step_input(x)
+            h = drnn.memory(shape=(len(lens), dim))
+            s = layers.elementwise_add(x_t, h)
+            drnn.update_memory(h, s)
+            drnn.step_output(s)
+        out = drnn()
+
+    exe = fluid.Executor()
+    (o,) = exe.run(prog, feed={"x": x_lod}, fetch_list=[out],
+                   scope=fluid.Scope())
+
+    offs = np.asarray(x_lod.lod[0])
+    want = np.concatenate([np.cumsum(x_lod.data[offs[i]:offs[i + 1]], axis=0)
+                           for i in range(len(lens))])
+    np.testing.assert_allclose(o, want, rtol=1e-5, atol=1e-6)
+
+
+def test_dynamic_recurrent_reverse():
+    """reverse=True: suffix sums per sequence (backward recurrence)."""
+    rng = np.random.RandomState(1)
+    lens = [2, 3]
+    dim = 3
+    x_lod = _ragged_input(rng, lens, dim)
+
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        x = layers.data("x", [dim], lod_level=1)
+        drnn = layers.DynamicRNN(reverse=True)
+        with drnn.step():
+            x_t = drnn.step_input(x)
+            h = drnn.memory(shape=(len(lens), dim))
+            s = layers.elementwise_add(x_t, h)
+            drnn.update_memory(h, s)
+            drnn.step_output(s)
+        out = drnn()
+
+    exe = fluid.Executor()
+    (o,) = exe.run(prog, feed={"x": x_lod}, fetch_list=[out],
+                   scope=fluid.Scope())
+
+    offs = np.asarray(x_lod.lod[0])
+    want = np.concatenate(
+        [np.cumsum(x_lod.data[offs[i]:offs[i + 1]][::-1], axis=0)[::-1]
+         for i in range(len(lens))])
+    np.testing.assert_allclose(o, want, rtol=1e-5, atol=1e-6)
+
+
+def test_dynamic_recurrent_trains():
+    """A learned recurrent projection trains through the LoD scan: fit a
+    target that is the per-sequence running MEAN of inputs (needs the
+    recurrence + the trained projection)."""
+    rng = np.random.RandomState(2)
+    dim = 4
+
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        x = layers.data("x", [dim], lod_level=1)
+        tgt = layers.data("tgt", [dim], lod_level=1)
+        drnn = layers.DynamicRNN()
+        with drnn.step():
+            x_t = drnn.step_input(x)
+            h = drnn.memory(shape=(4, dim))
+            s = layers.elementwise_add(layers.fc(x_t, size=dim), h)
+            drnn.update_memory(h, s)
+            drnn.step_output(s)
+        out = drnn()
+        loss = layers.mean(layers.square_error_cost(out, tgt))
+        optimizer.AdamOptimizer(learning_rate=0.02).minimize(loss)
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    lens = [3, 2, 4, 1]
+    losses = []
+    for _ in range(60):
+        x_lod = _ragged_input(rng, lens, dim)
+        offs = np.asarray(x_lod.lod[0])
+        # target: running sum of 0.5*x  (the fc must learn 0.5*I)
+        t = np.concatenate(
+            [np.cumsum(0.5 * x_lod.data[offs[i]:offs[i + 1]], axis=0)
+             for i in range(len(lens))])
+        (l,) = exe.run(prog, feed={"x": x_lod,
+                                   "tgt": LoDArray(t, x_lod.lod)},
+                       fetch_list=[loss], scope=scope)
+        losses.append(float(l))
+    assert losses[-1] < 0.1 * losses[0], losses[::15]
+
+
+# ---------------------------------------------------------------------------
+# save / restore
+# ---------------------------------------------------------------------------
+
+
+def _train_once(prog_holder):
+    rng = np.random.RandomState(3)
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        x = layers.data("x", [4])
+        y = layers.data("y", [1])
+        pred = layers.fc(x, size=1, bias_attr=True)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        optimizer.MomentumOptimizer(learning_rate=0.05,
+                                    momentum=0.9).minimize(loss)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    for _ in range(10):
+        xb = rng.randn(16, 4).astype(np.float32)
+        exe.run(prog, feed={"x": xb, "y": xb.sum(1, keepdims=True)},
+                fetch_list=[loss], scope=scope)
+    prog_holder.append(prog)
+    return exe, scope, loss
+
+
+def test_save_restore_roundtrip(tmp_path):
+    holder = []
+    exe, scope, _ = _train_once(holder)
+    prog = holder[0]
+    d = str(tmp_path / "ckpt")
+    fluid.io.save_persistables(exe, d, main_program=prog, scope=scope)
+
+    saved = {n: np.asarray(v).copy() for n, v in scope.values.items()}
+    for n in scope.values:
+        scope.values[n] = np.zeros_like(np.asarray(scope.values[n]))
+
+    fluid.io.load_persistables(exe, d, main_program=prog, scope=scope)
+    for n, want in saved.items():
+        np.testing.assert_array_equal(np.asarray(scope.values[n]), want)
+    # files are one .npy per var
+    assert sorted(f[:-4] for f in os.listdir(d)) == sorted(saved)
+
+
+def test_save_params_subset(tmp_path):
+    holder = []
+    exe, scope, _ = _train_once(holder)
+    prog = holder[0]
+    d = str(tmp_path / "params")
+    fluid.io.save_params(exe, d, main_program=prog, scope=scope)
+    n_params = sum(isinstance(v, fluid.Parameter)
+                   for v in prog.global_block().vars.values())
+    assert len(os.listdir(d)) == n_params
+    assert 0 < n_params < len(scope.values)  # strictly params, not slots
+
+
+def test_io_programs_must_be_pure(tmp_path):
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        x = layers.data("x", [2])
+        v = prog.global_block().create_var(name="w", shape=(2,),
+                                           persistable=True)
+        layers.scale(x, scale=2.0)
+        prog.global_block().append_op(
+            "save", inputs={"X": [v]}, outputs={},
+            attrs={"path": str(tmp_path)})
+    exe = fluid.Executor()
+    try:
+        exe.run(prog, feed={"x": np.ones((1, 2), np.float32)},
+                fetch_list=[], scope=fluid.Scope())
+        raise AssertionError("mixed IO program must be rejected")
+    except Exception as e:
+        assert "IO-only" in str(e)

@@ -1,0 +1,131 @@
+"""Pruning hook + SparseMomentum + per-param grad stats tests.
+
+Reference analogs: ParameterUpdaterHook.cpp:39-104 (StaticPruningHook),
+FirstOrderOptimizer.h:61-125 (SparseMomentumParameterOptimizer),
+TrainerInternal.cpp:80-110 (show_param_stats_period avg/max abs grad).
+"""
+
+import logging
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import layer, optimizer, trainer
+from paddle_tpu.attr import HookAttr, ParamAttr
+from paddle_tpu.platform.flags import FLAGS
+
+
+def _build(hooked=False):
+    paddle.topology.reset_name_scope()
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(16))
+    y = layer.data(name="y", type=paddle.data_type.integer_value(4))
+    pa = ParamAttr(update_hooks=HookAttr("pruning", sparsity_ratio=0.75)) \
+        if hooked else None
+    h = layer.fc(input=x, size=32, act="relu", param_attr=pa)
+    cost = layer.classification_cost(input=layer.fc(input=h, size=4), label=y)
+    return cost
+
+
+def _data(rng, n=64, dim=16, classes=4):
+    return [(rng.randn(dim).astype(np.float32), int(rng.randint(classes)))
+            for _ in range(n)]
+
+
+def test_pruning_hook_masks_stay_zero():
+    """75%-sparsified fc weight: pruned entries are zero at init AND stay
+    zero through momentum training (StaticPruningHook semantics)."""
+    rng = np.random.RandomState(0)
+    cost = _build(hooked=True)
+    params = paddle.Parameters.from_topology(
+        paddle.topology.Topology([cost]), seed=1)
+    sgd = trainer.SGD(cost=cost, parameters=params,
+                      update_equation=optimizer.Momentum(
+                          momentum=0.9, learning_rate=0.1,
+                          regularization=optimizer.L2Regularization(1e-3)))
+    wname = [n for n in params.names() if n.startswith("fc_0") and ".w" in n][0]
+    mask = np.asarray(sgd.opt_state["prune_masks"][wname])
+    frac = mask.mean()
+    assert 0.2 < frac < 0.3, frac          # ~25% kept
+
+    reader = paddle.batch(lambda: iter(_data(rng)), 16)
+    sgd.train(reader, num_passes=3, event_handler=lambda ev: None)
+    w = np.asarray(sgd.parameters[wname])
+    assert np.all(w[mask == 0] == 0.0), "pruned weights resurrected"
+    assert np.abs(w[mask == 1]).sum() > 0   # kept weights trained
+
+
+def test_unhooked_params_have_no_masks():
+    cost = _build(hooked=False)
+    params = paddle.Parameters.from_topology(
+        paddle.topology.Topology([cost]), seed=1)
+    sgd = trainer.SGD(cost=cost, parameters=params,
+                      update_equation=optimizer.Sgd(learning_rate=0.1))
+    assert "prune_masks" not in sgd.opt_state
+
+
+def test_sparse_momentum_equals_momentum():
+    """decay_rate=0: the lazy u/v scheme reproduces heavy-ball momentum
+    exactly (the equivalence the reference's scheme is built on)."""
+    rng = np.random.RandomState(42)
+    p0 = {"w": rng.randn(8, 4).astype(np.float32)}
+    grads = [{"w": rng.randn(8, 4).astype(np.float32)} for _ in range(6)]
+
+    om = optimizer.Momentum(momentum=0.9, learning_rate=0.05)
+    osm = optimizer.SparseMomentum(momentum=0.9, learning_rate=0.05)
+    pm, sm_ = dict(p0), om.init_state(p0)
+    ps, ss = dict(p0), osm.init_state(p0)
+    for g in grads:
+        pm, sm_ = om.apply(pm, g, sm_)
+        ps, ss = osm.apply(ps, g, ss)
+        np.testing.assert_allclose(np.asarray(ps["w"]), np.asarray(pm["w"]),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_sparse_momentum_restart_is_seamless():
+    """Force the alpha>threshold restart every few steps: trajectory must
+    stay (approximately) the plain-momentum one across the reset."""
+    rng = np.random.RandomState(1)
+    p0 = {"w": rng.randn(10).astype(np.float32)}
+    grads = [{"w": rng.randn(10).astype(np.float32)} for _ in range(12)]
+
+    om = optimizer.Momentum(momentum=0.5, learning_rate=0.1)
+    # momentum 0.5 -> alpha doubles per step; threshold 8 restarts ~every 3
+    osm = optimizer.SparseMomentum(momentum=0.5, learning_rate=0.1,
+                                   threshold=8.0)
+    pm, sm_ = dict(p0), om.init_state(p0)
+    ps, ss = dict(p0), osm.init_state(p0)
+    for g in grads:
+        pm, sm_ = om.apply(pm, g, sm_)
+        ps, ss = osm.apply(ps, g, ss)
+    # restart drops a tiny u/alpha residue; bounded, not exact
+    np.testing.assert_allclose(np.asarray(ps["w"]), np.asarray(pm["w"]),
+                               rtol=0.05, atol=0.05)
+
+
+def test_param_grad_stats_logged(caplog):
+    rng = np.random.RandomState(2)
+    cost = _build()
+    params = paddle.Parameters.from_topology(
+        paddle.topology.Topology([cost]), seed=1)
+    FLAGS.update(show_parameter_stats_period=2)
+    try:
+        sgd = trainer.SGD(cost=cost, parameters=params,
+                          update_equation=optimizer.Sgd(learning_rate=0.1))
+        reader = paddle.batch(lambda: iter(_data(rng)), 16)
+        # plog's logger doesn't propagate to root; attach caplog's handler
+        handler = caplog.handler
+        plog_logger = logging.getLogger("paddle_tpu")
+        plog_logger.addHandler(handler)
+        try:
+            sgd.train(reader, num_passes=1, event_handler=lambda ev: None)
+        finally:
+            plog_logger.removeHandler(handler)
+    finally:
+        FLAGS.update(show_parameter_stats_period=0)
+    stats_lines = [r.getMessage() for r in caplog.records
+                   if "avgAbsGrad" in r.getMessage()]
+    assert stats_lines, "no param stats logged"
+    # one line per parameter per logging point, finite values
+    assert any("fc_0" in ln for ln in stats_lines)
+    for ln in stats_lines:
+        assert "nan" not in ln.lower()
