@@ -480,7 +480,7 @@ def addto(input, act=None, name: Optional[str] = None, bias_attr=False,
         for v in ins[1:]:
             total = total + _data_of(v)
         if has_bias:
-            total = total + p["b"]
+            total = total + p["b"].astype(total.dtype)
         out = _like(ins[0], total)
         out = _apply_act(activation, out)
         return _apply_extra(ctx, name, out, layer_attr)
@@ -731,10 +731,12 @@ def img_conv(input, filter_size: int, num_filters: int, num_channels: int = None
             y = pconv.conv2d(x, p["w"], stride=stride, padding=padding,
                              dilation=dilation, groups=groups)
         if has_bias:
+            # cast the f32 bias into the activation dtype: a plain add would
+            # promote bf16 activations back to f32 and double HBM traffic
             if shared_biases:
-                y = y + p["b"]
+                y = y + p["b"].astype(y.dtype)
             else:
-                y = y + p["b"].reshape(1, oh, ow, num_filters)
+                y = y + p["b"].reshape(1, oh, ow, num_filters).astype(y.dtype)
         y = _apply_act(activation, y)
         return _apply_extra(ctx, name, y, layer_attr)
 

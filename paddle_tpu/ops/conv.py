@@ -32,9 +32,21 @@ def _conv_dtype(x):
     return x.dtype
 
 
+def activation_dtype() -> jnp.dtype:
+    """Storage dtype for inter-layer image activations.
+
+    bf16 activations halve HBM traffic between conv blocks — on TPU the
+    usual ResNet bottleneck is bandwidth, not MXU FLOPs. Batch-norm stats,
+    losses, and all parameters stay f32 (see ops/norm.py batch_norm).
+    """
+    if FLAGS.use_bf16 and FLAGS.bf16_activations:
+        return jnp.dtype(jnp.bfloat16)
+    return jnp.dtype(jnp.float32)
+
+
 def conv2d(x: jax.Array, w: jax.Array, *, stride: IntOr2 = 1,
            padding: Union[str, IntOr2] = 0, dilation: IntOr2 = 1,
-           groups: int = 1, out_dtype=jnp.float32) -> jax.Array:
+           groups: int = 1, out_dtype=None) -> jax.Array:
     """x: [N,H,W,C], w: [kh,kw,Cin/groups,Cout] -> [N,H',W',Cout]."""
     s = _pair(stride)
     d = _pair(dilation)
@@ -51,11 +63,12 @@ def conv2d(x: jax.Array, w: jax.Array, *, stride: IntOr2 = 1,
         x.astype(ct), w.astype(ct), window_strides=s, padding=pad,
         rhs_dilation=d, feature_group_count=groups,
         dimension_numbers=("NHWC", "HWIO", "NHWC"))
-    return y.astype(jnp.dtype(out_dtype))
+    return y.astype(jnp.dtype(out_dtype) if out_dtype is not None
+                    else activation_dtype())
 
 
 def conv2d_transpose(x: jax.Array, w: jax.Array, *, stride: IntOr2 = 1,
-                     padding: IntOr2 = 0, out_dtype=jnp.float32) -> jax.Array:
+                     padding: IntOr2 = 0, out_dtype=None) -> jax.Array:
     """Transposed conv (reference: ConvTransLayer / conv2dtranspose op)."""
     s = _pair(stride)
     ph, pw = _pair(padding)
@@ -69,7 +82,8 @@ def conv2d_transpose(x: jax.Array, w: jax.Array, *, stride: IntOr2 = 1,
         window_strides=(1, 1),
         padding=((kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw)),
         lhs_dilation=s, dimension_numbers=("NHWC", "HWIO", "NHWC"))
-    return y.astype(jnp.dtype(out_dtype))
+    return y.astype(jnp.dtype(out_dtype) if out_dtype is not None
+                    else activation_dtype())
 
 
 def depthwise_conv2d(x: jax.Array, w: jax.Array, *, stride: IntOr2 = 1,
@@ -96,7 +110,7 @@ def conv3d(x: jax.Array, w: jax.Array, *, stride=1, padding=0) -> jax.Array:
     y = lax.conv_general_dilated(
         x.astype(ct), w.astype(ct), window_strides=s, padding=pad,
         dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
-    return y.astype(jnp.float32)
+    return y.astype(activation_dtype())
 
 
 def row_conv(x: jax.Array, w: jax.Array) -> jax.Array:

@@ -29,9 +29,12 @@ def batch_norm(x: jax.Array, gamma: jax.Array, beta: jax.Array,
     """
     reduce_axes = tuple(range(x.ndim - 1))
     use_batch_stats = train and not (use_global_stats or False)
+    # stats always in f32: with bf16 activations (FLAGS.bf16_activations) a
+    # bf16 mean/var over N*H*W elements loses too many mantissa bits
+    x32 = x.astype(jnp.float32)
     if use_batch_stats:
-        mean = jnp.mean(x, axis=reduce_axes)
-        var = jnp.var(x, axis=reduce_axes)
+        mean = jnp.mean(x32, axis=reduce_axes)
+        var = jnp.var(x32, axis=reduce_axes)
         n = x.size // x.shape[-1]
         unbiased = var * (n / max(1, n - 1))
         new_mean = momentum * moving_mean + (1.0 - momentum) * mean
@@ -40,7 +43,7 @@ def batch_norm(x: jax.Array, gamma: jax.Array, beta: jax.Array,
         mean, var = moving_mean, moving_var
         new_mean, new_var = moving_mean, moving_var
     inv = jax.lax.rsqrt(var + eps)
-    y = (x - mean) * inv * gamma + beta
+    y = (x32 - mean) * inv * gamma + beta
     return y.astype(x.dtype), new_mean, new_var
 
 
@@ -55,13 +58,15 @@ def cross_map_norm(x: jax.Array, size: int = 5, scale: float = 1e-4,
                    power: float = 0.75) -> jax.Array:
     """Local response normalization across channels (reference:
     function/CrossMapNormalOp.cpp). x: [N,H,W,C]."""
-    sq = jnp.square(x)
+    # denominator in f32: bf16 activations would make the window-summed
+    # squares (and the pow) lossy; cast back to the input dtype at the end
+    sq = jnp.square(x.astype(jnp.float32))
     half = size // 2
     padded = jnp.pad(sq, ((0, 0), (0, 0), (0, 0), (half, size - 1 - half)))
     acc = jax.lax.reduce_window(padded, 0.0, jax.lax.add,
                                 (1, 1, 1, size), (1, 1, 1, 1), "VALID")
     denom = jnp.power(1.0 + scale * acc, power)
-    return x / denom
+    return (x.astype(jnp.float32) / denom).astype(x.dtype)
 
 
 def sum_to_one_norm(x: jax.Array, eps: float = 1e-12) -> jax.Array:

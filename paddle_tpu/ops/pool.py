@@ -36,16 +36,18 @@ def avg_pool2d(x: jax.Array, window: IntOr2, stride: IntOr2 = None,
     kh, kw = _pair(window)
     sh, sw = _pair(stride if stride is not None else window)
     ph, pw = _pair(padding)
+    # accumulate in f32: bf16 activations (FLAGS.bf16_activations) would lose
+    # mantissa bits summing kh*kw values; cast back to the input dtype after
     summed = lax.reduce_window(
-        x, 0.0, lax.add, (1, kh, kw, 1), (1, sh, sw, 1),
+        x.astype(jnp.float32), 0.0, lax.add, (1, kh, kw, 1), (1, sh, sw, 1),
         ((0, 0), (ph, ph), (pw, pw), (0, 0)))
     if exclude_padding and (ph or pw):
-        ones = jnp.ones(x.shape[:3] + (1,), dtype=x.dtype)
+        ones = jnp.ones(x.shape[:3] + (1,), jnp.float32)
         counts = lax.reduce_window(
             ones, 0.0, lax.add, (1, kh, kw, 1), (1, sh, sw, 1),
             ((0, 0), (ph, ph), (pw, pw), (0, 0)))
-        return summed / counts
-    return summed / float(kh * kw)
+        return (summed / counts).astype(x.dtype)
+    return (summed / float(kh * kw)).astype(x.dtype)
 
 
 def max_pool2d_with_index(x: jax.Array, window: IntOr2, stride: IntOr2 = None,
@@ -92,12 +94,15 @@ def spatial_pyramid_pool(x: jax.Array, pyramid_height: int,
                          constant_values=-jnp.inf)
             r = xp.reshape(n, bins, hh // bins, bins, ww // bins, c).max((2, 4))
         else:
-            xp = jnp.pad(x, ((0, 0), (0, hh - h), (0, ww - w), (0, 0)))
-            cnt = jnp.pad(jnp.ones((1, h, w, 1), x.dtype),
+            # accumulate bins in f32: bf16 activations would round away
+            # terms once the partial sum is large (same fix as avg_pool2d)
+            xp = jnp.pad(x.astype(jnp.float32),
+                         ((0, 0), (0, hh - h), (0, ww - w), (0, 0)))
+            cnt = jnp.pad(jnp.ones((1, h, w, 1), jnp.float32),
                           ((0, 0), (0, hh - h), (0, ww - w), (0, 0)))
             s = xp.reshape(n, bins, hh // bins, bins, ww // bins, c).sum((2, 4))
             d = cnt.reshape(1, bins, hh // bins, bins, ww // bins, 1).sum((2, 4))
-            r = s / d
+            r = (s / d).astype(x.dtype)
         outs.append(r.reshape(n, -1))
     return jnp.concatenate(outs, axis=-1)
 

@@ -34,16 +34,23 @@ class Clock:
 def test_register_assigns_smallest_free_slot():
     clk = Clock()
     svc = Service(time_fn=clk)
-    a, b, c = svc.register(), svc.register(), svc.register()
+    (a, tok_a), (b, _), (c, tok_c) = (svc.register(), svc.register(),
+                                      svc.register())
     assert (a, b, c) == (0, 1, 2)
     # b dies -> slot 1 frees after lease; next register reclaims it
     clk.t += 1.0
-    assert svc.heartbeat(0, ttl_s=1e6) and svc.heartbeat(2, ttl_s=1e6)
+    assert svc.heartbeat(0, tok_a, ttl_s=1e6)
+    assert svc.heartbeat(2, tok_c, ttl_s=1e6)
     clk.t += svc.lease_ttl_s  # b's lease lapses (0/2 renewed long)
-    assert svc.heartbeat(0, ttl_s=1e6) and svc.heartbeat(2, ttl_s=1e6)
+    assert svc.heartbeat(0, tok_a, ttl_s=1e6)
+    assert svc.heartbeat(2, tok_c, ttl_s=1e6)
     assert svc.members() == [0, 2]
-    assert svc.register() == 1
-    assert not svc.heartbeat(5), "unknown slot must not heartbeat"
+    slot, token = svc.register()
+    assert slot == 1
+    assert not svc.heartbeat(5, "bogus"), "unknown slot must not heartbeat"
+    # a stale token on a live slot must also be rejected
+    assert not svc.heartbeat(1, "stale-token")
+    assert svc.heartbeat(1, token)
 
 
 def test_dead_trainer_tasks_requeue_to_front(tmp_path):
@@ -53,8 +60,8 @@ def test_dead_trainer_tasks_requeue_to_front(tmp_path):
     recordio_write(p, [f"r{i}".encode() for i in range(8)])  # 4 tasks
     svc.set_dataset([p])
 
-    dead = svc.register(ttl_s=10.0)
-    live = svc.register(ttl_s=1e6)
+    dead, _ = svc.register(ttl_s=10.0)
+    live, _ = svc.register(ttl_s=1e6)
     t0 = svc.get_task(owner=dead)       # dead trainer holds task 0
     t1 = svc.get_task(owner=live)
     assert t0.id == 0 and t1.id == 1
