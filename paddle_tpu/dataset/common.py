@@ -34,9 +34,18 @@ def download(url: str, module_name: str, md5sum: str) -> str:
         return filename
     import urllib.request
 
-    urllib.request.urlretrieve(url, filename)
-    if md5sum and md5file(filename) != md5sum:
-        raise IOError(f"md5 mismatch for {url}")
+    # fetch to a temp name + atomic rename: an interrupted transfer must
+    # never leave a truncated file that a later call (especially one with
+    # no md5, e.g. sentiment) would trust as a valid cache hit
+    part = filename + ".part"
+    try:
+        urllib.request.urlretrieve(url, part)
+        if md5sum and md5file(part) != md5sum:
+            raise IOError(f"md5 mismatch for {url}")
+        os.replace(part, filename)
+    finally:
+        if os.path.exists(part):
+            os.remove(part)
     return filename
 
 

@@ -1,0 +1,185 @@
+"""Image preprocessing utilities.
+
+Reference: python/paddle/v2/image.py:1-60 (load/resize/crop/flip/chw
+pipelines used by the image demos — flowers, VOC, model-zoo resnet).
+
+TPU twist: the native layout here is **HWC** (and NHWC for batches) because
+that is the layout XLA tiles best onto the MXU (ops/conv.py); ``to_chw``
+exists for reference-format compatibility (the v2 API fed CHW-major flat
+vectors). Decoding prefers cv2 (BGR, like the reference) and falls back to
+PIL (RGB) so the module works wherever either is installed.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+try:
+    import cv2
+except Exception:  # pragma: no cover - env without opencv
+    cv2 = None
+
+__all__ = [
+    "load_image_bytes", "load_image", "resize_short", "to_chw", "to_hwc",
+    "center_crop", "random_crop", "left_right_flip", "simple_transform",
+    "load_and_transform", "batch_images_from_tar",
+]
+
+
+def channel_order() -> str:
+    """Channel order produced by load_image_bytes: cv2 decodes BGR, the PIL
+    fallback RGB. Callers applying per-channel constants (means) must match."""
+    return "BGR" if cv2 is not None else "RGB"
+
+
+def load_image_bytes(data: bytes, is_color: bool = True) -> np.ndarray:
+    """Decode an image from raw bytes -> HWC uint8 (HW if gray)."""
+    if cv2 is not None:
+        flag = 1 if is_color else 0
+        arr = np.frombuffer(data, np.uint8)
+        img = cv2.imdecode(arr, flag)
+        if img is None:
+            raise IOError("cv2 could not decode image bytes")
+        return img
+    import io
+
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(data))
+    img = img.convert("RGB" if is_color else "L")
+    return np.asarray(img)
+
+
+def load_image(path: str, is_color: bool = True) -> np.ndarray:
+    with open(path, "rb") as f:
+        return load_image_bytes(f.read(), is_color)
+
+
+def _resize(im: np.ndarray, w: int, h: int) -> np.ndarray:
+    if cv2 is not None:
+        return cv2.resize(im, (w, h), interpolation=cv2.INTER_LANCZOS4)
+    from PIL import Image
+
+    mode = "L" if im.ndim == 2 else "RGB"
+    return np.asarray(Image.fromarray(im, mode).resize((w, h), Image.LANCZOS))
+
+
+def resize_short(im: np.ndarray, size: int) -> np.ndarray:
+    """Resize so the SHORT edge equals ``size``, keeping aspect ratio."""
+    h, w = im.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(round(h * size / w))
+    else:
+        new_w, new_h = int(round(w * size / h)), size
+    return _resize(im, new_w, new_h)
+
+
+def to_chw(im: np.ndarray, order=(2, 0, 1)) -> np.ndarray:
+    """HWC -> CHW (the reference's storage layout)."""
+    assert im.ndim == len(order)
+    return im.transpose(order)
+
+
+def to_hwc(im: np.ndarray) -> np.ndarray:
+    """CHW -> HWC (the TPU-native layout)."""
+    assert im.ndim == 3
+    return im.transpose(1, 2, 0)
+
+
+def center_crop(im: np.ndarray, size: int, is_color: bool = True) -> np.ndarray:
+    h, w = im.shape[:2]
+    h0, w0 = (h - size) // 2, (w - size) // 2
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def random_crop(im: np.ndarray, size: int, is_color: bool = True,
+                rng: Optional[np.random.RandomState] = None) -> np.ndarray:
+    rng = rng or np.random
+    h, w = im.shape[:2]
+    h0 = rng.randint(0, h - size + 1)
+    w0 = rng.randint(0, w - size + 1)
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def left_right_flip(im: np.ndarray) -> np.ndarray:
+    return im[:, ::-1]
+
+
+def simple_transform(im: np.ndarray, resize_size: int, crop_size: int,
+                     is_train: bool, is_color: bool = True,
+                     mean: Optional[np.ndarray] = None,
+                     layout: str = "HWC",
+                     rng: Optional[np.random.RandomState] = None) -> np.ndarray:
+    """resize_short -> (random|center) crop -> [flip] -> float32 [-mean].
+
+    ``layout``: "HWC" (TPU-native, default) or "CHW" (reference-compatible).
+    """
+    rng = rng or np.random
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, rng=rng)
+        if rng.randint(2) == 0:
+            im = left_right_flip(im)
+    else:
+        im = center_crop(im, crop_size)
+    im = im.astype(np.float32)
+    if mean is not None:
+        mean = np.asarray(mean, np.float32)
+        # per-pixel mean comes in the requested layout; per-channel applies
+        # to the last (HWC) axis before any transpose
+        if mean.ndim == 1 and im.ndim == 3:
+            im -= mean.reshape(1, 1, -1)
+        else:
+            im -= mean
+    if layout == "CHW" and im.ndim == 3:
+        im = to_chw(im)
+    return im
+
+
+def load_and_transform(path: str, resize_size: int, crop_size: int,
+                       is_train: bool, is_color: bool = True,
+                       mean=None, layout: str = "HWC") -> np.ndarray:
+    return simple_transform(load_image(path, is_color), resize_size,
+                            crop_size, is_train, is_color, mean, layout)
+
+
+def batch_images_from_tar(data_file: str, dataset_name: str,
+                          img2label: Dict[str, int],
+                          num_per_batch: int = 1024) -> str:
+    """Pack raw images from a tar into pickled batch files; returns the meta
+    list file (reference: image.py batch_images_from_tar)."""
+    batch_dir = data_file + "_batch"
+    out_path = os.path.join(batch_dir, dataset_name)
+    meta_file = os.path.join(batch_dir, dataset_name + ".txt")
+    if os.path.exists(out_path):
+        return meta_file
+    os.makedirs(out_path)
+
+    data, labels, file_id = [], [], 0
+
+    def dump():
+        nonlocal data, labels, file_id
+        with open(os.path.join(out_path, f"batch_{file_id}"), "wb") as f:
+            pickle.dump({"label": labels, "data": data}, f,
+                        protocol=pickle.HIGHEST_PROTOCOL)
+        file_id += 1
+        data, labels = [], []
+
+    with tarfile.open(data_file) as tf:
+        for mem in tf.getmembers():
+            if mem.name in img2label:
+                data.append(tf.extractfile(mem).read())
+                labels.append(img2label[mem.name])
+                if len(data) == num_per_batch:
+                    dump()
+    if data:
+        dump()
+    with open(meta_file, "a") as meta:
+        for fname in sorted(os.listdir(out_path)):
+            meta.write(os.path.abspath(os.path.join(out_path, fname)) + "\n")
+    return meta_file

@@ -1,0 +1,361 @@
+"""Dataset pipeline tests: the REAL parse paths exercised on fabricated
+fixture archives (no egress in CI), plus the offline synthetic fallbacks.
+
+Mirrors the reference's approach of bundling mini-datasets for trainer
+tests (paddle/trainer/tests/mnist_bin_part etc.): each test builds a tiny
+archive in the reference's on-disk format and runs the same parser the
+download path uses.
+"""
+
+import gzip
+import io
+import os
+import re
+import socket
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu import image as pimage
+from paddle_tpu.dataset import (common, conll05, flowers, imdb, imikolov,
+                                movielens, mq2007, sentiment, voc2012, wmt14)
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+
+def _add_bytes(tf, name, data):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tf.addfile(info, io.BytesIO(data))
+
+
+def _png_bytes(arr):
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def _jpg_bytes(arr):
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# imdb
+# ---------------------------------------------------------------------------
+
+
+def _imdb_tar(tmp_path):
+    path = str(tmp_path / "aclImdb.tar.gz")
+    docs = {
+        "aclImdb/train/pos/0_9.txt": b"A great, GREAT movie!",
+        "aclImdb/train/pos/1_8.txt": b"great fun; truly great",
+        "aclImdb/train/neg/0_2.txt": b"terrible movie. boring",
+        "aclImdb/train/neg/1_1.txt": b"boring and terrible...",
+        "aclImdb/test/pos/0_10.txt": b"great",
+        "aclImdb/test/neg/0_1.txt": b"terrible",
+    }
+    with tarfile.open(path, "w:gz") as tf:
+        for name, data in docs.items():
+            _add_bytes(tf, name, data)
+    return path
+
+
+def test_imdb_tokenize_and_dict(tmp_path):
+    tar = _imdb_tar(tmp_path)
+    docs = list(imdb.tokenize(re.compile(r"aclImdb/train/pos/.*\.txt$"),
+                              tar_path=tar))
+    assert docs[0] == ["a", "great", "great", "movie"]  # punctuation stripped
+    d = imdb.build_dict(re.compile(r"aclImdb/train/.*\.txt$"), cutoff=1,
+                        tar_path=tar)
+    # freq: great=4; boring/movie/terrible=2 -> alphabetical tiebreak
+    assert list(d)[:4] == ["great", "boring", "movie", "terrible"]
+    assert d["<unk>"] == len(d) - 1
+
+
+def test_imdb_reader_interleaves_labels(tmp_path):
+    tar = _imdb_tar(tmp_path)
+    d = imdb.build_dict(re.compile(r"aclImdb/train/.*\.txt$"), 0, tar_path=tar)
+    samples = list(imdb._real_reader(r"aclImdb/train/pos/.*\.txt$",
+                                     r"aclImdb/train/neg/.*\.txt$", d,
+                                     tar_path=tar)())
+    assert [lab for _, lab in samples] == [0, 1, 0, 1]  # pos=0 neg=1
+    assert all(isinstance(ids, list) and ids for ids, _ in samples)
+
+
+# ---------------------------------------------------------------------------
+# imikolov (PTB)
+# ---------------------------------------------------------------------------
+
+
+def test_imikolov_parse_ngram_and_seq():
+    word_idx = imikolov.build_dict_from_files(
+        [b"the cat sat", b"the dog sat"], [b"the cat ran"], min_word_freq=0)
+    # freq: the=3,<s>=3,<e>=3 sat=2 cat=2 dog=1 ran=1 -> alphabetic ties
+    assert word_idx["<unk>"] == len(word_idx) - 1
+    grams = list(imikolov.parse_lines([b"the cat sat"], word_idx, 2,
+                                      imikolov.DataType.NGRAM))
+    # <s> the cat sat <e> -> 4 bigrams
+    assert len(grams) == 4 and all(len(g) == 2 for g in grams)
+    seqs = list(imikolov.parse_lines([b"the cat sat"], word_idx, 0,
+                                     imikolov.DataType.SEQ))
+    src, trg = seqs[0]
+    assert src[0] == word_idx["<s>"] and trg[-1] == word_idx["<e>"]
+    assert src[1:] == trg[:-1]
+
+
+# ---------------------------------------------------------------------------
+# wmt14
+# ---------------------------------------------------------------------------
+
+
+def _wmt_tar(tmp_path):
+    path = str(tmp_path / "wmt14.tgz")
+    src_dict = b"<s>\n<e>\n<unk>\nle\nchat\n"
+    trg_dict = b"<s>\n<e>\n<unk>\nthe\ncat\n"
+    train = b"le chat\tthe cat\nle inconnu\tthe cat\n"
+    with tarfile.open(path, "w:gz") as tf:
+        _add_bytes(tf, "wmt14/train/src.dict", src_dict)
+        _add_bytes(tf, "wmt14/train/trg.dict", trg_dict)
+        _add_bytes(tf, "wmt14/train/train", train)
+    return path
+
+
+def test_wmt14_parse(tmp_path):
+    tar = _wmt_tar(tmp_path)
+    src_d, trg_d = wmt14.read_dicts_from_tar(tar, 30000)
+    assert src_d["chat"] == 4 and trg_d["cat"] == 4
+    with tarfile.open(tar) as f:
+        lines = list(f.extractfile("wmt14/train/train"))
+    samples = list(wmt14.parse_lines(lines, src_d, trg_d))
+    src_ids, trg_ids, trg_next = samples[0]
+    assert src_ids == [0, 3, 4, 1]           # <s> le chat <e>
+    assert trg_ids == [0, 3, 4]              # <s> the cat
+    assert trg_next == [3, 4, 1]             # the cat <e>
+    # unknown source word -> UNK_IDX
+    assert samples[1][0] == [0, 3, wmt14.UNK_IDX, 1]
+
+
+# ---------------------------------------------------------------------------
+# conll05
+# ---------------------------------------------------------------------------
+
+
+def test_conll05_props_to_bio_and_sample():
+    words = [b"He", b"ate", b"rice", b""]
+    props = [b"-  *", b"eat  (V*)", b"-  (A1*)", b""]
+    # column-major: verbs column ['-','eat','-'], one arg layer
+    out = list(conll05.corpus_reader(words, props))
+    assert len(out) == 1
+    sentence, verb, tags = out[0]
+    assert sentence == ["He", "ate", "rice"]
+    assert verb == "eat"
+    assert tags == ["O", "B-V", "B-A1"]
+
+    wd = {"He": 1, "ate": 2, "rice": 3, "bos": 4, "eos": 5}
+    vd = {"eat": 0}
+    ld = {"O": 0, "B-V": 1, "B-A1": 2}
+    sample = conll05.make_sample(sentence, verb, tags, wd, vd, ld)
+    word_ids, n2, n1, c0, p1, p2, pred, mark, labels = sample
+    assert word_ids == [1, 2, 3]
+    assert c0 == [2, 2, 2]            # predicate word broadcast
+    assert n1 == [1, 1, 1] and n2 == [wd["bos"]] * 3
+    assert p1 == [3, 3, 3] and p2 == [wd["eos"]] * 3
+    assert mark == [1, 1, 1]          # +-2 window covers all 3 tokens
+    assert labels == [0, 1, 2]
+
+
+def test_conll05_multi_predicate_bracket_span():
+    cols = [["-", "run", "-", "jump"],
+            ["(A0*", "*", "*)", "*"],      # spans tokens 0-2
+            ["*", "(A1*)", "*", "(V*)"]]
+    out = list(conll05.props_to_bio(cols))
+    assert out[0] == ("run", ["B-A0", "I-A0", "I-A0", "O"])
+    assert out[1] == ("jump", ["O", "B-A1", "O", "B-V"])
+
+
+# ---------------------------------------------------------------------------
+# movielens
+# ---------------------------------------------------------------------------
+
+
+def test_movielens_parsers():
+    movies = movielens.parse_movies(
+        [b"1::Toy Story (1995)::Animation|Comedy",
+         b"2::Jumanji (1995)::Adventure"])
+    assert movies[1].title == "Toy Story"
+    assert movies[1].categories == ["Animation", "Comedy"]
+    users = movielens.parse_users([b"1::F::1::10::48067",
+                                   b"2::M::56::16::70072"])
+    assert users[1].is_male is False and users[1].age == 0
+    assert users[2].age == movielens.AGE_TABLE.index(56)
+    assert users[2].value() == [2, 0, 6, 16]
+
+
+# ---------------------------------------------------------------------------
+# mq2007
+# ---------------------------------------------------------------------------
+
+
+def _letor_line(rel, qid, seed):
+    rng = np.random.RandomState(seed)
+    feats = " ".join(f"{i + 1}:{rng.rand():.6f}"
+                     for i in range(mq2007.FEATURE_DIM))
+    return f"{rel} qid:{qid} {feats} #docid = G{qid}-{seed}"
+
+
+def test_mq2007_letor_parse_and_generators():
+    lines = [_letor_line(2, 10, 1), _letor_line(0, 10, 2),
+             _letor_line(1, 10, 3), _letor_line(1, 20, 4),
+             _letor_line(0, 20, 5)]
+    parsed = mq2007.parse_letor_line(lines[0])
+    assert parsed is not None
+    rel, qid, feats = parsed
+    assert (rel, qid) == (2, 10) and feats.shape == (46,)
+
+    groups = list(mq2007.group_by_query(lines))
+    assert [len(g) for g in groups] == [3, 2]
+    assert [r for r, _ in groups[0]] == [2, 1, 0]  # best-first
+    pairs = list(mq2007.gen_pair(groups[0]))
+    assert len(pairs) == 3                          # C(3,2), all ordered
+    points = list(mq2007.gen_point(groups[1]))
+    assert [p[0] for p in points] == [1, 0]
+    assert mq2007.parse_letor_line("# comment only") is None
+    assert mq2007.parse_letor_line("1 qid:3 1:0.5") is None  # wrong arity
+
+
+def test_mq2007_synthetic_fallback_shapes():
+    sample = next(iter(mq2007.train(format="pairwise")()))
+    assert sample[0].shape == (46,) and sample[2] == 1.0
+    group = next(iter(mq2007.train(format="listwise")()))
+    assert all(f.shape == (46,) for _, f in group)
+
+
+# ---------------------------------------------------------------------------
+# sentiment
+# ---------------------------------------------------------------------------
+
+
+def _reviews_zip(tmp_path):
+    path = str(tmp_path / "movie_reviews.zip")
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr("movie_reviews/neg/cv000.txt", "bad awful bad")
+        z.writestr("movie_reviews/neg/cv001.txt", "awful")
+        z.writestr("movie_reviews/pos/cv000.txt", "good nice good")
+        z.writestr("movie_reviews/pos/cv001.txt", "nice")
+    return path
+
+
+def test_sentiment_zip_parse(tmp_path):
+    path = _reviews_zip(tmp_path)
+    docs = list(sentiment.iter_documents(path))
+    assert [lab for _, lab in docs] == [0, 1, 0, 1]  # neg/pos interleaved
+    d = sentiment.build_word_dict(path)
+    # freq: bad=2,good=2 (alpha ties), awful=2, nice=2
+    assert set(list(d)[:4]) == {"awful", "bad", "good", "nice"}
+    assert docs[0][0] == ["bad", "awful", "bad"]
+
+
+# ---------------------------------------------------------------------------
+# flowers / voc2012 / image utils
+# ---------------------------------------------------------------------------
+
+
+def test_flowers_real_parse(tmp_path):
+    import scipy.io as scio
+
+    rng = np.random.RandomState(0)
+    tar_path = str(tmp_path / "102flowers.tgz")
+    with tarfile.open(tar_path, "w:gz") as tf:
+        for i in (1, 2, 3):
+            img = (rng.rand(40, 52, 3) * 255).astype(np.uint8)
+            _add_bytes(tf, f"jpg/image_{i:05d}.jpg", _jpg_bytes(img))
+    label_mat = str(tmp_path / "imagelabels.mat")
+    setid_mat = str(tmp_path / "setid.mat")
+    scio.savemat(label_mat, {"labels": np.array([[5, 7, 9]])})
+    scio.savemat(setid_mat, {"tstid": np.array([[1, 3]]),
+                             "trnid": np.array([[2]])})
+
+    img2label = flowers.split_img2label(label_mat, setid_mat, "tstid")
+    assert img2label == {"jpg/image_00001.jpg": 5, "jpg/image_00003.jpg": 9}
+
+    reader = flowers._reader_creator(
+        tar_path, label_mat, setid_mat, "tstid",
+        flowers.test_mapper, use_xmap=False)
+    samples = list(reader())
+    assert len(samples) == 2
+    img, label = samples[0]
+    assert img.shape == (224 * 224 * 3,) and label == 4  # 0-based
+
+
+def test_voc2012_real_parse(tmp_path):
+    rng = np.random.RandomState(1)
+    tar_path = str(tmp_path / "voc.tar")
+    img = (rng.rand(24, 32, 3) * 255).astype(np.uint8)
+    seg = rng.randint(0, 21, (24, 32)).astype(np.uint8)
+    with tarfile.open(tar_path, "w") as tf:
+        _add_bytes(tf, voc2012.SET_FILE.format("train"), b"img0\n")
+        _add_bytes(tf, voc2012.DATA_FILE.format("img0"), _jpg_bytes(img))
+        _add_bytes(tf, voc2012.LABEL_FILE.format("img0"), _png_bytes(seg))
+    samples = list(voc2012.reader_creator(tar_path, "train")())
+    assert len(samples) == 1
+    got_img, got_seg = samples[0]
+    assert got_img.shape == (24, 32, 3)
+    np.testing.assert_array_equal(got_seg, seg)  # png is lossless
+
+
+def test_image_transform_pipeline():
+    rng = np.random.RandomState(2)
+    im = (rng.rand(60, 80, 3) * 255).astype(np.uint8)
+    short = pimage.resize_short(im, 30)
+    assert min(short.shape[:2]) == 30 and short.shape[1] == 40
+    crop = pimage.center_crop(short, 24)
+    assert crop.shape[:2] == (24, 24)
+    flipped = pimage.left_right_flip(crop)
+    np.testing.assert_array_equal(flipped[:, 0], crop[:, -1])
+    chw = pimage.to_chw(crop)
+    assert chw.shape == (3, 24, 24)
+    np.testing.assert_array_equal(pimage.to_hwc(chw), crop)
+    out = pimage.simple_transform(im, 32, 24, is_train=False,
+                                  mean=[1.0, 2.0, 3.0])
+    assert out.shape == (24, 24, 3) and out.dtype == np.float32
+    out_chw = pimage.simple_transform(im, 32, 24, is_train=False,
+                                      layout="CHW")
+    assert out_chw.shape == (3, 24, 24)
+    # decode round-trip (png lossless)
+    decoded = pimage.load_image_bytes(_png_bytes(im))
+    assert decoded.shape == im.shape
+
+
+# ---------------------------------------------------------------------------
+# download smoke test — runs only when the environment has egress
+# ---------------------------------------------------------------------------
+
+
+def _has_egress(host="storage.googleapis.com", timeout=3.0):
+    try:
+        socket.create_connection((host, 80), timeout=timeout).close()
+        return True
+    except OSError:
+        return False
+
+
+@pytest.mark.skipif(not _has_egress(), reason="no network egress")
+def test_download_smoke(tmp_path, monkeypatch):
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path))
+    from paddle_tpu.dataset import mnist
+
+    path = common.download(mnist.URL_PREFIX + mnist.TEST_LABEL[0], "mnist",
+                           mnist.TEST_LABEL[1])
+    assert os.path.exists(path)
+    assert common.md5file(path) == mnist.TEST_LABEL[1]
