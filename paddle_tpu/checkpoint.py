@@ -98,6 +98,20 @@ def latest_pass(root: str) -> Optional[int]:
     return best
 
 
+def prune_checkpoints(root: str, keep: int = 2) -> None:
+    """Delete all but the ``keep`` newest checkpoints. Crash-resume only
+    needs the latest; one older is kept as insurance while the newest is
+    young (the Go pserver similarly overwrites its single checkpoint)."""
+    import shutil
+
+    if not os.path.isdir(root):
+        return
+    ids = sorted(int(m.group(1)) for name in os.listdir(root)
+                 if (m := _PASS_RE.match(name)))
+    for pid in ids[:-keep] if keep > 0 else ids:
+        shutil.rmtree(pass_dir(root, pid), ignore_errors=True)
+
+
 def load_checkpoint(root: str, pass_id: Optional[int] = None
                     ) -> Tuple[Parameters, Any, Any, Dict]:
     """Returns (parameters, opt_state, model_state, meta). Verifies md5

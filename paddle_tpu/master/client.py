@@ -80,6 +80,37 @@ class MasterClient:
                 return None
         return self._records.pop(0)
 
+    def try_next_task(self):
+        """ONE non-blocking task-fetch attempt with NO implicit ack —
+        the elastic trainer acks explicitly (ack_task) only after the
+        covering checkpoint is durable, so a crash never acks unapplied
+        work. Returns:
+
+        - ("task", (task_id, epoch, records)) — a task to process;
+        - ("empty", None) — nothing available NOW (other trainers hold
+          pending tasks, or the caller itself holds unacked ones);
+        - ("done", None)  — the pass is fully consumed.
+        """
+        task = self._t.call("get_task", owner=self._slot)
+        if task is None:
+            return (("done" if self._t.call("all_done") else "empty"), None)
+        recs: List[bytes] = []
+        try:
+            for c in task["chunks"]:
+                got = recordio_read_chunk(c["path"], c["offset"], c["count"])
+                recs.extend(g if isinstance(g, bytes) else bytes(g)
+                            for g in got)
+        except OSError:
+            self._t.call("task_failed", task_id=task["id"])
+            return ("empty", None)
+        return ("task", (task["id"], task.get("epoch", 0), recs))
+
+    def ack_task(self, task_id: int) -> None:
+        """Report a task finished (explicit-ack path of try_next_task)."""
+        self._t.call("task_finished", task_id=task_id)
+        if self._task_id == task_id:
+            self._task_id = None
+
     def task_failed(self) -> None:
         """Report the in-flight task failed (fault-injection / error paths)."""
         if self._task_id is not None:

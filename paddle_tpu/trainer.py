@@ -160,14 +160,37 @@ class SGD:
     # public API (reference: v2 trainer.py)
     # ------------------------------------------------------------------
 
-    def train(self, reader, num_passes: int = 1, event_handler=None,
+    def train(self, reader=None, num_passes: int = 1, event_handler=None,
               feeding=None, test_reader=None, save_dir: Optional[str] = None,
-              start_pass: int = 0, saving_period: int = 1) -> None:
+              start_pass: int = 0, saving_period: int = 1, master=None,
+              record_parser=None, heartbeat_ttl_s: Optional[float] = None
+              ) -> None:
         """``save_dir``/``start_pass``/``saving_period`` are the
         --save_dir/--start_pass/--saving_period flags of the reference
         trainer (ParamUtil.h:77-111): checkpoints (params + optimizer
         state) land in save_dir/pass-%05d every ``saving_period`` passes,
-        and ``start_pass`` resumes from an existing one if present."""
+        and ``start_pass`` resumes from an existing one if present.
+
+        With ``master=MasterClient(...)`` training is elastic/task-driven
+        instead of reader-driven (reference: cloud_reader + etcd
+        registration, go/pserver/etcd_client.go:67-166): batches come from
+        master tasks (``record_parser`` maps each record's bytes to a
+        sample tuple), the lease is heartbeat per batch, and a lapsed
+        lease triggers re-register + auto-resume from the latest
+        checkpoint in ``save_dir``."""
+        if master is not None:
+            enforce_that(record_parser is not None,
+                         "master= training needs record_parser=",
+                         context="trainer")
+            enforce_that(start_pass == 0, "start_pass is reader-path only; "
+                         "elastic training resumes from save_dir "
+                         "automatically", context="trainer")
+            return self._train_elastic(master, record_parser, num_passes,
+                                       event_handler, feeding, save_dir,
+                                       heartbeat_ttl_s, saving_period,
+                                       test_reader)
+        enforce_that(reader is not None, "train() needs a reader "
+                     "(or master=)", context="trainer")
         if event_handler is None:
             event_handler = _default_event_handler
         feeder = self._make_feeder(feeding)
@@ -260,6 +283,189 @@ class SGD:
         self.parameters.update_from(params)
         self.opt_state = opt_state
         self.model_state = mstate
+
+    def _train_elastic(self, master, record_parser, num_passes: int,
+                       event_handler, feeding, save_dir: Optional[str],
+                       ttl_s: Optional[float], saving_period: int,
+                       test_reader) -> None:
+        """Task-driven elastic training (the kill/resume e2e productized).
+
+        One SGD step per master task; the step counter (== applied task
+        count along this trainer lineage) drives the rng stream and is
+        persisted in checkpoint meta, so a replacement trainer resumes
+        the SAME stream — final params equal an uninterrupted run (the
+        test_TrainerOnePass determinism bar extended to the crash path;
+        single-lineage guarantee — with several concurrent trainers a
+        requeued task may be re-run by a peer, the reference's async
+        tolerance).
+
+        Ack protocol: tasks are acked ONLY after a checkpoint covering
+        them is durable (``saving_period`` = tasks per checkpoint; every
+        task when save_dir is unset). The checkpoint meta records the
+        covered-but-possibly-unacked (task_id, epoch) set plus the
+        in-progress pass and next rng step, so a crash in ANY window —
+        before the step, or after the checkpoint but before the acks —
+        resumes without losing or double-applying a task. Old
+        checkpoints are pruned (crash-resume only needs the latest; the
+        previous one is kept as insurance while the newest is young).
+        """
+        import time as _time
+
+        from paddle_tpu import checkpoint as ckpt
+
+        if event_handler is None:
+            event_handler = _default_event_handler
+        feeder = self._make_feeder(feeding)
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        log = plog.logger()
+        saving_period = max(1, int(saving_period))
+
+        def resume_state():
+            """-> (next_step, skip_set, pass_id, next_ckpt_id)."""
+            latest = ckpt.latest_pass(save_dir) if save_dir else None
+            if latest is None:
+                return 0, set(), 0, 0
+            p, opt, mst, meta = ckpt.load_checkpoint(save_dir)
+            self.parameters.update_from(p.as_dict())
+            if opt is not None:
+                self.opt_state = opt
+            if mst is not None:
+                self.model_state = mst
+            log.info("elastic: resumed from checkpoint %d (pass %d, "
+                     "next step %d)", latest, meta.get("pass_id", 0),
+                     meta.get("next_step", latest + 1))
+            skip = {(tid, meta.get("epoch", 0))
+                    for tid in meta.get("task_ids", [])}
+            return (meta.get("next_step", latest + 1), skip,
+                    meta.get("pass_id", 0), latest + 1)
+
+        if getattr(master, "_slot", None) is None:
+            master.register(ttl_s=ttl_s)
+        step, skip_set, pass_id, ck_id = resume_state()
+
+        params = self.parameters.as_dict()
+        opt_state = self.opt_state
+        mstate = self.model_state
+        unacked: List[int] = []
+
+        def sync_back():
+            self.parameters.update_from(params)
+            self.opt_state = opt_state
+            self.model_state = mstate
+
+        def flush(meta_pass: int, epoch: int) -> None:
+            """Checkpoint the current state, then ack everything the
+            checkpoint covers. Ack strictly AFTER the write: the reverse
+            order could lose acked-but-not-durable updates."""
+            nonlocal ck_id
+            if save_dir is not None:
+                sync_back()
+                ckpt.save_checkpoint(
+                    save_dir, ck_id, self.parameters,
+                    opt_state=self.opt_state, model_state=self.model_state,
+                    extra_meta={"next_step": step, "pass_id": meta_pass,
+                                "epoch": epoch, "task_ids": list(unacked)})
+                ckpt.prune_checkpoints(save_dir, keep=2)
+                ck_id += 1
+            for tid in unacked:
+                master.ack_task(tid)
+            unacked.clear()
+
+        while pass_id < num_passes:
+            master.begin_pass()
+            event_handler(v2_event.BeginPass(pass_id))
+            pending_costs: List = []
+            batch_id = 0
+            epoch = 0
+            rejoined = False
+            resumed_acks = False
+            while True:
+                if not master.heartbeat(ttl_s=ttl_s):
+                    # declared dead (long GC/preemption): durable state is
+                    # required to rejoin — silently restarting the rng
+                    # stream from scratch would corrupt training
+                    enforce_that(save_dir is not None,
+                                 "elastic lease lost with no save_dir: "
+                                 "cannot resume; pass save_dir= to "
+                                 "train(master=...)", context="trainer")
+                    log.info("elastic: lease lost, re-registering")
+                    master.register(ttl_s=ttl_s)
+                    unacked.clear()
+                    step, skip_set, pass_id, ck_id = resume_state()
+                    params = self.parameters.as_dict()
+                    opt_state = self.opt_state
+                    mstate = self.model_state
+                    rejoined = True
+                    break
+                status, got = master.try_next_task()
+                if status == "done":
+                    if resumed_acks and batch_id == 0:
+                        # the only thing this pass did was ack stale tasks
+                        # from the PREVIOUS pass (crash at a pass
+                        # boundary): the queue just drained, so recycle it
+                        # and actually train this pass
+                        master.begin_pass()
+                        resumed_acks = False
+                        continue
+                    break
+                if status == "empty":
+                    # possibly blocked on our own unacked tasks: flush
+                    if unacked:
+                        flush(pass_id, epoch)
+                    else:
+                        _time.sleep(master._poll)
+                    continue
+                task_id, epoch, records = got
+                if skip_set:
+                    if (task_id, epoch) in skip_set:
+                        # already applied inside the restored checkpoint
+                        # (crash hit between write and ack): ack, skip
+                        skip_set.discard((task_id, epoch))
+                        log.info("elastic: task %d already in checkpoint, "
+                                 "skipping", task_id)
+                        master.ack_task(task_id)
+                        resumed_acks = True
+                        continue
+                    # requeued tasks come back FIRST; a non-match means
+                    # the remaining skip entries are stale
+                    skip_set.clear()
+                batch = [record_parser(r) for r in records]
+                event_handler(v2_event.BeginIteration(pass_id, batch_id))
+                feeds = self._shard_feeds(feeder.feed(batch))
+                with stats.timer("trainOneBatch"):
+                    loss, params, opt_state, mstate, metric_vals = \
+                        self._step_fn(params, opt_state, mstate,
+                                      jax.random.PRNGKey(step), feeds)
+                metric_vals.pop("__param_stats__", None)
+                step += 1
+                unacked.append(task_id)
+                if len(unacked) >= saving_period:
+                    flush(pass_id, epoch)
+                batch_id += 1
+                pending_costs.append(loss)  # device scalar, no sync
+                event_handler(v2_event.EndIteration(pass_id, batch_id - 1,
+                                                    loss, metric_vals))
+                if FLAGS.log_period and batch_id % FLAGS.log_period == 0:
+                    window = pending_costs[-FLAGS.log_period:]
+                    log.info("Elastic pass %d, Batch %d, Cost %.5f", pass_id,
+                             batch_id - 1,
+                             float(np.mean(np.asarray(jnp.stack(window)))))
+            if rejoined:
+                continue  # restart the (possibly different) resumed pass
+            # pass complete: flush leftovers, mark the NEXT pass durable so
+            # a crash right here doesn't re-run this pass on resume
+            pass_id += 1
+            flush(pass_id, epoch)
+            sync_back()
+            if test_reader is not None:
+                tr = self.test(test_reader, feeding)
+                event_handler(v2_event.EndPass(pass_id - 1, tr.metrics,
+                                               self.parameters))
+            else:
+                event_handler(v2_event.EndPass(pass_id - 1, {},
+                                               self.parameters))
+        sync_back()
 
     def test(self, reader, feeding=None) -> v2_event.TestResult:
         feeder = self._make_feeder(feeding)

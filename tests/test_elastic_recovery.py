@@ -116,100 +116,140 @@ def _parse(rec):
             int(label))
 
 
-def _train_tasks(sgd, client, max_tasks=None,
-                 save_dir=None, die_after=None):
-    """Consume master tasks; one SGD step per task-chunk batch. Returns
-    the number of tasks completed. ``die_after`` stops WITHOUT reporting
-    task_finished (the crash)."""
-    import jax
+class _Crash(Exception):
+    """Injected trainer crash (fault injection, go/master
+    service_internal_test.go style)."""
 
-    done = 0
-    while True:
-        if max_tasks is not None and done >= max_tasks:
-            return done
-        if not client._fetch_task():
-            return done
-        batch = [_parse(r) for r in client._records]
-        client._records = []
-        if die_after is not None and done >= die_after:
-            return done  # crash: in-flight task never reported
-        feeder = sgd._make_feeder(None)
-        feeds = feeder.feed(batch)
-        if sgd._step_fn is None:
-            sgd._step_fn = sgd._build_step()
-        p = sgd.parameters.as_dict()
-        loss, p, sgd.opt_state, sgd.model_state, _ = sgd._step_fn(
-            p, sgd.opt_state, sgd.model_state, jax.random.PRNGKey(done),
-            feeds)
-        sgd.parameters.update_from(p)
-        done += 1
-        if save_dir is not None:
-            sgd.save_checkpoint(save_dir, done - 1)
+
+def _crash_at(event_type, batch_id):
+    """Event handler that raises when the given event fires."""
+    def handler(ev):
+        if isinstance(ev, event_type) and ev.batch_id == batch_id:
+            raise _Crash()
+
+    return handler
+
+
+def _run_straight(svc, num_passes=1):
+    """One trainer, whole pass(es), public API; returns final params."""
+    c = MasterClient(service=svc)
+    sgd = _make_sgd()
+    sgd.train(master=c, record_parser=_parse, num_passes=num_passes,
+              heartbeat_ttl_s=1e9)
+    return {k: np.asarray(sgd.parameters[k]) for k in sgd.parameters.names()}
+
+
+def _crash_resume_case(tmp_path, clk, svc, crash_event, crash_batch,
+                       num_passes=1, saving_period=1, tag=""):
+    """Trainer A crashes at the given event; lease lapses; trainer B
+    resumes from checkpoint via the SAME public entry point."""
+    ck_dir = str(tmp_path /
+                 f"ckpt_{crash_event.__name__}_{crash_batch}_{tag}")
+    sgd_a = _make_sgd()
+    with np.testing.assert_raises(_Crash):
+        sgd_a.train(master=MasterClient(service=svc), record_parser=_parse,
+                    num_passes=num_passes, save_dir=ck_dir,
+                    heartbeat_ttl_s=10.0, saving_period=saving_period,
+                    event_handler=_crash_at(crash_event, crash_batch))
+
+    clk.t += 11.0   # A's lease lapses -> its in-flight task refronts
+
+    sgd_b = _make_sgd()
+    sgd_b.train(master=MasterClient(service=svc), record_parser=_parse,
+                num_passes=num_passes, save_dir=ck_dir,
+                heartbeat_ttl_s=1e9, saving_period=saving_period)
+    return {k: np.asarray(sgd_b.parameters[k])
+            for k in sgd_b.parameters.names()}
 
 
 def test_kill_trainer_resume_parity(tmp_path):
-    """Trainer A processes 2 tasks (checkpointing each), crashes holding
-    task 3; its lease lapses; trainer B registers, restores A's last
-    checkpoint, and finishes the pass. Final params must EQUAL a straight
-    single-trainer run over the same task sequence (the
-    test_TrainerOnePass.cpp determinism bar, extended to the crash path)."""
+    """Crash/resume through the PUBLIC API (SGD.train(master=...)):
+    trainer A dies mid-pass, its lease lapses, trainer B re-registers and
+    auto-resumes from checkpoint. Final params must EQUAL a straight
+    single-trainer run (test_TrainerOnePass.cpp determinism bar extended
+    to the crash path). Covers BOTH crash windows:
+
+    - holding a task it never stepped (BeginIteration): the task refronts
+      and B re-runs it;
+    - after the checkpoint was written but before the task was acked
+      (EndIteration): the task refronts but B recognizes it from the
+      checkpoint meta and skips, avoiding double-application.
+    """
     rng = np.random.RandomState(0)
     data_path = str(tmp_path / "train.recordio")
     _write_dataset(data_path, rng)
-
     clk = Clock()
 
-    def fresh(save_dir=None):
+    def fresh():
         svc = Service(chunks_per_task=16, timeout_s=1e6, time_fn=clk)
         svc.set_dataset([data_path])   # 96 recs / 16 = 6 tasks
         return svc
 
-    # ---- straight run: one trainer, whole pass ----
-    svc = fresh()
-    c = MasterClient(service=svc)
-    c.register(ttl_s=1e9)
-    sgd_ref = _make_sgd()
-    n = _train_tasks(sgd_ref, c)
-    assert n == 6
-    ref = {k: np.asarray(sgd_ref.parameters[k])
-           for k in sgd_ref.parameters.names()}
+    ref = _run_straight(fresh())
 
-    # ---- crash run ----
-    svc = fresh()
-    ck_dir = str(tmp_path / "ckpt")
-    ca = MasterClient(service=svc)
-    ca.register(ttl_s=10.0)
-    sgd_a = _make_sgd()
-    # A: completes tasks 0,1 (checkpointing), takes task 2 and dies
-    done_a = _train_tasks(sgd_a, ca, max_tasks=3, save_dir=ck_dir,
-                          die_after=2)
-    assert done_a == 2
-
-    clk.t += 11.0   # A's lease lapses -> task 2 requeues to the front
-
-    cb = MasterClient(service=svc)
-    cb.register(ttl_s=1e9)
-    sgd_b = _make_sgd()
-    sgd_b.load_checkpoint(ck_dir)      # latest = after A's task 1
-    # B's step counter must continue where A stopped (rng stream parity);
-    # replay continuation: tasks 2..5 with step ids 2..5
-    import jax
-    done = 2
-    while True:
-        if not cb._fetch_task():
-            break
-        batch = [_parse(r) for r in cb._records]
-        cb._records = []
-        if sgd_b._step_fn is None:
-            sgd_b._step_fn = sgd_b._build_step()
-        p = sgd_b.parameters.as_dict()
-        loss, p, sgd_b.opt_state, sgd_b.model_state, _ = sgd_b._step_fn(
-            p, sgd_b.opt_state, sgd_b.model_state, jax.random.PRNGKey(done),
-            feeds=sgd_b._make_feeder(None).feed(batch))
-        sgd_b.parameters.update_from(p)
-        done += 1
-    assert done == 6, f"B finished at {done}, expected 6 tasks total"
-
+    # crash window 1: fetched task 2, never stepped it
+    got = _crash_resume_case(tmp_path, clk, fresh(),
+                             paddle.event.BeginIteration, 2)
     for k in ref:
-        np.testing.assert_allclose(np.asarray(sgd_b.parameters[k]), ref[k],
-                                   rtol=2e-5, atol=2e-6, err_msg=k)
+        np.testing.assert_allclose(got[k], ref[k], rtol=2e-5, atol=2e-6,
+                                   err_msg=f"begin-crash {k}")
+
+    # crash window 2: stepped + checkpointed task 1, never acked it
+    got = _crash_resume_case(tmp_path, clk, fresh(),
+                             paddle.event.EndIteration, 1)
+    for k in ref:
+        np.testing.assert_allclose(got[k], ref[k], rtol=2e-5, atol=2e-6,
+                                   err_msg=f"end-crash {k}")
+
+
+def test_elastic_multipass_and_periodic_checkpoint_parity(tmp_path):
+    """Crash mid pass 1 of a 2-pass run (replacement must NOT re-run pass
+    0 or add an extra pass), and crash under saving_period=2 (unacked
+    tasks requeue and replay from the last durable checkpoint)."""
+    rng = np.random.RandomState(1)
+    data_path = str(tmp_path / "train.recordio")
+    _write_dataset(data_path, rng)
+    clk = Clock()
+
+    def fresh():
+        svc = Service(chunks_per_task=16, timeout_s=1e6, time_fn=clk)
+        svc.set_dataset([data_path])   # 6 tasks/pass
+        return svc
+
+    ref2 = _run_straight(fresh(), num_passes=2)
+
+    # crash in pass 1 (2nd pass), batch 1: resume must finish exactly
+    # passes {0,1} worth of updates
+    svc = fresh()
+    crashes = {"n": 0}
+
+    def crash_in_pass1(ev):
+        if isinstance(ev, paddle.event.BeginIteration) \
+                and ev.pass_id == 1 and ev.batch_id == 1:
+            crashes["n"] += 1
+            raise _Crash()
+
+    ck_dir = str(tmp_path / "ckpt_mp")
+    sgd_a = _make_sgd()
+    with np.testing.assert_raises(_Crash):
+        sgd_a.train(master=MasterClient(service=svc), record_parser=_parse,
+                    num_passes=2, save_dir=ck_dir, heartbeat_ttl_s=10.0,
+                    event_handler=crash_in_pass1)
+    clk.t += 11.0
+    sgd_b = _make_sgd()
+    sgd_b.train(master=MasterClient(service=svc), record_parser=_parse,
+                num_passes=2, save_dir=ck_dir, heartbeat_ttl_s=1e9)
+    for k in ref2:
+        np.testing.assert_allclose(np.asarray(sgd_b.parameters[k]), ref2[k],
+                                   rtol=2e-5, atol=2e-6,
+                                   err_msg=f"multipass {k}")
+
+    # saving_period=2: crash holding task 3 with task 2 completed but
+    # NOT yet checkpointed/acked -> both replay from the last checkpoint
+    ref1 = _run_straight(fresh(), num_passes=1)
+    got = _crash_resume_case(tmp_path, clk, fresh(),
+                             paddle.event.BeginIteration, 3,
+                             saving_period=2, tag="sp2")
+    for k in ref1:
+        np.testing.assert_allclose(got[k], ref1[k], rtol=2e-5, atol=2e-6,
+                                   err_msg=f"period2 {k}")
