@@ -78,10 +78,21 @@ ParameterAttribute = ParamAttr
 
 @dataclass
 class ExtraAttr:
-    """Extra layer attributes (reference ExtraLayerAttribute): dropout etc."""
+    """Extra layer attributes (reference ExtraLayerAttribute): dropout,
+    device placement.
+
+    ``sharding``: PartitionSpec-like axis names per OUTPUT dim — the
+    activation-sharding half of model parallelism (applied as a
+    with_sharding_constraint when the trainer runs over a mesh).
+    ``device``: the reference's per-layer device id
+    (ParallelNeuralNetwork.h:15-70, --parallel_nn). On TPU meshes manual
+    thread-per-device placement is replaced by SPMD sharding, so the id
+    is kept as a stage LABEL (diagnostics/config parity; see
+    parallel.placement for the sharding-based equivalent)."""
 
     drop_rate: float = 0.0
-    sharding: Optional[Sequence[Optional[str]]] = None   # output sharding hint
+    sharding: Optional[Sequence[Optional[str]]] = None   # output sharding
+    device: Optional[int] = None                         # v1 stage label
     error_clipping_threshold: float = 0.0                # clip activations' grad
 
     @staticmethod

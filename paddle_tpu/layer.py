@@ -77,6 +77,18 @@ def _apply_extra(ctx: Context, name: str, value, layer_attr: Optional[ExtraAttr]
                 pmath.dropout(value.data, attr.drop_rate, key, ctx.train))
         else:
             value = pmath.dropout(value, attr.drop_rate, key, ctx.train)
+    if attr.sharding is not None and getattr(ctx, "mesh", None) is not None:
+        # activation half of model parallelism: constrain this layer's
+        # output over the mesh; XLA inserts the collectives (the
+        # ParallelNeuralNetwork dispatchByDeviceId analog)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        ns = NamedSharding(ctx.mesh, P(*attr.sharding))
+        if isinstance(value, SequenceBatch):
+            value = value.with_data(
+                jax.lax.with_sharding_constraint(value.data, ns))
+        else:
+            value = jax.lax.with_sharding_constraint(value, ns)
     return value
 
 

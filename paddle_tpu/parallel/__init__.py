@@ -10,3 +10,5 @@ from paddle_tpu.parallel.mesh import (make_mesh, data_parallel_mesh,
                                       mesh_axis_names)
 from paddle_tpu.parallel.api import (shard_batch, replicate, param_sharding,
                                      DataParallel)
+from paddle_tpu.parallel.placement import (stage_attrs, model_parallel_fc,
+                                           model_parallel_mlp)

@@ -1,0 +1,79 @@
+"""Per-layer placement / model parallelism over a mesh axis.
+
+Reference: paddle/gserver/gradientmachines/ParallelNeuralNetwork.h:15-70 —
+the v1 engine places layers on devices via a per-layer ``device`` attr
+(--parallel_nn) and runs one compute thread per device with queue dispatch.
+
+TPU-native redesign: manual thread/queue placement becomes SPMD sharding.
+A "stage" here is a (weight sharding, activation sharding) pair over a
+named mesh axis; XLA inserts the transfers/collectives that the
+reference's dispatchByDeviceId did by hand:
+
+- ``part="col"``: W sharded [in, axis] — output features sharded over the
+  axis (no collective on the forward matmul);
+- ``part="row"``: W sharded [axis, out] — input features expected sharded,
+  output replicated (XLA inserts the psum).
+
+A col->row pair is the classic tensor-parallel block: the model's weights
+never exist replicated on any device, which is the capability the
+reference's layer placement provided (models too big for one device).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from paddle_tpu.attr import ExtraAttr, ParamAttr
+
+
+def stage_attrs(part: str, axis: str = "model"):
+    """(param_attr, layer_attr) for one model-parallel fc stage."""
+    if part == "col":
+        pa = ParamAttr(sharding=(None, axis))
+        la = ExtraAttr(sharding=(None, axis))
+    elif part == "row":
+        pa = ParamAttr(sharding=(axis, None))
+        la = ExtraAttr(sharding=(None, None))
+    else:
+        raise ValueError(f"part must be 'col' or 'row', got {part!r}")
+    return pa, la
+
+
+def model_parallel_fc(input, size: int, *, part: str, axis: str = "model",
+                      act=None, name: Optional[str] = None,
+                      bias_attr=True):
+    """fc whose weight AND activation are sharded over ``axis``.
+
+    col-part biases are feature-sharded too (they live with the output
+    features); row-part biases stay replicated (they add to the psum
+    result).
+    """
+    from paddle_tpu import layer
+
+    pa, la = stage_attrs(part, axis)
+    if bias_attr is True and part == "col":
+        bias_attr = ParamAttr(sharding=(axis,))
+    return layer.fc(input=input, size=size, act=act, name=name,
+                    param_attr=pa, bias_attr=bias_attr, layer_attr=la)
+
+
+def model_parallel_mlp(input, hidden_sizes: Sequence[int], out_size: int,
+                       *, axis: str = "model", act: str = "relu",
+                       out_act=None, name_prefix: str = "mp"):
+    """Alternating col/row tensor-parallel MLP (megatron-style pairs).
+
+    Hidden layers shard features over ``axis``; the final row-parallel
+    projection returns a replicated [batch, out_size] output ready for a
+    loss layer. With an even number of hidden layers every weight is
+    sharded; no device ever holds a full replica.
+    """
+    net = input
+    part = "col"
+    for i, h in enumerate(hidden_sizes):
+        net = model_parallel_fc(net, h, part=part, axis=axis, act=act,
+                                name=f"{name_prefix}_fc{i}")
+        part = "row" if part == "col" else "col"
+    return model_parallel_fc(net, out_size, part="row", axis=axis,
+                             act=out_act, name=f"{name_prefix}_out")
+
+

@@ -67,14 +67,20 @@ class StateSpec:
 
 
 class Context:
-    """Per-forward execution context handed to each node's compute fn."""
+    """Per-forward execution context handed to each node's compute fn.
 
-    def __init__(self, train: bool, rng: Optional[jax.Array], state: Dict[str, Dict[str, jax.Array]]):
+    ``mesh`` (when set) enables per-layer activation sharding constraints
+    (ExtraAttr.sharding — the ParallelNeuralNetwork layer-placement
+    analog, see paddle_tpu.parallel.placement)."""
+
+    def __init__(self, train: bool, rng: Optional[jax.Array],
+                 state: Dict[str, Dict[str, jax.Array]], mesh=None):
         self.train = train
         self._rng = rng
         self.state_in = state
         self.state_out: Dict[str, Dict[str, jax.Array]] = {}
         self._current: Optional[str] = None
+        self.mesh = mesh
 
     def rng_for(self, node_name: str) -> jax.Array:
         if self._rng is None:
@@ -223,10 +229,11 @@ class Topology:
                 state: Dict[str, Dict[str, jax.Array]],
                 feeds: Dict[str, Any], *, train: bool = False,
                 rng: Optional[jax.Array] = None,
-                outputs: Optional[Sequence[LayerOutput]] = None
+                outputs: Optional[Sequence[LayerOutput]] = None,
+                mesh=None
                 ) -> Tuple[List[Any], Dict[str, Dict[str, jax.Array]]]:
         wanted = list(outputs) if outputs is not None else self.outputs
-        ctx = Context(train=train, rng=rng, state=state)
+        ctx = Context(train=train, rng=rng, state=state, mesh=mesh)
         values: Dict[str, Any] = {}
         for node in topological_order(wanted):
             if node.fn is None:  # data layers and frame/memory placeholders

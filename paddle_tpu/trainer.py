@@ -65,7 +65,8 @@ class SGD:
     def __init__(self, cost, parameters: Parameters, update_equation: Optimizer,
                  extra_layers: Optional[Sequence[LayerOutput]] = None,
                  is_local: bool = True, mesh=None,
-                 metrics: Optional[Dict[str, LayerOutput]] = None):
+                 metrics: Optional[Dict[str, LayerOutput]] = None,
+                 zero_axis: Optional[str] = None):
         costs = [cost] if isinstance(cost, LayerOutput) else list(cost)
         self.metrics = dict(metrics or {})
         # auto-collect evaluator nodes passed via extra_layers
@@ -78,8 +79,22 @@ class SGD:
         self.optimizer = update_equation
         self.optimizer.set_param_specs(self.topology.param_specs())
         self.model_state = self.topology.init_state()
-        self.opt_state = self.optimizer.init_state(parameters.as_dict())
         self.mesh = mesh
+        if mesh is not None:
+            # commit params to their declared shardings (ParamAttr.sharding;
+            # replicated by default, ZeRO-style largest-dim sharding with
+            # zero_axis=) BEFORE optimizer slots are created: zeros_like
+            # slots then inherit the committed shardings, so no device ever
+            # materializes a full slot replica of a sharded weight
+            from paddle_tpu.parallel.api import param_sharding
+
+            shardings = param_sharding(mesh, parameters.as_dict(),
+                                       specs=self.topology.param_specs(),
+                                       zero_axis=zero_axis)
+            placed = {k: jax.device_put(v, shardings[k])
+                      for k, v in parameters.as_dict().items()}
+            parameters.update_from(placed)
+        self.opt_state = self.optimizer.init_state(parameters.as_dict())
         self._rng = jax.random.PRNGKey(FLAGS.seed or 0)
         self._step_fn = None
         self._test_fn = None
@@ -93,6 +108,7 @@ class SGD:
         optimizer = self.optimizer
         n_costs = self._n_costs
         metric_names = list(self.metrics.keys())
+        mesh = self.mesh
 
         # grad stats ride in the same compiled step (TrainerInternal.cpp:
         # 80-110 computes avgAbsGrad/maxAbsGrad in the update callback).
@@ -104,7 +120,7 @@ class SGD:
         def step(params, opt_state, model_state, rng, feeds):
             def loss_fn(p):
                 outs, new_state = topo.forward(p, model_state, feeds,
-                                               train=True, rng=rng)
+                                               train=True, rng=rng, mesh=mesh)
                 cost_vals = [_reduce_cost(o) for o in outs[:n_costs]]
                 total = functools.reduce(jnp.add, cost_vals)
                 metric_vals = {name: _metric_scalar(o) for name, o in
@@ -130,9 +146,11 @@ class SGD:
         topo = self.topology
         n_costs = self._n_costs
         metric_names = list(self.metrics.keys())
+        mesh = self.mesh
 
         def test_step(params, model_state, feeds):
-            outs, _ = topo.forward(params, model_state, feeds, train=False)
+            outs, _ = topo.forward(params, model_state, feeds, train=False,
+                                   mesh=mesh)
             cost_vals = [_reduce_cost(o) for o in outs[:n_costs]]
             total = functools.reduce(jnp.add, cost_vals)
             metric_vals = {name: _metric_scalar(o) for name, o in
@@ -146,11 +164,17 @@ class SGD:
             return feeds
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        axis = self.mesh.axis_names[0]
+        # batch shards ONLY over the 'data' axis; on a model-parallel-only
+        # mesh feeds replicate (sharding the batch over 'model' would both
+        # break on non-divisible trailing batches and force a per-step
+        # all-gather against the stage constraints)
+        axis = "data" if "data" in self.mesh.axis_names else None
         out = {}
         for k, v in feeds.items():
             if isinstance(v, SequenceBatch):
                 out[k] = v  # ragged feeds stay replicated (see parallel/)
+            elif axis is None:
+                out[k] = jax.device_put(v, NamedSharding(self.mesh, P()))
             else:
                 out[k] = jax.device_put(
                     v, NamedSharding(self.mesh, P(axis, *([None] * (v.ndim - 1)))))
@@ -593,11 +617,12 @@ class MultiTaskTrainer:
         topo = self._topos[name]
         optimizer = task.optimizer
         trainable = task.trainable
+        mesh = self.mesh
 
         def step(params, opt_state, model_state, rng, feeds):
             def loss_fn(p):
                 outs, new_state = topo.forward(p, model_state, feeds,
-                                               train=True, rng=rng)
+                                               train=True, rng=rng, mesh=mesh)
                 return _reduce_cost(outs[0]), new_state
 
             (loss, new_mstate), grads = jax.value_and_grad(

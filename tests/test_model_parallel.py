@@ -1,0 +1,128 @@
+"""Layer/stage placement model parallelism (ParallelNeuralNetwork analog).
+
+Reference bar: paddle/gserver/gradientmachines/ParallelNeuralNetwork.h:15-70
+lets a model too big for one device train by placing layers on devices. The
+TPU-native equivalent (parallel/placement.py) shards each stage's weights
+AND activations over the 'model' mesh axis — verified here on the virtual
+8-device mesh: weights are genuinely distributed (1/8 of the bytes per
+device), training runs and converges, and the result matches an identical
+unsharded model bit-for-bit within tolerance.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import layer, optimizer, trainer
+from paddle_tpu.parallel import make_mesh, model_parallel_mlp
+
+
+HIDDEN = [512, 512]
+IN_DIM, OUT_DIM = 64, 10
+
+
+def _build(mp: bool):
+    paddle.topology.reset_name_scope()
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(IN_DIM))
+    y = layer.data(name="y", type=paddle.data_type.integer_value(OUT_DIM))
+    if mp:
+        logits = model_parallel_mlp(x, HIDDEN, OUT_DIM, axis="model")
+    else:
+        net = x
+        for i, h in enumerate(HIDDEN):
+            net = layer.fc(input=net, size=h, act="relu", name=f"mp_fc{i}")
+        logits = layer.fc(input=net, size=OUT_DIM, name="mp_out")
+    cost = layer.classification_cost(input=logits, label=y)
+    return cost
+
+
+_LABEL_W = np.random.RandomState(99).randn(IN_DIM, OUT_DIM)
+
+
+def _batch(rng, n=32):
+    """Learnable task: label = argmax of a fixed random projection."""
+    xs = rng.randn(n, IN_DIM).astype(np.float32)
+    ys = np.argmax(xs @ _LABEL_W, axis=1)
+    return [(xs[i], int(ys[i])) for i in range(n)]
+
+
+def test_model_parallel_weights_are_distributed():
+    mesh = make_mesh((8,), ("model",))
+    cost = _build(mp=True)
+    params = paddle.Parameters.from_topology(
+        paddle.topology.Topology([cost]), seed=3)
+    sgd = trainer.SGD(cost=cost, parameters=params,
+                      update_equation=optimizer.Adam(learning_rate=3e-3),
+                      mesh=mesh)
+    # every stage weight is sharded: per-device shard holds 1/8 of bytes —
+    # the "too big to replicate" capability (no full copy anywhere)
+    for pname in ["mp_fc0.w0", "mp_fc1.w0", "mp_out.w0"]:
+        v = sgd.parameters[pname]
+        shard = v.addressable_shards[0].data
+        assert shard.nbytes * 8 == v.nbytes, \
+            f"{pname} not distributed: {shard.shape} vs {v.shape}"
+        # optimizer slots inherit the sharding AT INIT (params are placed
+        # before slot creation — no transient full replica on one device)
+        for sname, tree in sgd.opt_state["slots"].items():
+            sv = tree[pname]
+            assert sv.addressable_shards[0].data.nbytes * 8 == sv.nbytes, \
+                f"slot {sname}[{pname}] not sharded at init"
+
+    rng = np.random.RandomState(0)
+    costs = []
+    sgd.train(lambda: iter([_batch(rng) for _ in range(80)]), num_passes=1,
+              event_handler=lambda ev: costs.append(float(ev.cost))
+              if isinstance(ev, paddle.event.EndIteration) else None)
+    assert np.mean(costs[-5:]) < np.mean(costs[:5]) / 2, \
+        "model-parallel training failed to learn"
+
+    # params remain sharded after training (no silent gather)
+    v = sgd.parameters["mp_fc0.w0"]
+    assert v.addressable_shards[0].data.nbytes * 8 == v.nbytes
+
+
+def test_model_parallel_matches_single_device():
+    """Same seed, same data: the TP-sharded model must compute the same
+    updates as the plain replicated model (test_NetworkCompare analog)."""
+    rng_data = np.random.RandomState(7)
+    batches = [_batch(rng_data) for _ in range(5)]
+
+    def run(mp, mesh):
+        cost = _build(mp)
+        params = paddle.Parameters.from_topology(
+            paddle.topology.Topology([cost]), seed=11)
+        sgd = trainer.SGD(cost=cost, parameters=params,
+                          update_equation=optimizer.Adam(learning_rate=1e-2),
+                          mesh=mesh)
+        sgd.train(lambda: iter(list(batches)), num_passes=1)
+        return {k: np.asarray(sgd.parameters[k])
+                for k in sgd.parameters.names()}
+
+    ref = run(False, None)
+    got = run(True, make_mesh((8,), ("model",)))
+    assert set(ref) == set(got)
+    # SPMD partitioning reassociates reductions; Adam's per-param rescale
+    # amplifies the roundoff, so parity is close-but-not-bitwise
+    for k in ref:
+        np.testing.assert_allclose(got[k], ref[k], rtol=5e-3, atol=5e-4,
+                                   err_msg=k)
+
+
+def test_stage_activation_sharding_constraint_in_hlo():
+    """The compiled step must contain the activation sharding (custom call
+    Sharding / all-reduce from the row-parallel stage)."""
+    mesh = make_mesh((8,), ("model",))
+    cost = _build(mp=True)
+    params = paddle.Parameters.from_topology(
+        paddle.topology.Topology([cost]), seed=3)
+    sgd = trainer.SGD(cost=cost, parameters=params,
+                      update_equation=optimizer.Momentum(
+                          momentum=0.9, learning_rate=0.1), mesh=mesh)
+    feeds = sgd._make_feeder(None).feed(_batch(np.random.RandomState(1)))
+    feeds = sgd._shard_feeds(feeds)
+    step = sgd._build_step()
+    args = (sgd.parameters.as_dict(), sgd.opt_state, sgd.model_state,
+            jax.random.PRNGKey(0), feeds)
+    txt = step.lower(*args).compile().as_text()
+    assert "all-reduce" in txt, "row-parallel psum missing from HLO"
