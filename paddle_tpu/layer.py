@@ -1486,9 +1486,32 @@ def _crf_viterbi(emissions, mask, transitions, start, stop):
         cur = bp[jnp.arange(B), nxt]
         return cur, nxt
 
-    _, path_rev = jax.lax.scan(back_step, last, bps, reverse=True)
-    path = jnp.concatenate([path_rev, last[None, :]], axis=0)  # [T, B]
+    # reverse scan emits y[t] = state at position t+1 and carries the
+    # chain back to position 0 (the final carry) — prepend it, don't
+    # re-append `last`
+    first, path_rev = jax.lax.scan(back_step, last, bps, reverse=True)
+    path = jnp.concatenate([first[None, :], path_rev], axis=0)  # [T, B]
     return jnp.swapaxes(path, 0, 1).astype(jnp.int32)
+
+
+def _crf_params(size: int, param_attr) -> Dict[str, "ParamSpec"]:
+    """CRF parameter table. An explicit ParamAttr.name becomes a PREFIX so
+    a crf cost layer and its crf_decoding twin can share the learned
+    transitions (the reference shares via parameter_name on both layers)."""
+    import dataclasses
+
+    attr = ParamAttr.to_attr(param_attr)
+
+    def per(pname):
+        if attr.name:
+            return dataclasses.replace(attr, name=f"{attr.name}.{pname}")
+        return attr
+
+    return {
+        "transitions": ParamSpec((size, size), per("transitions")),
+        "start": ParamSpec((size,), per("start")),
+        "stop": ParamSpec((size,), per("stop")),
+    }
 
 
 @_export
@@ -1501,12 +1524,7 @@ def crf(input, label, size: int = None, name: Optional[str] = None,
     _need_seq(inp, "crf")
     size = size or inp.size
     name = name or unique_name("crf")
-    attr = ParamAttr.to_attr(param_attr)
-    params = {
-        "transitions": ParamSpec((size, size), attr),
-        "start": ParamSpec((size,), attr),
-        "stop": ParamSpec((size,), attr),
-    }
+    params = _crf_params(size, param_attr)
 
     def compute(ctx, p, ins):
         sb, lb = ins[0], ins[1]
@@ -1530,12 +1548,7 @@ def crf_decoding(input, size: int = None, label=None,
     _need_seq(inp, "crf_decoding")
     size = size or inp.size
     name = name or unique_name("crf_decoding")
-    attr = ParamAttr.to_attr(param_attr)
-    params = {
-        "transitions": ParamSpec((size, size), attr),
-        "start": ParamSpec((size,), attr),
-        "stop": ParamSpec((size,), attr),
-    }
+    params = _crf_params(size, param_attr)
     inputs = [inp] + ([label] if label is not None else [])
 
     def compute(ctx, p, ins):
@@ -1613,6 +1626,54 @@ def _per_example(fn_dense, value, *args):
         masked = jnp.where(value.valid_mask, out, 0.0)
         return value.with_data(masked)
     return fn_dense(value, *[_data_of(a) for a in args])
+
+
+@_export
+class BeamInput:
+    """One beam expansion for cross_entropy_over_beam (reference:
+    trainer_config_helpers/layers.py BeamInput): candidate scores over the
+    expansion's search space, the selected top-k candidate ids, and the
+    gold candidate id."""
+
+    def __init__(self, candidate_scores, selected_candidates, gold):
+        self.candidate_scores = candidate_scores
+        self.selected_candidates = selected_candidates
+        self.gold = gold
+
+
+@_export
+def cross_entropy_over_beam(input, name: Optional[str] = None) -> LayerOutput:
+    """Training-through-beam cost for learning-to-search models
+    (reference: CrossEntropyOverBeam.cpp:131-162 + the
+    cross_entropy_over_beam helper). Takes a list of BeamInput (one per
+    beam expansion); the cost is -log P(gold path) under a softmax over
+    the beam at the expansion where gold falls off (gold joins the
+    normalizer as an extra path). Works with kmax_seq_score /
+    sub_nested_seq / seq_slice to trim the search space."""
+    beams = [input] if isinstance(input, BeamInput) else list(input)
+    for b in beams:
+        enforce_that(isinstance(b, BeamInput),
+                     "cross_entropy_over_beam takes BeamInput(s)",
+                     context="cross_entropy_over_beam")
+    name = name or unique_name("cross_entropy_over_beam")
+    inputs = []
+    for b in beams:
+        inputs += [b.candidate_scores, b.selected_candidates, b.gold]
+
+    def compute(ctx, p, ins):
+        triples = []
+        for i in range(0, len(ins), 3):
+            scores = _data_of(ins[i])
+            selected = _data_of(ins[i + 1])
+            gold = _data_of(ins[i + 2]).reshape(-1)
+            if scores.ndim == 1:
+                scores = scores.reshape(1, -1)
+            if selected.ndim == 1:
+                selected = selected.reshape(1, -1)
+            triples.append((scores, selected, gold))
+        return ploss.cross_entropy_over_beam(triples)
+
+    return _cost_node(name, "cross_entropy_over_beam", inputs, compute)
 
 
 @_export

@@ -9,3 +9,4 @@ from paddle_tpu.models import seq2seq
 from paddle_tpu.models import deepfm
 from paddle_tpu.models import gan
 from paddle_tpu.models import vae
+from paddle_tpu.models import sequence_tagging

@@ -1,0 +1,45 @@
+"""Linear-CRF sequence tagging — the sequence_tagging demo.
+
+Reference: v1_api_demo/sequence_tagging/linear_crf.py (chunking: word +
+context-window features -> emission scores -> crf_layer cost, with a
+crf_decoding twin sharing the transition parameters for evaluation).
+
+TPU-native: the context window is an embedding + context_projection mixed
+layer; the CRF forward (log-partition) and viterbi decode run as lax.scans
+inside the jitted step (paddle_tpu/layer.py crf/crf_decoding).
+"""
+
+from __future__ import annotations
+
+import paddle_tpu as paddle
+from paddle_tpu import layer
+from paddle_tpu.attr import ParamAttr
+
+
+def build(vocab_size: int = 2000, num_tags: int = 9, emb_dim: int = 32,
+          context_len: int = 5, hidden: int = 64):
+    """Returns (word, label, crf_cost, decoded) LayerOutputs.
+
+    ``decoded`` is the viterbi path from a crf_decoding layer sharing the
+    cost layer's transitions via the 'crf_tag' parameter-name prefix
+    (reference: linear_crf.py shares via parameter_name)."""
+    word = layer.data(
+        name="word", type=paddle.data_type.integer_value_sequence(vocab_size))
+    label = layer.data(
+        name="label", type=paddle.data_type.integer_value_sequence(num_tags))
+
+    emb = layer.embedding(input=word, size=emb_dim)
+    ctx = layer.mixed(
+        size=emb_dim * context_len,
+        input=[layer.context_projection(input=emb,
+                                        context_len=context_len,
+                                        context_start=-(context_len // 2))])
+    feat = layer.fc(input=ctx, size=hidden, act="tanh")
+    emission = layer.fc(input=feat, size=num_tags, name="emission")
+
+    shared = ParamAttr(name="crf_tag")
+    cost = layer.crf(input=emission, label=label, size=num_tags,
+                     param_attr=shared)
+    decoded = layer.crf_decoding(input=emission, size=num_tags,
+                                 param_attr=shared)
+    return word, label, cost, decoded

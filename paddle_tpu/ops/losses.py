@@ -131,3 +131,58 @@ def cross_entropy_with_selfnorm(logits: jax.Array, labels: jax.Array,
     picked = jnp.take_along_axis(logits, labels[..., None].astype(jnp.int32),
                                  axis=-1)[..., 0]
     return (logz - picked) + alpha * jnp.square(logz)
+
+
+def cross_entropy_over_beam(beams) -> jax.Array:
+    """Globally-normalized beam cost for learning-to-search training.
+
+    Reference: paddle/gserver/layers/CrossEntropyOverBeam.cpp:131-162
+    (CostForOneSequence::globallyNormalizedScore): candidate paths across
+    beam expansions are scored, softmax-normalized over the beam, and the
+    cost is -log P(gold path). If gold falls off the beam at expansion t,
+    the cost is computed over the beam AT step t; the gold path joins the
+    normalizer as an extra path.
+
+    TPU-native formulation: per expansion the inputs are dense
+    (scores[B, N], selected[B, K] candidate ids, gold[B] id). Path
+    prefixes shared by every candidate at an expansion cancel inside the
+    softmax, so the loss at the decisive expansion f reduces to a
+    (K+1)-way softmax over [beam scores at f, gold score at f] with the
+    gold's in-beam duplicate masked. Everything is branch-free
+    (lax-friendly): the decisive step is selected with a one-hot over
+    the static expansion count.
+
+    ``beams``: list of (scores[B, N_t], selected[B, K_t], gold[B]).
+    Returns per-sequence costs [B].
+    """
+    neg = -1e9
+    gold_in = []       # [B] per t
+    logits_t = []      # [B, Kmax+1] per t
+    kmax = max(int(s.shape[1]) for _, s, _ in beams)
+    for scores, selected, gold in beams:
+        selected = selected.astype(jnp.int32)
+        gold = gold.astype(jnp.int32)
+        in_beam = jnp.any(selected == gold[:, None], axis=1)
+        beam_scores = jnp.take_along_axis(scores, selected, axis=1)
+        # mask gold's in-beam copy: it is re-appended as the explicit
+        # gold path so it is counted exactly once in the normalizer
+        beam_scores = jnp.where(selected == gold[:, None], neg, beam_scores)
+        if beam_scores.shape[1] < kmax:
+            pad = jnp.full((beam_scores.shape[0], kmax - beam_scores.shape[1]),
+                           neg, beam_scores.dtype)
+            beam_scores = jnp.concatenate([beam_scores, pad], axis=1)
+        gold_score = jnp.take_along_axis(scores, gold[:, None], axis=1)
+        logits_t.append(jnp.concatenate([beam_scores, gold_score], axis=1))
+        gold_in.append(in_beam)
+    gold_in = jnp.stack(gold_in, axis=1)              # [B, T]
+    logits = jnp.stack(logits_t, axis=1)              # [B, T, K+1]
+    t_count = gold_in.shape[1]
+    # decisive expansion: first fall-off, else the last expansion
+    fell = jnp.any(~gold_in, axis=1)
+    first_off = jnp.argmax(~gold_in, axis=1)
+    f = jnp.where(fell, first_off, t_count - 1)       # [B]
+    picked = jnp.take_along_axis(
+        logits, f[:, None, None], axis=1)[:, 0]       # [B, K+1]
+    # gold path is always the LAST logit
+    return softmax_cross_entropy(
+        picked, jnp.full(picked.shape[:1], picked.shape[1] - 1, jnp.int32))

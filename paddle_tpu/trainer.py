@@ -80,20 +80,13 @@ class SGD:
         self.optimizer.set_param_specs(self.topology.param_specs())
         self.model_state = self.topology.init_state()
         self.mesh = mesh
-        if mesh is not None:
-            # commit params to their declared shardings (ParamAttr.sharding;
-            # replicated by default, ZeRO-style largest-dim sharding with
-            # zero_axis=) BEFORE optimizer slots are created: zeros_like
-            # slots then inherit the committed shardings, so no device ever
-            # materializes a full slot replica of a sharded weight
-            from paddle_tpu.parallel.api import param_sharding
-
-            shardings = param_sharding(mesh, parameters.as_dict(),
-                                       specs=self.topology.param_specs(),
-                                       zero_axis=zero_axis)
-            placed = {k: jax.device_put(v, shardings[k])
-                      for k, v in parameters.as_dict().items()}
-            parameters.update_from(placed)
+        self._zero_axis = zero_axis
+        # commit params to their declared shardings (ParamAttr.sharding;
+        # replicated by default, ZeRO-style largest-dim sharding with
+        # zero_axis=) BEFORE optimizer slots are created: zeros_like slots
+        # then inherit the committed shardings, so no device ever
+        # materializes a full slot replica of a sharded weight
+        self._place_on_mesh(slots_too=False)
         self.opt_state = self.optimizer.init_state(parameters.as_dict())
         self._rng = jax.random.PRNGKey(FLAGS.seed or 0)
         self._step_fn = None
@@ -158,6 +151,38 @@ class SGD:
             return total, metric_vals
 
         return jax.jit(test_step)
+
+    def _place_on_mesh(self, slots_too: bool = True) -> None:
+        """(Re)commit params — and optimizer state mirroring them — to
+        their mesh shardings. Called at init and after ANY checkpoint
+        load: load_checkpoint hands back host arrays, and without
+        re-placement a resume would replicate 'too big to replicate'
+        weights on every device."""
+        if self.mesh is None:
+            return
+        from paddle_tpu.parallel.api import param_sharding
+
+        shardings = param_sharding(self.mesh, self.parameters.as_dict(),
+                                   specs=self.topology.param_specs(),
+                                   zero_axis=self._zero_axis)
+        self.parameters.update_from(
+            {k: jax.device_put(v, shardings[k])
+             for k, v in self.parameters.as_dict().items()})
+        if not slots_too or not isinstance(self.opt_state, dict):
+            return
+        new_state = dict(self.opt_state)
+        for key in ("slots",):
+            if key in new_state:
+                new_state[key] = {
+                    s: {k: (jax.device_put(v, shardings[k])
+                            if k in shardings else v)
+                        for k, v in d.items()}
+                    for s, d in new_state[key].items()}
+        if "avg" in new_state:
+            new_state["avg"] = {
+                k: (jax.device_put(v, shardings[k]) if k in shardings else v)
+                for k, v in new_state["avg"].items()}
+        self.opt_state = new_state
 
     def _shard_feeds(self, feeds):
         if self.mesh is None:
@@ -356,6 +381,7 @@ class SGD:
                 self.opt_state = opt
             if mst is not None:
                 self.model_state = mst
+            self._place_on_mesh()
             log.info("elastic: resumed from checkpoint %d (pass %d, "
                      "next step %d)", latest, meta.get("pass_id", 0),
                      meta.get("next_step", latest + 1))
@@ -536,6 +562,7 @@ class SGD:
             self.opt_state = opt_state
         if model_state is not None:
             self.model_state = model_state
+        self._place_on_mesh()
 
 
 def _default_event_handler(ev) -> None:

@@ -1,0 +1,238 @@
+"""cross_entropy_over_beam + sequence_tagging CRF demo.
+
+Reference bars: CrossEntropyOverBeam.cpp semantics (globally-normalized
+path softmax, gold-as-extra-path when it falls off the beam at step t),
+checked against a numpy oracle and by numeric gradients; and the
+v1_api_demo/sequence_tagging linear_crf demo trained end-to-end.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import layer, optimizer, trainer
+from paddle_tpu.models import sequence_tagging
+from paddle_tpu.ops import losses as ploss
+from paddle_tpu.platform.flags import FLAGS
+
+
+@pytest.fixture(autouse=True)
+def f32_math():
+    old = FLAGS.use_bf16
+    FLAGS.use_bf16 = False
+    yield
+    FLAGS.use_bf16 = old
+
+
+# ---------------------------------------------------------------------------
+# cross_entropy_over_beam op
+# ---------------------------------------------------------------------------
+
+
+def _oracle_one(beams, b):
+    """Reference semantics (CrossEntropyOverBeam.cpp:131-162) for one
+    sequence: shared path prefixes cancel, so the cost is the softmax at
+    the decisive expansion over [beam scores (gold copy removed), gold]."""
+    t_fall = None
+    for t, (scores, selected, gold) in enumerate(beams):
+        if gold[b] not in list(selected[b]):
+            t_fall = t
+            break
+    f = t_fall if t_fall is not None else len(beams) - 1
+    scores, selected, gold = beams[f]
+    logits = [scores[b, j] for j in selected[b] if j != gold[b]]
+    logits.append(scores[b, gold[b]])
+    logits = np.asarray(logits, np.float64)
+    e = np.exp(logits - logits.max())
+    return -np.log(e[-1] / e.sum())
+
+
+def _mk_beams(rng, batch=4, t=3, n=12, k=4):
+    beams = []
+    for _ in range(t):
+        scores = rng.randn(batch, n).astype(np.float32)
+        selected = np.stack([rng.choice(n, size=k, replace=False)
+                             for _ in range(batch)]).astype(np.int32)
+        gold = rng.randint(0, n, size=batch).astype(np.int32)
+        beams.append((scores, selected, gold))
+    return beams
+
+
+def test_beam_cost_matches_oracle():
+    rng = np.random.RandomState(0)
+    beams = _mk_beams(rng)
+    # force specific regimes: seq0 gold in beam everywhere; seq1 falls off
+    # at step 0; seq2 at step 1
+    for t, (scores, selected, gold) in enumerate(beams):
+        gold[0] = selected[0][0]
+        if t == 0:
+            gold[1] = [j for j in range(12) if j not in selected[1]][0]
+        gold[2] = (selected[2][1] if t < 1
+                   else [j for j in range(12) if j not in selected[2]][0])
+    got = np.asarray(ploss.cross_entropy_over_beam(
+        [(jnp.asarray(s), jnp.asarray(c), jnp.asarray(g))
+         for s, c, g in beams]))
+    for b in range(4):
+        assert got[b] == pytest.approx(_oracle_one(beams, b), rel=1e-5), b
+
+
+def test_beam_cost_mixed_beam_sizes_and_grad():
+    rng = np.random.RandomState(1)
+    b1 = _mk_beams(rng, t=1, n=10, k=3)[0]
+    b2 = _mk_beams(rng, t=1, n=16, k=5)[0]
+    beams = [b1, b2]
+
+    def loss_fn(s1, s2):
+        return jnp.sum(ploss.cross_entropy_over_beam(
+            [(s1, jnp.asarray(b1[1]), jnp.asarray(b1[2])),
+             (s2, jnp.asarray(b2[1]), jnp.asarray(b2[2]))]))
+
+    g1, g2 = jax.grad(loss_fn, argnums=(0, 1))(jnp.asarray(b1[0]),
+                                               jnp.asarray(b2[0]))
+    # numeric check on a few coordinates of each expansion's scores
+    for (arr, grad, idx) in [(b1[0], g1, (0, 2)), (b2[0], g2, (3, 7))]:
+        eps = 1e-3
+        up, dn = arr.copy(), arr.copy()
+        up[idx] += eps
+        dn[idx] -= eps
+        if arr is b1[0]:
+            num = (loss_fn(jnp.asarray(up), jnp.asarray(b2[0])) -
+                   loss_fn(jnp.asarray(dn), jnp.asarray(b2[0]))) / (2 * eps)
+        else:
+            num = (loss_fn(jnp.asarray(b1[0]), jnp.asarray(up)) -
+                   loss_fn(jnp.asarray(b1[0]), jnp.asarray(dn))) / (2 * eps)
+        assert float(num) == pytest.approx(float(grad[idx]), abs=2e-3)
+
+
+def test_beam_cost_layer_trains():
+    """Learning-to-search e2e: scores come from a trainable fc; training
+    must raise the gold path's probability."""
+    paddle.topology.reset_name_scope()
+    n_cand, k = 8, 3
+    feat = layer.data(name="feat", type=paddle.data_type.dense_vector(16))
+    sel = layer.data(name="sel",
+                     type=paddle.data_type.dense_vector(k))
+    gold = layer.data(name="gold", type=paddle.data_type.integer_value(n_cand))
+    scores = layer.fc(input=feat, size=n_cand, name="scorer")
+    cost = layer.cross_entropy_over_beam(layer.BeamInput(
+        candidate_scores=scores, selected_candidates=sel, gold=gold))
+    params = paddle.Parameters.from_topology(
+        paddle.topology.Topology([cost]), seed=0)
+    sgd = trainer.SGD(cost=cost, parameters=params,
+                      update_equation=optimizer.Adam(learning_rate=1e-2))
+    rng = np.random.RandomState(0)
+    proj = rng.randn(16, n_cand)
+
+    def reader():
+        for _ in range(40):
+            batch = []
+            for _ in range(16):
+                x = rng.randn(16).astype(np.float32)
+                g = int(np.argmax(x @ proj))
+                s = rng.choice(n_cand, size=k, replace=False).astype(np.float32)
+                batch.append((x, s, g))
+            yield batch
+
+    costs = []
+    sgd.train(reader, num_passes=2,
+              event_handler=lambda ev: costs.append(float(ev.cost))
+              if isinstance(ev, paddle.event.EndIteration) else None)
+    assert np.mean(costs[-5:]) < np.mean(costs[:5]) / 2
+
+
+# ---------------------------------------------------------------------------
+# sequence_tagging (linear CRF) demo
+# ---------------------------------------------------------------------------
+
+
+def _tag_data(rng, n_seqs, vocab, n_tags):
+    """Learnable tagging: tag = f(token class, previous token class) — a
+    2nd-order pattern a linear CRF with context features can fit."""
+    for _ in range(n_seqs):
+        length = int(rng.randint(4, 12))
+        toks = rng.randint(0, vocab, size=length)
+        tags = []
+        prev = 0
+        for t in toks:
+            cls = t % 3
+            tags.append((cls + 2 * prev) % n_tags)
+            prev = cls
+        yield [int(t) for t in toks], [int(t) for t in tags]
+
+
+def test_crf_viterbi_matches_bruteforce():
+    """Every tag path enumerated: viterbi must return the arg-max path
+    (caught a backtrack off-by-one that dropped position 0)."""
+    from itertools import product
+
+    from paddle_tpu.layer import _crf_viterbi
+
+    rng = np.random.RandomState(3)
+    B, T, K = 3, 5, 4
+    em = rng.randn(B, T, K).astype(np.float32)
+    tr = rng.randn(K, K).astype(np.float32)
+    start = rng.randn(K).astype(np.float32)
+    stop = rng.randn(K).astype(np.float32)
+    mask = np.ones((B, T), bool)
+    mask[1, 3:] = False  # one shorter sequence
+
+    got = np.asarray(_crf_viterbi(jnp.asarray(em), jnp.asarray(mask),
+                                  jnp.asarray(tr), jnp.asarray(start),
+                                  jnp.asarray(stop)))
+    for b in range(B):
+        length = int(mask[b].sum())
+        best, best_s = None, -np.inf
+        for path in product(range(K), repeat=length):
+            s = start[path[0]] + em[b, 0, path[0]]
+            for t in range(1, length):
+                s += tr[path[t - 1], path[t]] + em[b, t, path[t]]
+            s += stop[path[-1]]
+            if s > best_s:
+                best, best_s = path, s
+        assert tuple(got[b, :length]) == best, \
+            f"seq {b}: {tuple(got[b, :length])} != {best}"
+
+
+def test_sequence_tagging_crf_trains_and_decodes():
+    paddle.topology.reset_name_scope()
+    vocab, n_tags = 50, 5
+    word, label, cost, decoded = sequence_tagging.build(
+        vocab_size=vocab, num_tags=n_tags, emb_dim=16, hidden=32)
+    topo = paddle.topology.Topology([cost])
+    params = paddle.Parameters.from_topology(topo, seed=0)
+    # shared params: crf cost and decoding read the same storage
+    keys = set(topo.param_specs())
+    assert "crf_tag.transitions" in keys and "crf_tag.start" in keys
+    sgd = trainer.SGD(cost=cost, parameters=params,
+                      update_equation=optimizer.Adam(learning_rate=5e-3))
+
+    rng = np.random.RandomState(0)
+    data = list(_tag_data(rng, 512, vocab, n_tags))
+
+    def reader():
+        for i in range(0, len(data), 32):
+            yield data[i:i + 32]
+
+    costs = []
+    sgd.train(reader, num_passes=6,
+              event_handler=lambda ev: costs.append(float(ev.cost))
+              if isinstance(ev, paddle.event.EndIteration) else None)
+    assert np.mean(costs[-8:]) < np.mean(costs[:8]) / 3, \
+        f"CRF failed to learn: {np.mean(costs[:8])} -> {np.mean(costs[-8:])}"
+
+    # viterbi decode through the SHARED transitions: token accuracy
+    test_data = list(_tag_data(rng, 32, vocab, n_tags))
+    dec_topo = paddle.topology.Topology([decoded])
+    feeder = sgd._make_feeder({"word": 0, "label": 1})
+    feeds = feeder.feed(test_data)
+    outs, _ = dec_topo.forward(sgd.parameters.as_dict(), sgd.model_state,
+                               {"word": feeds["word"]}, train=False)
+    sb = outs[0]
+    pred = np.asarray(sb.data).reshape(-1)
+    mask = np.asarray(sb.valid_mask)
+    truth = np.concatenate([np.asarray(t) for _, t in test_data])
+    assert mask.sum() == len(truth)
+    acc = (pred[mask] == truth).mean()
+    assert acc > 0.8, f"viterbi decode accuracy {acc}"
