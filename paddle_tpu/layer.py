@@ -1633,12 +1633,18 @@ class BeamInput:
     """One beam expansion for cross_entropy_over_beam (reference:
     trainer_config_helpers/layers.py BeamInput): candidate scores over the
     expansion's search space, the selected top-k candidate ids, and the
-    gold candidate id."""
+    gold candidate id. ``prev_ids`` (optional) links each selected
+    candidate to the beam slot of the PREVIOUS expansion it extends —
+    the dense analog of the reference's seqInfo path bookkeeping; with
+    it, path scores accumulate across expansions and every expansion's
+    scorer receives gradient."""
 
-    def __init__(self, candidate_scores, selected_candidates, gold):
+    def __init__(self, candidate_scores, selected_candidates, gold,
+                 prev_ids=None):
         self.candidate_scores = candidate_scores
         self.selected_candidates = selected_candidates
         self.gold = gold
+        self.prev_ids = prev_ids
 
 
 @_export
@@ -1657,12 +1663,18 @@ def cross_entropy_over_beam(input, name: Optional[str] = None) -> LayerOutput:
                      context="cross_entropy_over_beam")
     name = name or unique_name("cross_entropy_over_beam")
     inputs = []
+    arity = []
     for b in beams:
-        inputs += [b.candidate_scores, b.selected_candidates, b.gold]
+        ins_b = [b.candidate_scores, b.selected_candidates, b.gold]
+        if b.prev_ids is not None:
+            ins_b.append(b.prev_ids)
+        arity.append(len(ins_b))
+        inputs += ins_b
 
     def compute(ctx, p, ins):
         triples = []
-        for i in range(0, len(ins), 3):
+        i = 0
+        for n in arity:
             scores = _data_of(ins[i])
             selected = _data_of(ins[i + 1])
             gold = _data_of(ins[i + 2]).reshape(-1)
@@ -1670,7 +1682,14 @@ def cross_entropy_over_beam(input, name: Optional[str] = None) -> LayerOutput:
                 scores = scores.reshape(1, -1)
             if selected.ndim == 1:
                 selected = selected.reshape(1, -1)
-            triples.append((scores, selected, gold))
+            entry = [scores, selected.astype(jnp.int32), gold]
+            if n == 4:
+                prev = _data_of(ins[i + 3])
+                if prev.ndim == 1:
+                    prev = prev.reshape(1, -1)
+                entry.append(prev.astype(jnp.int32))
+            triples.append(tuple(entry))
+            i += n
         return ploss.cross_entropy_over_beam(triples)
 
     return _cost_node(name, "cross_entropy_over_beam", inputs, compute)

@@ -137,43 +137,70 @@ def cross_entropy_over_beam(beams) -> jax.Array:
     """Globally-normalized beam cost for learning-to-search training.
 
     Reference: paddle/gserver/layers/CrossEntropyOverBeam.cpp:131-162
-    (CostForOneSequence::globallyNormalizedScore): candidate paths across
-    beam expansions are scored, softmax-normalized over the beam, and the
-    cost is -log P(gold path). If gold falls off the beam at expansion t,
-    the cost is computed over the beam AT step t; the gold path joins the
-    normalizer as an extra path.
+    (CostForOneSequence::globallyNormalizedScore): each candidate PATH's
+    score is the sum of its per-expansion scores, the paths at the
+    decisive expansion are softmax-normalized, and the cost is
+    -log P(gold path). If gold falls off the beam at expansion t, the
+    cost is computed over the beam AT step t; the gold path joins the
+    normalizer as an extra path. Gradient flows to EVERY expansion on a
+    surviving path (the reference backward()'s addToRows over all
+    expansions).
 
     TPU-native formulation: per expansion the inputs are dense
-    (scores[B, N], selected[B, K] candidate ids, gold[B] id). Path
-    prefixes shared by every candidate at an expansion cancel inside the
-    softmax, so the loss at the decisive expansion f reduces to a
-    (K+1)-way softmax over [beam scores at f, gold score at f] with the
-    gold's in-beam duplicate masked. Everything is branch-free
-    (lax-friendly): the decisive step is selected with a one-hot over
-    the static expansion count.
+    (scores[B, N_t], selected[B, K_t], gold[B][, parents[B, K_t]]).
+    ``parents`` links candidate k at expansion t to the beam slot at
+    t-1 it extends; path scores accumulate along those links. Without
+    parents, every candidate extends the gold prefix — the shared
+    prefix then cancels in the softmax (and correctly receives zero
+    gradient, since d(-log softmax(c+x))/dc = 0). Branch-free: the
+    decisive step is selected by index, not control flow.
 
-    ``beams``: list of (scores[B, N_t], selected[B, K_t], gold[B]).
     Returns per-sequence costs [B].
     """
     neg = -1e9
-    gold_in = []       # [B] per t
-    logits_t = []      # [B, Kmax+1] per t
-    kmax = max(int(s.shape[1]) for _, s, _ in beams)
-    for scores, selected, gold in beams:
-        selected = selected.astype(jnp.int32)
-        gold = gold.astype(jnp.int32)
-        in_beam = jnp.any(selected == gold[:, None], axis=1)
+    kmax = max(int(b[1].shape[1]) for b in beams)
+    batch = beams[0][0].shape[0]
+
+    gold_in = []        # [B] per t: gold (with gold ancestry) in beam
+    logits_t = []       # [B, Kmax+1] per t: [path scores, gold path]
+    path = None         # [B, Kmax] accumulated candidate-path scores
+    gold_prefix = jnp.zeros((batch,), beams[0][0].dtype)
+    gold_slot_prev = None  # [B] beam slot holding the gold path at t-1
+
+    for b in beams:
+        scores, selected, gold = b[0], b[1].astype(jnp.int32), \
+            b[2].astype(jnp.int32)
+        parents = b[3].astype(jnp.int32) if len(b) > 3 else None
+        k = selected.shape[1]
         beam_scores = jnp.take_along_axis(scores, selected, axis=1)
+        if path is None or parents is None:
+            # first expansion, or unlinked: extend the gold prefix
+            path_t = gold_prefix[:, None] + beam_scores
+        else:
+            path_t = jnp.take_along_axis(path, parents, axis=1) + beam_scores
+        gold_score = jnp.take_along_axis(scores, gold[:, None], axis=1)[:, 0]
+        gold_prefix = gold_prefix + gold_score
+        # the gold PATH sits in the beam only where the candidate id is
+        # gold AND (when linked) its ancestry is the gold path's slot
+        dup = selected == gold[:, None]
+        if parents is not None and gold_slot_prev is not None:
+            dup = dup & (parents == gold_slot_prev[:, None])
+        gold_slot_prev = jnp.argmax(dup, axis=1)
+        gold_in.append(jnp.any(dup, axis=1))
         # mask gold's in-beam copy: it is re-appended as the explicit
         # gold path so it is counted exactly once in the normalizer
-        beam_scores = jnp.where(selected == gold[:, None], neg, beam_scores)
-        if beam_scores.shape[1] < kmax:
-            pad = jnp.full((beam_scores.shape[0], kmax - beam_scores.shape[1]),
-                           neg, beam_scores.dtype)
-            beam_scores = jnp.concatenate([beam_scores, pad], axis=1)
-        gold_score = jnp.take_along_axis(scores, gold[:, None], axis=1)
-        logits_t.append(jnp.concatenate([beam_scores, gold_score], axis=1))
-        gold_in.append(in_beam)
+        masked = jnp.where(dup, neg, path_t)
+        if k < kmax:
+            masked = jnp.concatenate(
+                [masked, jnp.full((batch, kmax - k), neg, masked.dtype)],
+                axis=1)
+            path_t = jnp.concatenate(
+                [path_t, jnp.full((batch, kmax - k), neg, path_t.dtype)],
+                axis=1)
+        path = path_t
+        logits_t.append(jnp.concatenate([masked, gold_prefix[:, None]],
+                                        axis=1))
+
     gold_in = jnp.stack(gold_in, axis=1)              # [B, T]
     logits = jnp.stack(logits_t, axis=1)              # [B, T, K+1]
     t_count = gold_in.shape[1]

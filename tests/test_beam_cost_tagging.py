@@ -106,6 +106,75 @@ def test_beam_cost_mixed_beam_sizes_and_grad():
         assert float(num) == pytest.approx(float(grad[idx]), abs=2e-3)
 
 
+def test_beam_cost_linked_paths_full_oracle_and_grad():
+    """With parents links, the loss must match a full path-enumeration
+    oracle (reference semantics: path scores SUM across expansions,
+    CrossEntropyOverBeam.cpp:137-156) and EARLIER expansions' scores
+    must receive nonzero gradient."""
+    rng = np.random.RandomState(5)
+    B, N0, K0, N1, K1 = 3, 8, 3, 10, 3
+    s0 = rng.randn(B, N0).astype(np.float32)
+    sel0 = np.stack([rng.choice(N0, K0, replace=False)
+                     for _ in range(B)]).astype(np.int32)
+    g0 = np.array([sel0[b][b % K0] for b in range(B)], np.int32)  # in beam
+    s1 = rng.randn(B, N1).astype(np.float32)
+    sel1 = np.stack([rng.choice(N1, K1, replace=False)
+                     for _ in range(B)]).astype(np.int32)
+    par1 = np.stack([rng.randint(0, K0, K1) for _ in range(B)]).astype(np.int32)
+    g1 = np.array([sel1[b][0] for b in range(B)], np.int32)
+    # make candidate 0's ancestry the gold slot so the gold path is IN
+    # the final beam for seq 0; push it off ancestry for seq 1
+    gold_slot0 = np.array([int(np.where(sel0[b] == g0[b])[0][0])
+                           for b in range(B)])
+    par1[0, 0] = gold_slot0[0]
+    par1[1, 0] = (gold_slot0[1] + 1) % K0   # wrong ancestry -> falls off
+    g1[2] = [j for j in range(N1) if j not in sel1[2]][0]  # id falls off
+
+    beams = [(jnp.asarray(s0), jnp.asarray(sel0), jnp.asarray(g0)),
+             (jnp.asarray(s1), jnp.asarray(sel1), jnp.asarray(g1),
+              jnp.asarray(par1))]
+    got = np.asarray(ploss.cross_entropy_over_beam(beams))
+
+    for b in range(B):
+        gold_path_score = s0[b, g0[b]] + s1[b, g1[b]]
+        gold_in_final = any(
+            sel1[b][k] == g1[b] and par1[b][k] == gold_slot0[b]
+            for k in range(K1))
+        if gold_in_final or b == 0:
+            # decisive expansion = final: normalize over full paths
+            logits = [s0[b, sel0[b][par1[b][k]]] + s1[b, sel1[b][k]]
+                      for k in range(K1)
+                      if not (sel1[b][k] == g1[b]
+                              and par1[b][k] == gold_slot0[b])]
+        else:
+            logits = [s0[b, sel0[b][par1[b][k]]] + s1[b, sel1[b][k]]
+                      for k in range(K1)]
+        logits.append(gold_path_score)
+        logits = np.asarray(logits, np.float64)
+        e = np.exp(logits - logits.max())
+        want = -np.log(e[-1] / e.sum())
+        assert got[b] == pytest.approx(want, rel=1e-5), b
+
+    # earlier-expansion gradient is NONZERO (the single-step
+    # simplification this replaced gave exactly zero here)
+    def loss_fn(s0_):
+        return jnp.sum(ploss.cross_entropy_over_beam(
+            [(s0_, jnp.asarray(sel0), jnp.asarray(g0)),
+             (jnp.asarray(s1), jnp.asarray(sel1), jnp.asarray(g1),
+              jnp.asarray(par1))]))
+
+    g = np.asarray(jax.grad(loss_fn)(jnp.asarray(s0)))
+    assert np.abs(g).max() > 1e-3, "no gradient to expansion 0"
+    # and numerically correct
+    idx = (0, int(sel0[0][par1[0, 1]]))
+    eps = 1e-3
+    up, dn = s0.copy(), s0.copy()
+    up[idx] += eps
+    dn[idx] -= eps
+    num = (loss_fn(jnp.asarray(up)) - loss_fn(jnp.asarray(dn))) / (2 * eps)
+    assert float(num) == pytest.approx(float(g[idx]), abs=2e-3)
+
+
 def test_beam_cost_layer_trains():
     """Learning-to-search e2e: scores come from a trainable fc; training
     must raise the gold path's probability."""
