@@ -192,6 +192,74 @@ def worker_lstm():
                       "lstm_config": f"h={hidden} bs={batch} seq={seq_len}"}))
 
 
+def worker_attention():
+    """Flash-attention BACKWARD: pallas dQ/dKV kernels vs the plain-JAX
+    blockwise fallback (FLAGS.use_pallas toggle), long-context shape."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    paddle.init()
+    from paddle_tpu.ops import attention
+    from paddle_tpu.platform.flags import FLAGS
+
+    B, S, H, D = 4, 4096, 8, 64
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32),
+                    dtype=jnp.bfloat16)
+    k = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32),
+                    dtype=jnp.bfloat16)
+    v = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32),
+                    dtype=jnp.bfloat16)
+
+    def timeit(fn, iters=10):
+        out = fn(q, k, v)
+        jax.block_until_ready(out)
+        start = time.perf_counter()
+        for _ in range(iters):
+            out = fn(q, k, v)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - start) / iters
+
+    @jax.jit
+    def fwd_fn(q, k, v):
+        return attention.flash_attention(q, k, v, causal=True)
+
+    t_fwd = timeit(fwd_fn)
+
+    def time_grad(use_pallas):
+        FLAGS.use_pallas = use_pallas
+
+        @jax.jit
+        def grad_fn(q, k, v):
+            def loss(q, k, v):
+                o = attention.flash_attention(q, k, v, causal=True)
+                return jnp.sum(o.astype(jnp.float32))
+
+            return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+        return timeit(grad_fn)
+
+    t_plain = time_grad(False)
+    t_pallas = time_grad(True)
+    # the forward (same pallas kernel both ways) is subtracted so the
+    # ratio compares the BACKWARD implementations, not fwd+bwd totals
+    bwd_pallas = max(t_pallas - t_fwd, 1e-9)
+    bwd_plain = max(t_plain - t_fwd, 1e-9)
+    print(json.dumps({
+        "attention_bwd": {
+            "shape": f"B{B}xS{S}xH{H}xD{D} bf16 causal",
+            "fwd_ms": round(t_fwd * 1000, 3),
+            "pallas_fwdbwd_ms": round(t_pallas * 1000, 3),
+            "plain_jax_fwdbwd_ms": round(t_plain * 1000, 3),
+            "bwd_pallas_ms": round(bwd_pallas * 1000, 3),
+            "bwd_plain_jax_ms": round(bwd_plain * 1000, 3),
+            "bwd_speedup": round(bwd_plain / bwd_pallas, 2),
+        }}))
+
+
 def worker_scaling():
     """Fixed-GLOBAL-batch 1-vs-8-device DP step time for a ResNet train
     step on the serialized virtual CPU mesh (the headline model family,
@@ -283,6 +351,7 @@ WORKERS = {
     "resnet50": worker_resnet50,
     "alexnet": worker_alexnet,
     "lstm": worker_lstm,
+    "attention": worker_attention,
     "scaling": worker_scaling,
 }
 
@@ -357,7 +426,7 @@ def main():
                               max_attempts=3)
     if probe:
         record.update(probe)
-        for name in ("resnet50", "alexnet", "lstm"):
+        for name in ("resnet50", "alexnet", "lstm", "attention"):
             out, err = _run_worker(name, deadline)
             if out:
                 record.update(out)

@@ -14,10 +14,12 @@ Design notes (TPU-first):
   - forward is a pallas kernel: grid (batch, heads, q-blocks); K/V live in
     VMEM per (batch, head); online-softmax accumulation in fp32; matmuls hit
     the MXU with block_q x head_dim x block_k shapes.
-  - backward is a blockwise lax.scan over key blocks in plain JAX (memory
-    O(S * block_k), never materialises the S x S score matrix); XLA fuses it
-    well.  A full pallas backward is a later optimisation.
-  - on CPU (tests / 8-device virtual mesh) the kernel runs in interpret mode.
+  - backward is TWO pallas kernels (dK/dV gridded over key blocks, dQ over
+    query blocks) recomputing P blockwise from (q, k, lse) — the S x S score
+    matrix never exists in either direction; fp32 accumulation on the MXU.
+    FLAGS.use_pallas=False falls back to a blockwise lax.scan in plain JAX
+    with identical semantics.
+  - on CPU (tests / 8-device virtual mesh) the kernels run in interpret mode.
 """
 
 from __future__ import annotations
@@ -32,8 +34,7 @@ from jax.experimental import pallas as pl
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
-def _interpret_default() -> bool:
-    return jax.default_backend() == "cpu"
+from paddle_tpu.ops.kernel_util import interpret_default as _interpret_default
 
 
 # ---------------------------------------------------------------------------
@@ -174,7 +175,176 @@ def _flash_fwd(q, k, v, q_seg, kv_seg, causal, sm_scale, block_q, block_k,
 
 
 # ---------------------------------------------------------------------------
-# Backward: blockwise scan over key blocks (plain JAX)
+# Backward: pallas kernels (dK/dV then dQ), mirroring the forward's
+# blocking. Reference for what they replace: the reference's hand-fused
+# CUDA attention-adjacent kernels (paddle/cuda/src/*.cu) — here the win is
+# recomputing P blockwise from (q, k, lse) so the S x S matrix never
+# exists, with fp32 accumulation on the MXU.
+# ---------------------------------------------------------------------------
+
+
+def _flash_bwd_kv_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref,
+                         lse_ref, delta_ref, dk_ref, dv_ref, *,
+                         block_q: int, sm_scale: float, causal: bool):
+    # k_ref/v_ref: (1, 1, block_k, D); q/do: (1, 1, Sq, D);
+    # lse/delta: (1, 1, Sq, 1); qseg: (B, Sq); kseg: (B, block_k)
+    block_k = k_ref.shape[2]
+    head_dim = k_ref.shape[3]
+    seq_q = q_ref.shape[2]
+    b = pl.program_id(0)
+    kj = pl.program_id(2)
+
+    kb = k_ref[0, 0, :, :].astype(jnp.float32)
+    vb = v_ref[0, 0, :, :].astype(jnp.float32)
+    k_seg = kseg_ref[b, :].reshape(1, block_k)
+    k_ids = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    num_qb = seq_q // block_q
+
+    def body(i, carry):
+        dk, dv = carry
+        qb = q_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        dob = do_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lseb = lse_ref[0, 0, pl.ds(i * block_q, block_q), :]
+        deltab = delta_ref[0, 0, pl.ds(i * block_q, block_q), :]
+        q_seg = qseg_ref[b, pl.ds(i * block_q, block_q)].reshape(block_q, 1)
+        s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        mask = q_seg == k_seg
+        if causal:
+            q_ids = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = mask & (q_ids >= k_ids)
+        p = jnp.where(mask, jnp.exp(s - lseb), 0.0)
+        dv = dv + jax.lax.dot_general(p, dob, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - deltab) * sm_scale
+        dk = dk + jax.lax.dot_general(ds, qb, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    if causal:
+        # q blocks strictly before this k block are fully masked
+        start_qb = (kj * block_k) // block_q
+    else:
+        start_qb = 0
+    dk0 = jnp.zeros((block_k, head_dim), jnp.float32)
+    dv0 = jnp.zeros((block_k, head_dim), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start_qb, num_qb, body, (dk0, dv0))
+    dk_ref[0, 0, :, :] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref,
+                         lse_ref, delta_ref, dq_ref, *, block_k: int,
+                         sm_scale: float, causal: bool):
+    # q/do/lse/delta blocked over q; k/v full-seq per (b, h)
+    block_q = q_ref.shape[2]
+    head_dim = q_ref.shape[3]
+    seq_k = k_ref.shape[2]
+    b = pl.program_id(0)
+    qi = pl.program_id(2)
+
+    qb = q_ref[0, 0, :, :].astype(jnp.float32)
+    dob = do_ref[0, 0, :, :].astype(jnp.float32)
+    lseb = lse_ref[0, 0, :, :]
+    deltab = delta_ref[0, 0, :, :]
+    q_seg = qseg_ref[b, :].reshape(block_q, 1)
+    q_ids = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    num_kb = seq_k // block_k
+
+    def body(j, dq):
+        kb = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k_seg = kseg_ref[b, pl.ds(j * block_k, block_k)].reshape(1, block_k)
+        s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        mask = q_seg == k_seg
+        if causal:
+            k_ids = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            mask = mask & (q_ids >= k_ids)
+        p = jnp.where(mask, jnp.exp(s - lseb), 0.0)
+        dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - deltab) * sm_scale
+        return dq + jax.lax.dot_general(ds, kb, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    if causal:
+        num_kb_eff = jnp.minimum(
+            num_kb, (qi + 1) * block_q // block_k +
+            jnp.int32(block_q % block_k != 0) + 1)
+    else:
+        num_kb_eff = num_kb
+    dq = jax.lax.fori_loop(0, num_kb_eff, body,
+                           jnp.zeros((block_q, head_dim), jnp.float32))
+    dq_ref[0, 0, :, :] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_pallas(res, do, *, causal, sm_scale, block_q, block_k,
+                      interpret):
+    q, k, v, q_seg, kv_seg, out, lse = res
+    batch, seq_q, heads, head_dim = q.shape
+    seq_k = k.shape[1]
+    block_q = min(block_q, seq_q)
+    block_k = min(block_k, seq_k)
+
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    dot = do.transpose(0, 2, 1, 3)
+    delta = jnp.sum(dot.astype(jnp.float32) *
+                    out.transpose(0, 2, 1, 3).astype(jnp.float32),
+                    axis=-1, keepdims=True)               # (B, H, Sq, 1)
+    lse_t = lse[..., None]                                # (B, H, Sq, 1)
+
+    full_q = pl.BlockSpec((1, 1, seq_q, head_dim), lambda b, h, i: (b, h, 0, 0))
+    full_q1 = pl.BlockSpec((1, 1, seq_q, 1), lambda b, h, i: (b, h, 0, 0))
+    blk_q = pl.BlockSpec((1, 1, block_q, head_dim), lambda b, h, i: (b, h, i, 0))
+    blk_q1 = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0))
+    full_k = pl.BlockSpec((1, 1, seq_k, head_dim), lambda b, h, i: (b, h, 0, 0))
+    blk_k = pl.BlockSpec((1, 1, block_k, head_dim), lambda b, h, i: (b, h, i, 0))
+    qseg_all = pl.BlockSpec((batch, seq_q), lambda b, h, i: (0, 0))
+    qseg_blk = pl.BlockSpec((batch, block_q), lambda b, h, i: (0, i))
+    kseg_all = pl.BlockSpec((batch, seq_k), lambda b, h, i: (0, 0))
+    kseg_blk = pl.BlockSpec((batch, block_k), lambda b, h, i: (0, i))
+
+    dk_t, dv_t = pl.pallas_call(
+        functools.partial(_flash_bwd_kv_kernel, block_q=block_q,
+                          sm_scale=sm_scale, causal=causal),
+        grid=(batch, heads, seq_k // block_k),
+        in_specs=[full_q, blk_k, blk_k, qseg_all, kseg_blk, full_q,
+                  full_q1, full_q1],
+        out_specs=[blk_k, blk_k],
+        out_shape=[
+            jax.ShapeDtypeStruct((batch, heads, seq_k, head_dim), k.dtype),
+            jax.ShapeDtypeStruct((batch, heads, seq_k, head_dim), v.dtype),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, q_seg, kv_seg, dot, lse_t, delta)
+
+    dq_t = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
+                          sm_scale=sm_scale, causal=causal),
+        grid=(batch, heads, seq_q // block_q),
+        in_specs=[blk_q, full_k, full_k, qseg_blk, kseg_all, blk_q,
+                  blk_q1, blk_q1],
+        out_specs=blk_q,
+        out_shape=jax.ShapeDtypeStruct((batch, heads, seq_q, head_dim),
+                                       q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt, q_seg, kv_seg, dot, lse_t, delta)
+
+    return (dq_t.transpose(0, 2, 1, 3), dk_t.transpose(0, 2, 1, 3),
+            dv_t.transpose(0, 2, 1, 3), None, None)
+
+
+# ---------------------------------------------------------------------------
+# Backward: blockwise scan over key blocks (plain JAX fallback)
 # ---------------------------------------------------------------------------
 
 def _flash_bwd(res, do, *, causal, sm_scale, block_k):
@@ -242,6 +412,12 @@ def _fwd_rule(q, k, v, q_seg, kv_seg, causal, sm_scale, block_q, block_k,
 
 
 def _bwd_rule(causal, sm_scale, block_q, block_k, interpret, res, do):
+    from paddle_tpu.platform.flags import FLAGS
+
+    if FLAGS.use_pallas:
+        return _flash_bwd_pallas(res, do, causal=causal, sm_scale=sm_scale,
+                                 block_q=block_q, block_k=block_k,
+                                 interpret=interpret)
     return _flash_bwd(res, do, causal=causal, sm_scale=sm_scale,
                       block_k=block_k)
 

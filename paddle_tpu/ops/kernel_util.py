@@ -1,0 +1,12 @@
+"""Shared helpers for pallas TPU kernels."""
+
+from __future__ import annotations
+
+import jax
+
+
+def interpret_default() -> bool:
+    """Run pallas kernels in interpret mode on CPU (tests, virtual CPU
+    meshes). Anything else — 'tpu' or a TPU-relay platform like 'axon' —
+    compiles natively."""
+    return jax.default_backend() == "cpu"

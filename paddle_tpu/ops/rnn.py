@@ -14,12 +14,15 @@ reordering machinery).
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
 
 from paddle_tpu.ops.math import matmul
+from paddle_tpu.platform.flags import FLAGS
 
 
 class LSTMState(NamedTuple):
@@ -66,10 +69,119 @@ def gru_cell(x_proj: jax.Array, h: jax.Array, w_h: jax.Array,
     return (1.0 - z) * h + z * c
 
 
+# ---------------------------------------------------------------------------
+# Fused pallas LSTM step — the hl_cuda_lstm.cu analog: recurrent gate gemm
+# + all four gates' elementwise math in ONE kernel, fp32 accumulation, so
+# the per-step intermediates (gates, candidate) never round-trip to HBM.
+# Backward is closed-form plain JAX over saved activations (one gemm pair).
+# ---------------------------------------------------------------------------
+
+
+def _lstm_fused_kernel(xp_ref, h_ref, c_ref, wh_ref, b_ref, newh_ref,
+                       newc_ref, acts_ref=None):
+    xp = xp_ref[...].astype(jnp.float32)
+    h = h_ref[...].astype(jnp.float32)
+    c = c_ref[...].astype(jnp.float32)
+    gates = xp + jax.lax.dot_general(
+        h, wh_ref[...].astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    gates = gates + b_ref[...].astype(jnp.float32)
+    hd = h.shape[1]
+    i = jax.nn.sigmoid(gates[:, :hd])
+    f = jax.nn.sigmoid(gates[:, hd:2 * hd])
+    g = jnp.tanh(gates[:, 2 * hd:3 * hd])
+    o = jax.nn.sigmoid(gates[:, 3 * hd:])
+    new_c = f * c + i * g
+    tanh_nc = jnp.tanh(new_c)
+    newh_ref[...] = (o * tanh_nc).astype(newh_ref.dtype)
+    newc_ref[...] = new_c.astype(newc_ref.dtype)
+    if acts_ref is not None:  # training variant: save for the backward
+        acts_ref[...] = jnp.concatenate([i, f, g, o, tanh_nc], axis=1)
+
+
+def _fused_call(xp, h, c, w_h, bias, interpret, save_acts: bool):
+    B, H = h.shape
+    out_shape = [
+        jax.ShapeDtypeStruct((B, H), xp.dtype),
+        jax.ShapeDtypeStruct((B, H), jnp.float32),
+    ]
+    if save_acts:
+        out_shape.append(jax.ShapeDtypeStruct((B, 5 * H), jnp.float32))
+    return pl.pallas_call(
+        _lstm_fused_kernel,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(xp, h, c, w_h, bias.reshape(1, -1))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def _fused_lstm_cell(xp, h, c, w_h, bias, interpret):
+    # primal-only variant skips the (B, 5H) acts write entirely —
+    # inference/eval passes shouldn't pay HBM for backward residuals
+    new_h, new_c = _fused_call(xp, h, c, w_h, bias, interpret,
+                               save_acts=False)
+    return new_h, new_c
+
+
+def _fused_lstm_fwd(xp, h, c, w_h, bias, interpret):
+    new_h, new_c, acts = _fused_call(xp, h, c, w_h, bias, interpret,
+                                     save_acts=True)
+    # zero-size tokens carry primal dtypes (a bare dtype is not a JAX type)
+    return (new_h, new_c), (h, c, w_h, acts, jnp.zeros((0,), xp.dtype),
+                            jnp.zeros((0,), bias.dtype))
+
+
+def _fused_lstm_bwd(interpret, res, grads):
+    d_newh, d_newc = grads
+    h, c, w_h, acts, xp_token, bias_token = res
+    xp_dtype = xp_token.dtype
+    H = h.shape[1]
+    i, f, g, o, tanh_nc = (acts[:, :H], acts[:, H:2 * H], acts[:, 2 * H:3 * H],
+                           acts[:, 3 * H:4 * H], acts[:, 4 * H:])
+    d_newh = d_newh.astype(jnp.float32)
+    d_newc = d_newc.astype(jnp.float32)
+    do_ = d_newh * tanh_nc
+    dct = d_newc + d_newh * o * (1.0 - tanh_nc * tanh_nc)
+    dgates = jnp.concatenate([
+        dct * g * i * (1.0 - i),
+        dct * c.astype(jnp.float32) * f * (1.0 - f),
+        dct * i * (1.0 - g * g),
+        do_ * o * (1.0 - o),
+    ], axis=1)
+    dxp = dgates.astype(xp_dtype)
+    dh = matmul(dgates, w_h, trans_b=True).astype(h.dtype)
+    dc = (dct * f).astype(c.dtype)
+    dwh = matmul(h.astype(jnp.float32), dgates,
+                 trans_a=True).astype(w_h.dtype)
+    db = jnp.sum(dgates, axis=0).astype(bias_token.dtype)
+    return dxp, dh, dc, dwh, db
+
+
+_fused_lstm_cell.defvjp(_fused_lstm_fwd, _fused_lstm_bwd)
+
+
+# conservative per-kernel VMEM budget (bytes): w_h f32 + gates/acts/io all
+# resident at once; real v5e VMEM is ~16MB, leave headroom for the compiler
+_FUSED_VMEM_BUDGET = 10 * 1024 * 1024
+
+
+def _use_fused(batch: int, w_h, gate_act, cell_act, out_act) -> bool:
+    if not (FLAGS.use_pallas and w_h is not None
+            and gate_act is jax.nn.sigmoid and cell_act is jnp.tanh
+            and out_act is jnp.tanh):
+        return False
+    hidden = w_h.shape[0]
+    need = (w_h.size + batch * (4 * hidden) * 2   # gates in/out
+            + batch * 5 * hidden                  # saved acts
+            + batch * 4 * hidden) * 4             # io tensors, f32
+    return need <= _FUSED_VMEM_BUDGET
+
+
 def lstm_scan(x: jax.Array, mask: jax.Array, w_x: Optional[jax.Array],
               w_h: jax.Array, bias: Optional[jax.Array], *,
               reverse: bool = False, init: Optional[LSTMState] = None,
-              gate_act=jax.nn.sigmoid, cell_act=jnp.tanh, out_act=jnp.tanh
+              gate_act=jax.nn.sigmoid, cell_act=jnp.tanh, out_act=jnp.tanh,
+              interpret: Optional[bool] = None
               ) -> Tuple[jax.Array, LSTMState]:
     """Full-sequence LSTM: x [B,T,D], mask [B,T] -> (h_all [B,T,H], final).
 
@@ -84,9 +196,25 @@ def lstm_scan(x: jax.Array, mask: jax.Array, w_x: Optional[jax.Array],
     if init is None:
         init = LSTMState(jnp.zeros((B, H), xp.dtype), jnp.zeros((B, H), xp.dtype))
 
+    fused = _use_fused(B, w_h, gate_act, cell_act, out_act)
+    if interpret is None:
+        from paddle_tpu.ops.kernel_util import interpret_default
+
+        interpret = interpret_default()
+    bias_arr = (bias if bias is not None
+                else jnp.zeros((4 * H,), jnp.float32)) if fused else bias
+
     def step(state, inp):
         xt, mt = inp
-        h, new_state = lstm_cell(xt, state, w_h, bias, gate_act, cell_act, out_act)
+        if fused:
+            new_h, new_c = _fused_lstm_cell(xt, state.h,
+                                            state.c.astype(jnp.float32),
+                                            w_h, bias_arr, interpret)
+            new_state = LSTMState(new_h, new_c.astype(state.c.dtype))
+            h = new_h
+        else:
+            h, new_state = lstm_cell(xt, state, w_h, bias, gate_act,
+                                     cell_act, out_act)
         m = mt[:, None].astype(h.dtype)
         new_state = LSTMState(m * new_state.h + (1 - m) * state.h,
                               m * new_state.c + (1 - m) * state.c)

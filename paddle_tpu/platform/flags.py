@@ -88,6 +88,10 @@ FLAGS.define("platform", "", "force a jax platform ('cpu'/'tpu'); empty = auto")
 FLAGS.define("mesh_shape", "", "comma dims for the device mesh, e.g. '8' or '2,4'")
 FLAGS.define("mesh_axes", "data", "comma axis names matching mesh_shape")
 FLAGS.define("use_bf16", True, "compute matmuls/convs in bfloat16 on TPU")
+FLAGS.define("use_pallas", True,
+             "use hand-written pallas TPU kernels for the hot ops "
+             "(flash-attention backward, fused LSTM cell); off = plain "
+             "JAX/XLA fallbacks with identical semantics")
 FLAGS.define("bf16_activations", True,
              "store inter-layer image activations in bfloat16 (halves HBM "
              "traffic between fused conv blocks; stats/losses stay f32). "
