@@ -114,30 +114,58 @@ def _compiled_flops(step, args):
 
 
 def worker_resnet50():
+    """ResNet-50 train step, images/sec/chip + MFU.
+
+    Feeds are device-resident NHWC 4-D (the framework's native layout:
+    layer._to_nhwc passes 4-D through, so the per-step CHW-flat ->
+    NHWC transpose is off the hot path). Batch sweep picks the best
+    throughput; activations ride bf16 (FLAGS.bf16_activations)."""
     import jax
+    import numpy as np
 
     paddle = _init_paddle()
     from paddle_tpu.models import resnet
 
-    batch, img = 128, 224
-    paddle.topology.reset_name_scope()
-    images, label, logits, cost = resnet.build(depth=50, img_size=img,
-                                               num_classes=1000)
-    topo = paddle.topology.Topology([cost])
-    params = paddle.Parameters.from_topology(topo, seed=0)
-    sgd = _make_sgd(cost, params)
-    feeds = _dense_feeds(sgd, batch, 3 * img * img, 1000)
-    step = sgd._build_step()
-    args = _step_args(sgd, feeds)
+    img = 224
+    rng = np.random.RandomState(0)
 
-    flops = _compiled_flops(step, args)
+    def measure(batch, iters=20):
+        paddle.topology.reset_name_scope()
+        images, label, logits, cost = resnet.build(depth=50, img_size=img,
+                                                   num_classes=1000)
+        topo = paddle.topology.Topology([cost])
+        params = paddle.Parameters.from_topology(topo, seed=0)
+        sgd = _make_sgd(cost, params)
+        feeds = {
+            "image": jax.device_put(
+                rng.randn(batch, img, img, 3).astype(np.float32)),
+            "label": jax.device_put(
+                rng.randint(0, 1000, size=batch).astype(np.int32)),
+        }
+        step = sgd._build_step()
+        args = _step_args(sgd, feeds)
+        flops = _compiled_flops(step, args)
+        sec = _time_steps(step, args, iters=iters)
+        return sec, flops
+
+    results = {}
+    first_err = None
+    for batch in (128, 256):
+        try:
+            results[batch] = measure(batch)
+        except Exception as e:  # keep the smaller-batch result if any
+            first_err = e
+            break
+    if not results:
+        raise first_err  # surface the root cause, not an empty-max error
+    batch, (sec, flops) = max(
+        results.items(), key=lambda kv: kv[0] / kv[1][0])
     flops_source = "xla_cost_analysis"
     if flops is None:
         # analytic: ResNet-50 fwd ~4.09 GFLOP/img (2*MACs); train ~3x fwd
         flops = 3 * 4.089e9 * batch
         flops_source = "analytic"
 
-    sec = _time_steps(step, args, iters=20)
     kind = jax.devices()[0].device_kind
     peak = _peak_for(kind)
     achieved = flops / sec
@@ -151,6 +179,9 @@ def worker_resnet50():
         "device_kind": kind,
         "peak_tflops_assumed": peak / 1e12,
         "batch": batch,
+        "batch_sweep": {str(b): round(b / s, 1)
+                        for b, (s, _) in results.items()},
+        "feed_layout": "NHWC device-resident",
     }))
 
 
@@ -199,9 +230,7 @@ def worker_attention():
     import jax.numpy as jnp
     import numpy as np
 
-    import paddle_tpu as paddle
-
-    paddle.init()
+    _init_paddle()
     from paddle_tpu.ops import attention
     from paddle_tpu.platform.flags import FLAGS
 
@@ -214,13 +243,18 @@ def worker_attention():
     v = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32),
                     dtype=jnp.bfloat16)
 
+    def fetch(out):
+        # concrete value fetch: the completion barrier that works over the
+        # relay (block_until_ready is optimistic there — see _time_steps)
+        leaf = jax.tree.leaves(out)[0]
+        return float(jnp.asarray(leaf).ravel()[0])
+
     def timeit(fn, iters=10):
-        out = fn(q, k, v)
-        jax.block_until_ready(out)
+        fetch(fn(q, k, v))
         start = time.perf_counter()
         for _ in range(iters):
             out = fn(q, k, v)
-        jax.block_until_ready(out)
+        fetch(out)
         return (time.perf_counter() - start) / iters
 
     @jax.jit

@@ -1,0 +1,64 @@
+"""Semantic role labeling — stacked alternating-direction LSTMs + CRF.
+
+Reference: the conll05-driven SRL config family (python/paddle/v2/dataset/
+conll05.py provides the 9-slot samples; the classic db_lstm topology:
+word/context/predicate/mark embeddings -> mixed projection -> ``depth``
+LSTM layers alternating direction -> fc emission -> crf_layer, with a
+crf_decoding twin sharing transitions).
+
+TPU-native: each LSTM layer is one big input-projection gemm + a fused
+pallas recurrent cell (ops/rnn.py); the CRF forward/viterbi are lax.scans
+inside the jitted step.
+"""
+
+from __future__ import annotations
+
+import paddle_tpu as paddle
+from paddle_tpu import layer
+from paddle_tpu.attr import ParamAttr
+
+
+def build(word_dict_len: int = 4000, label_dict_len: int = 67,
+          pred_dict_len: int = 300, word_dim: int = 32, mark_dim: int = 5,
+          hidden_dim: int = 128, depth: int = 4):
+    """Returns (data_layers, crf_cost, decoded).
+
+    ``data_layers`` order matches the conll05 9-slot sample:
+    word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, predicate, mark, label.
+    """
+    seq = paddle.data_type.integer_value_sequence
+    word = layer.data(name="word", type=seq(word_dict_len))
+    ctx_names = ["ctx_n2", "ctx_n1", "ctx_0", "ctx_p1", "ctx_p2"]
+    ctxs = [layer.data(name=n, type=seq(word_dict_len)) for n in ctx_names]
+    predicate = layer.data(name="verb", type=seq(pred_dict_len))
+    mark = layer.data(name="mark", type=seq(2))
+    label = layer.data(name="label", type=seq(label_dict_len))
+
+    # word + 5 context slots SHARE one embedding table (the reference ties
+    # them via parameter_name emb)
+    shared_emb = ParamAttr(name="word_emb.w")
+    embs = [layer.embedding(input=x, size=word_dim, param_attr=shared_emb)
+            for x in [word] + ctxs]
+    embs.append(layer.embedding(input=predicate, size=word_dim))
+    embs.append(layer.embedding(input=mark, size=mark_dim))
+
+    hidden = layer.fc(input=embs, size=hidden_dim, act="tanh",
+                      name="srl_hidden0")
+    lstm = layer.lstmemory(
+        input=layer.fc(input=hidden, size=hidden_dim * 4, name="srl_in0"),
+        size=hidden_dim, name="srl_lstm0")
+    feat = [hidden, lstm]
+    for i in range(1, depth):
+        mix = layer.fc(input=feat, size=hidden_dim * 4, name=f"srl_in{i}")
+        lstm = layer.lstmemory(input=mix, size=hidden_dim,
+                               reverse=(i % 2 == 1), name=f"srl_lstm{i}")
+        feat = [feat[0], lstm]
+
+    emission = layer.fc(input=feat, size=label_dict_len, name="srl_emission")
+    shared_crf = ParamAttr(name="srl_crf")
+    cost = layer.crf(input=emission, label=label, size=label_dict_len,
+                     param_attr=shared_crf)
+    decoded = layer.crf_decoding(input=emission, size=label_dict_len,
+                                 param_attr=shared_crf)
+    data_layers = [word] + ctxs + [predicate, mark, label]
+    return data_layers, cost, decoded

@@ -160,21 +160,102 @@ def _fused_lstm_bwd(interpret, res, grads):
 _fused_lstm_cell.defvjp(_fused_lstm_fwd, _fused_lstm_bwd)
 
 
+def _gru_fused_kernel(xp_ref, h_ref, wh_ref, b_ref, newh_ref, acts_ref=None):
+    """Fused GRU step (hl_gpu_gru.cuh analog): both recurrent gemms + all
+    gate elementwise in one kernel, fp32 accumulation. Gate order matches
+    gru_cell: update z, reset r, candidate."""
+    xp = xp_ref[...].astype(jnp.float32)
+    h = h_ref[...].astype(jnp.float32)
+    wh = wh_ref[...].astype(jnp.float32)
+    b = b_ref[...].astype(jnp.float32)
+    hd = h.shape[1]
+    zr = xp[:, :2 * hd] + jax.lax.dot_general(
+        h, wh[:, :2 * hd], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) + b[:, :2 * hd]
+    z = jax.nn.sigmoid(zr[:, :hd])
+    r = jax.nn.sigmoid(zr[:, hd:])
+    c = jnp.tanh(xp[:, 2 * hd:] + jax.lax.dot_general(
+        r * h, wh[:, 2 * hd:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) + b[:, 2 * hd:])
+    newh_ref[...] = ((1.0 - z) * h + z * c).astype(newh_ref.dtype)
+    if acts_ref is not None:
+        acts_ref[...] = jnp.concatenate([z, r, c], axis=1)
+
+
+def _gru_fused_call(xp, h, w_h, bias, interpret, save_acts: bool):
+    B, H = h.shape
+    out_shape = [jax.ShapeDtypeStruct((B, H), xp.dtype)]
+    if save_acts:
+        out_shape.append(jax.ShapeDtypeStruct((B, 3 * H), jnp.float32))
+    out = pl.pallas_call(
+        _gru_fused_kernel,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(xp, h, w_h, bias.reshape(1, -1))
+    return out if save_acts else (out[0], None)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _fused_gru_cell(xp, h, w_h, bias, interpret):
+    new_h, _ = _gru_fused_call(xp, h, w_h, bias, interpret, save_acts=False)
+    return new_h
+
+
+def _fused_gru_fwd(xp, h, w_h, bias, interpret):
+    new_h, acts = _gru_fused_call(xp, h, w_h, bias, interpret,
+                                  save_acts=True)
+    return new_h, (h, w_h, acts, jnp.zeros((0,), xp.dtype),
+                   jnp.zeros((0,), bias.dtype))
+
+
+def _fused_gru_bwd(interpret, res, d_newh):
+    h, w_h, acts, xp_token, bias_token = res
+    H = h.shape[1]
+    z, r, c = acts[:, :H], acts[:, H:2 * H], acts[:, 2 * H:]
+    hf = h.astype(jnp.float32)
+    d_newh = d_newh.astype(jnp.float32)
+    dz = d_newh * (c - hf)
+    dc = d_newh * z
+    dh = d_newh * (1.0 - z)
+    dgc = dc * (1.0 - c * c)
+    d_rh = matmul(dgc, w_h[:, 2 * H:], trans_b=True)
+    dr = d_rh * hf
+    dh = dh + d_rh * r
+    dgz = dz * z * (1.0 - z)
+    dgr = dr * r * (1.0 - r)
+    dgzr = jnp.concatenate([dgz, dgr], axis=1)
+    dh = dh + matmul(dgzr, w_h[:, :2 * H], trans_b=True)
+    dgates = jnp.concatenate([dgzr, dgc], axis=1)
+    dwh = jnp.concatenate([
+        matmul(hf, dgzr, trans_a=True),
+        matmul((r * hf), dgc, trans_a=True),
+    ], axis=1).astype(w_h.dtype)
+    dxp = dgates.astype(xp_token.dtype)
+    db = jnp.sum(dgates, axis=0).astype(bias_token.dtype)
+    return dxp, dh.astype(h.dtype), dwh, db
+
+
+_fused_gru_cell.defvjp(_fused_gru_fwd, _fused_gru_bwd)
+
+
 # conservative per-kernel VMEM budget (bytes): w_h f32 + gates/acts/io all
 # resident at once; real v5e VMEM is ~16MB, leave headroom for the compiler
 _FUSED_VMEM_BUDGET = 10 * 1024 * 1024
 
 
+def _fused_vmem_ok(w_h, batch: int, rows_per_item: int) -> bool:
+    """Shared budget check: w_h (f32) + ``rows_per_item`` H-wide f32 rows
+    per batch element resident at once. LSTM: 4H gates in+out, 5H acts,
+    4H io = 17H; GRU: 3H xp + 3H zr/c stages + 3H acts + 2H h/out = 11H."""
+    return (w_h.size + batch * rows_per_item * w_h.shape[0]) * 4 \
+        <= _FUSED_VMEM_BUDGET
+
+
 def _use_fused(batch: int, w_h, gate_act, cell_act, out_act) -> bool:
-    if not (FLAGS.use_pallas and w_h is not None
+    return (FLAGS.use_pallas and w_h is not None
             and gate_act is jax.nn.sigmoid and cell_act is jnp.tanh
-            and out_act is jnp.tanh):
-        return False
-    hidden = w_h.shape[0]
-    need = (w_h.size + batch * (4 * hidden) * 2   # gates in/out
-            + batch * 5 * hidden                  # saved acts
-            + batch * 4 * hidden) * 4             # io tensors, f32
-    return need <= _FUSED_VMEM_BUDGET
+            and out_act is jnp.tanh
+            and _fused_vmem_ok(w_h, batch, 17))
 
 
 def lstm_scan(x: jax.Array, mask: jax.Array, w_x: Optional[jax.Array],
@@ -227,8 +308,8 @@ def lstm_scan(x: jax.Array, mask: jax.Array, w_x: Optional[jax.Array],
 
 def gru_scan(x: jax.Array, mask: jax.Array, w_x: Optional[jax.Array],
              w_h: jax.Array, bias: Optional[jax.Array], *,
-             reverse: bool = False,
-             init: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
+             reverse: bool = False, init: Optional[jax.Array] = None,
+             interpret: Optional[bool] = None) -> Tuple[jax.Array, jax.Array]:
     """Full-sequence GRU: x [B,T,D] -> (h_all [B,T,H], final_h).
     ``w_x=None`` means x is already [B,T,3H] (grumemory contract)."""
     B, T, _ = x.shape
@@ -236,9 +317,20 @@ def gru_scan(x: jax.Array, mask: jax.Array, w_x: Optional[jax.Array],
     xp = matmul(x, w_x) if w_x is not None else x  # [B, T, 3H]
     h0 = init if init is not None else jnp.zeros((B, H), xp.dtype)
 
+    fused = FLAGS.use_pallas and _fused_vmem_ok(w_h, B, 11)
+    if interpret is None:
+        from paddle_tpu.ops.kernel_util import interpret_default
+
+        interpret = interpret_default()
+    bias_arr = (bias if bias is not None
+                else jnp.zeros((3 * H,), jnp.float32)) if fused else bias
+
     def step(h, inp):
         xt, mt = inp
-        new_h = gru_cell(xt, h, w_h, bias)
+        if fused:
+            new_h = _fused_gru_cell(xt, h, w_h, bias_arr, interpret)
+        else:
+            new_h = gru_cell(xt, h, w_h, bias)
         m = mt[:, None].astype(new_h.dtype)
         new_h = m * new_h + (1 - m) * h
         return new_h, new_h

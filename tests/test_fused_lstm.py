@@ -96,3 +96,34 @@ def test_vmem_guard_falls_back_for_large_hidden():
     assert not rnn._use_fused(64, big_wh, jax.nn.sigmoid, jnp.tanh, jnp.tanh)
     small_wh = jnp.zeros((128, 4 * 128), jnp.float32)
     assert rnn._use_fused(64, small_wh, jax.nn.sigmoid, jnp.tanh, jnp.tanh)
+
+
+@pytest.mark.parametrize("reverse", [False, True])
+def test_fused_gru_matches_plain(rng, reverse):
+    B, T, D, H = 4, 6, 5, 8
+    x = jnp.asarray(rng.randn(B, T, D).astype(np.float32))
+    lengths = rng.randint(2, T + 1, size=B)
+    mask = jnp.asarray(np.arange(T)[None, :] < lengths[:, None])
+    w_x = jnp.asarray(rng.randn(D, 3 * H).astype(np.float32) * 0.3)
+    w_h = jnp.asarray(rng.randn(H, 3 * H).astype(np.float32) * 0.3)
+    bias = jnp.asarray(rng.randn(3 * H).astype(np.float32) * 0.1)
+
+    def loss(x, w_x, w_h, bias):
+        hs, _ = rnn.gru_scan(x, mask, w_x, w_h, bias, reverse=reverse)
+        return jnp.sum(jnp.tanh(hs))
+
+    old = FLAGS.use_pallas
+    try:
+        FLAGS.use_pallas = True
+        hs_f, fin_f = rnn.gru_scan(x, mask, w_x, w_h, bias, reverse=reverse)
+        g_f = jax.grad(loss, argnums=(0, 1, 2, 3))(x, w_x, w_h, bias)
+        FLAGS.use_pallas = False
+        hs_p, fin_p = rnn.gru_scan(x, mask, w_x, w_h, bias, reverse=reverse)
+        g_p = jax.grad(loss, argnums=(0, 1, 2, 3))(x, w_x, w_h, bias)
+    finally:
+        FLAGS.use_pallas = old
+    np.testing.assert_allclose(np.asarray(hs_f), np.asarray(hs_p), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(fin_f), np.asarray(fin_p),
+                               atol=1e-5)
+    for a, b in zip(g_f, g_p):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
