@@ -169,7 +169,9 @@ def worker_resnet50():
     kind = jax.devices()[0].device_kind
     peak = _peak_for(kind)
     achieved = flops / sec
+    extra = ({"batch_sweep_error": repr(first_err)} if first_err else {})
     print(json.dumps({
+        **extra,
         "resnet50_images_per_sec_per_chip": round(batch / sec, 1),
         "resnet50_ms_per_batch": round(sec * 1000, 2),
         "resnet50_achieved_tflops": round(achieved / 1e12, 2),

@@ -52,7 +52,10 @@ def build(word_dict_len: int = 4000, label_dict_len: int = 67,
         mix = layer.fc(input=feat, size=hidden_dim * 4, name=f"srl_in{i}")
         lstm = layer.lstmemory(input=mix, size=hidden_dim,
                                reverse=(i % 2 == 1), name=f"srl_lstm{i}")
-        feat = [feat[0], lstm]
+        # thread the PER-LAYER mix forward (db_lstm re-binds input_tmp =
+        # [mix_hidden, lstm] each layer): layer i+1 and the emission fc
+        # consume layer i's mixed projection, not the depth-0 hidden
+        feat = [mix, lstm]
 
     emission = layer.fc(input=feat, size=label_dict_len, name="srl_emission")
     shared_crf = ParamAttr(name="srl_crf")

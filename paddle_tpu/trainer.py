@@ -212,8 +212,8 @@ class SGD:
     def train(self, reader=None, num_passes: int = 1, event_handler=None,
               feeding=None, test_reader=None, save_dir: Optional[str] = None,
               start_pass: int = 0, saving_period: int = 1, master=None,
-              record_parser=None, heartbeat_ttl_s: Optional[float] = None
-              ) -> None:
+              record_parser=None, heartbeat_ttl_s: Optional[float] = None,
+              prefetch: int = 0) -> None:
         """``save_dir``/``start_pass``/``saving_period`` are the
         --save_dir/--start_pass/--saving_period flags of the reference
         trainer (ParamUtil.h:77-111): checkpoints (params + optimizer
@@ -286,9 +286,21 @@ class SGD:
                         pass_metrics[k].extend(np.asarray(jnp.stack(buf)).tolist())
                         buf.clear()
 
-            for batch_id, data_batch in enumerate(reader()):
+            if prefetch > 0:
+                # device-resident double buffering: feed conversion + the
+                # host->device transfer of batch k+1 overlap batch k's
+                # compute (the async DataProvider pool analog)
+                from paddle_tpu.reader.prefetch import device_prefetch
+
+                feed_it = device_prefetch(
+                    reader(), size=prefetch, transform=feeder.feed,
+                    place=self._shard_feeds if self.mesh is not None
+                    else None)
+            else:
+                feed_it = (self._shard_feeds(feeder.feed(b))
+                           for b in reader())
+            for batch_id, feeds in enumerate(feed_it):
                 event_handler(v2_event.BeginIteration(pass_id, batch_id))
-                feeds = self._shard_feeds(feeder.feed(data_batch))
                 self._rng, key = jax.random.split(self._rng)
                 with stats.timer("trainOneBatch"):
                     loss, params, opt_state, mstate, metric_vals = self._step_fn(

@@ -359,3 +359,57 @@ def test_download_smoke(tmp_path, monkeypatch):
                            mnist.TEST_LABEL[1])
     assert os.path.exists(path)
     assert common.md5file(path) == mnist.TEST_LABEL[1]
+
+
+# ---------------------------------------------------------------------------
+# device-prefetch pipeline
+# ---------------------------------------------------------------------------
+
+
+def test_device_prefetch_matches_sequential():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import layer, optimizer, trainer
+    from paddle_tpu.reader.prefetch import device_prefetch
+
+    rng = np.random.RandomState(0)
+    batches = [[(rng.randn(8).astype(np.float32), int(rng.randint(2)))
+                for _ in range(16)] for _ in range(6)]
+
+    def run(prefetch):
+        paddle.topology.reset_name_scope()
+        x = layer.data(name="x", type=paddle.data_type.dense_vector(8))
+        y = layer.data(name="y", type=paddle.data_type.integer_value(2))
+        cost = layer.classification_cost(
+            input=layer.fc(input=x, size=2), label=y)
+        params = paddle.Parameters.from_topology(
+            paddle.topology.Topology([cost]), seed=3)
+        sgd = trainer.SGD(cost=cost, parameters=params,
+                          update_equation=optimizer.Momentum(
+                              momentum=0.9, learning_rate=0.1))
+        sgd.train(lambda: iter(list(batches)), num_passes=2,
+                  prefetch=prefetch)
+        return {k: np.asarray(sgd.parameters[k])
+                for k in sgd.parameters.names()}
+
+    ref = run(0)
+    got = run(2)
+    for k in ref:
+        np.testing.assert_array_equal(got[k], ref[k], err_msg=k)
+
+
+def test_device_prefetch_propagates_reader_errors():
+    import numpy as np
+    import pytest as _pytest
+
+    from paddle_tpu.reader.prefetch import device_prefetch
+
+    def bad_iter():
+        yield {"x": np.zeros((2, 2), np.float32)}
+        raise RuntimeError("boom")
+
+    it = device_prefetch(bad_iter(), size=1)
+    next(it)
+    with _pytest.raises(RuntimeError, match="boom"):
+        list(it)
