@@ -1629,6 +1629,79 @@ def _per_example(fn_dense, value, *args):
 
 
 @_export
+def multi_head_attention(query, key=None, value=None, *, num_heads: int,
+                         size: int = None, causal: bool = False,
+                         name: Optional[str] = None, param_attr=None,
+                         layer_attr=None) -> LayerOutput:
+    """Multi-head (flash) attention over packed variable-length sequences —
+    the long-context extension of the reference's attention helpers
+    (networks.py:1304 simple_attention, :1402 dot_product_attention),
+    built on the blockwise pallas kernel (ops/attention.py).
+
+    Sequence inputs ride the packed SequenceBatch form: segment ids ARE
+    the attention mask (tokens never attend across sequences — the
+    padding-free Argument.sequenceStartPositions capability), so no
+    [B, T, T] mask is ever materialised. ``causal=True`` adds
+    per-sequence causal masking (positions are absolute in the packed
+    buffer, combined with segment ids). key/value default to query
+    (self-attention); pass an encoder sequence for cross-attention."""
+    q_in = query
+    k_in = key if key is not None else query
+    v_in = value if value is not None else k_in
+    _need_seq(q_in, "multi_head_attention")
+    _need_seq(k_in, "multi_head_attention")
+    _need_seq(v_in, "multi_head_attention")
+    # causal masking uses absolute positions in the packed buffer; two
+    # independently packed buffers have incomparable positions, so causal
+    # cross-attention would silently mask wrong keys
+    enforce_that(not (causal and key is not None),
+                 "causal=True is self-attention only (packed positions "
+                 "are incomparable across different key/query buffers)",
+                 context="multi_head_attention")
+    size = size or q_in.size
+    enforce_that(size % num_heads == 0,
+                 f"num_heads {num_heads} must divide size {size}",
+                 context="multi_head_attention")
+    name = name or unique_name("mha")
+    attr = ParamAttr.to_attr(param_attr)
+    params = {
+        "wq": ParamSpec((q_in.size, size), attr),
+        "wk": ParamSpec((k_in.size, size), attr),
+        "wv": ParamSpec((v_in.size, size), attr),
+        "wo": ParamSpec((size, size), attr),
+    }
+    head_dim = size // num_heads
+
+    def compute(ctx, p, ins):
+        from paddle_tpu.ops import attention as pattn
+
+        qs, ks, vs = ins[0], ins[1], ins[2]
+        cap_q, cap_k = qs.capacity, ks.capacity
+        enforce_that(vs.capacity == cap_k,
+                     f"key/value capacities differ ({cap_k} vs "
+                     f"{vs.capacity}) — they must come from the same "
+                     "feeder bucket", context="multi_head_attention")
+        q = pmath.matmul(qs.data, p["wq"]).reshape(1, cap_q, num_heads,
+                                                   head_dim)
+        k = pmath.matmul(ks.data, p["wk"]).reshape(1, cap_k, num_heads,
+                                                   head_dim)
+        v = pmath.matmul(vs.data, p["wv"]).reshape(1, cap_k, num_heads,
+                                                   head_dim)
+        out = pattn.flash_attention(
+            q, k, v, segment_ids=qs.segment_ids[None, :],
+            kv_segment_ids=ks.segment_ids[None, :], causal=causal,
+            block_q=min(128, cap_q), block_k=min(128, cap_k))
+        y = pmath.matmul(out.reshape(cap_q, size), p["wo"])
+        y = qs.with_data(y)
+        return _apply_extra(ctx, name, y, layer_attr)
+
+    node = LayerOutput(name=name, layer_type="multi_head_attention",
+                       inputs=[q_in, k_in, v_in], fn=compute, params=params,
+                       size=size, is_sequence=True)
+    return node
+
+
+@_export
 class BeamInput:
     """One beam expansion for cross_entropy_over_beam (reference:
     trainer_config_helpers/layers.py BeamInput): candidate scores over the
