@@ -68,6 +68,25 @@ def _apply_act(activation, value):
     return fn(value)
 
 
+@jax.custom_vjp
+def _clip_error(x, threshold):
+    return x
+
+
+def _clip_error_fwd(x, threshold):
+    return x, threshold
+
+
+def _clip_error_bwd(threshold, g):
+    # identity forward, clipped backward: the reference's per-layer
+    # error_clipping_threshold (Layer.cpp backwardActivation clips the
+    # output-grad to [-t, t] before it propagates)
+    return jnp.clip(g, -threshold, threshold), None
+
+
+_clip_error.defvjp(_clip_error_fwd, _clip_error_bwd)
+
+
 def _apply_extra(ctx: Context, name: str, value, layer_attr: Optional[ExtraAttr]):
     attr = ExtraAttr.to_attr(layer_attr)
     if attr.drop_rate > 0.0:
@@ -89,6 +108,16 @@ def _apply_extra(ctx: Context, name: str, value, layer_attr: Optional[ExtraAttr]
                 jax.lax.with_sharding_constraint(value.data, ns))
         else:
             value = jax.lax.with_sharding_constraint(value, ns)
+    if attr.error_clipping_threshold > 0.0:
+        # LAST in forward order = FIRST in backward: the raw upstream
+        # gradient is clipped before dropout's 1/(1-p) rescale, matching
+        # the reference (Layer.cpp backwardActivation clips the incoming
+        # output-grad before any other backward work)
+        t = float(attr.error_clipping_threshold)
+        if isinstance(value, SequenceBatch):
+            value = value.with_data(_clip_error(value.data, t))
+        else:
+            value = _clip_error(value, t)
     return value
 
 
@@ -1654,7 +1683,7 @@ def multi_head_attention(query, key=None, value=None, *, num_heads: int,
     # causal masking uses absolute positions in the packed buffer; two
     # independently packed buffers have incomparable positions, so causal
     # cross-attention would silently mask wrong keys
-    enforce_that(not (causal and key is not None),
+    enforce_that(not (causal and key is not None and key is not query),
                  "causal=True is self-attention only (packed positions "
                  "are incomparable across different key/query buffers)",
                  context="multi_head_attention")
@@ -1689,8 +1718,7 @@ def multi_head_attention(query, key=None, value=None, *, num_heads: int,
                                                    head_dim)
         out = pattn.flash_attention(
             q, k, v, segment_ids=qs.segment_ids[None, :],
-            kv_segment_ids=ks.segment_ids[None, :], causal=causal,
-            block_q=min(128, cap_q), block_k=min(128, cap_k))
+            kv_segment_ids=ks.segment_ids[None, :], causal=causal)
         y = pmath.matmul(out.reshape(cap_q, size), p["wo"])
         y = qs.with_data(y)
         return _apply_extra(ctx, name, y, layer_attr)

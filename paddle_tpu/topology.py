@@ -245,7 +245,10 @@ class Topology:
             node_params = {p: params[self.param_key(node, p)] for p in node.params}
             ins = [values[i.name] for i in node.inputs]
             ctx._current = node.name
-            values[node.name] = node.fn(ctx, node_params, ins)
+            # named_scope: layer names show up in xplane/profiler traces
+            # (the REGISTER_TIMER-per-layer analog, NeuralNetwork.cpp:259)
+            with jax.named_scope(node.name):
+                values[node.name] = node.fn(ctx, node_params, ins)
         new_state = dict(state)
         for ns, slots in ctx.state_out.items():
             # per-slot merge: a node updating one slot must not drop the

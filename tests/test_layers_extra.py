@@ -321,3 +321,37 @@ def test_nms_suppresses_overlaps():
     keep, ok = pdet.nms(boxes, scores, iou_threshold=0.5, max_keep=3)
     kept = set(np.asarray(keep)[np.asarray(ok)].tolist())
     assert kept == {0, 2}
+
+
+def test_error_clipping_threshold_clips_backward():
+    """ExtraAttr.error_clipping_threshold: identity forward, clipped
+    backward (reference Layer.cpp backwardActivation error clipping)."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu import layer
+
+    paddle.topology.reset_name_scope()
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    h = layer.fc(input=x, size=4, act=None, bias_attr=False,
+                 param_attr=paddle.attr.ParamAttr(initializer=lambda key, shape, dtype: jnp.eye(4)),
+                 layer_attr=paddle.attr.ExtraAttr(error_clipping_threshold=0.5))
+    cost = layer.sum_cost(input=h)
+    topo = paddle.topology.Topology([cost])
+    params = paddle.Parameters.from_topology(topo, seed=0)
+
+    def loss(p, scale):
+        outs, _ = topo.forward(p, {}, {"x": jnp.ones((2, 4)) * scale},
+                               train=True)
+        return jnp.sum(outs[0]) * scale
+
+    g = jax.grad(lambda p: loss(p, 10.0))(params.as_dict())
+    w_grad = g[[k for k in g if k.endswith(".w0")][0]]
+    # upstream grad is 10 per element; clipped to 0.5 before the matmul
+    # backward -> |dW| <= 0.5 * sum(|x|) = 0.5 * 2 * 10
+    assert float(jnp.max(jnp.abs(w_grad))) <= 0.5 * 2 * 10 + 1e-5
+    # forward value unchanged by the clip
+    outs, _ = topo.forward(params.as_dict(), {}, {"x": jnp.ones((2, 4))},
+                           train=False)
+    assert float(jnp.sum(outs[0])) == 8.0
