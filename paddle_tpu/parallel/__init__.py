@@ -6,7 +6,7 @@ optimizer-state sharding; sparse remote tables → row-sharded embeddings with
 all_to_all; LightNetwork/RDMA → XLA collectives over ICI/DCN.
 """
 
-from paddle_tpu.parallel.mesh import (make_mesh, data_parallel_mesh,
+from paddle_tpu.parallel.mesh import (make_mesh, data_parallel_mesh, hybrid_mesh,
                                       mesh_axis_names)
 from paddle_tpu.parallel.api import (shard_batch, replicate, param_sharding,
                                      DataParallel)

@@ -36,5 +36,40 @@ def data_parallel_mesh(num: Optional[int] = None):
     return make_mesh((n,), ("data",), devs)
 
 
+def hybrid_mesh(ici_shape: Sequence[int], dcn_shape: Sequence[int],
+                axis_names: Sequence[str], devices=None):
+    """Multi-slice mesh: per-axis size = dcn * ici, devices laid out so the
+    DCN factor spans slices and the ICI factor stays within a slice —
+    collectives along an axis then prefer ICI hops and cross DCN only at
+    slice granularity (the pserver-fleet-over-network analog, rebuilt on
+    jax mesh_utils). Falls back to a plain reshape when the platform
+    exposes no slice topology (CPU tests / single slice)."""
+    import jax
+
+    enforce_that(len(ici_shape) == len(dcn_shape) == len(axis_names),
+                 "ici_shape/dcn_shape/axis_names must have the same rank",
+                 context="hybrid_mesh")
+    devs = list(devices) if devices is not None else pdevice.devices()
+    has_slice_topology = all(
+        getattr(d, "slice_index", None) is not None for d in devs)
+    if has_slice_topology:
+        # real multi-slice hardware: config errors must propagate, not
+        # degrade into a topology-blind layout
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_hybrid_device_mesh(
+            tuple(ici_shape), tuple(dcn_shape), devices=devs)
+    else:
+        # no slice topology exposed (CPU tests / single slice): plain
+        # reshape — every hop is equivalent anyway
+        shape = tuple(int(i) * int(d) for i, d in zip(ici_shape, dcn_shape))
+        n = int(np.prod(shape))
+        enforce_that(n <= len(devs),
+                     f"hybrid mesh {shape} needs {n} devices, have "
+                     f"{len(devs)}", context="hybrid_mesh")
+        arr = np.asarray(devs[:n]).reshape(shape)
+    return jax.sharding.Mesh(arr, tuple(axis_names))
+
+
 def mesh_axis_names(mesh) -> Tuple[str, ...]:
     return tuple(mesh.axis_names)

@@ -37,8 +37,35 @@ def init(**kwargs) -> None:
     ``paddle.init(use_gpu=..., trainer_count=...)`` analog. Keyword args are
     flag overrides (see platform.flags); mesh construction reads ``mesh_shape``
     / ``mesh_axes``. Safe to call more than once — later calls rebuild the mesh.
+
+    Multi-host: pass ``coordinator_address=`` (plus optional
+    ``num_processes=``/``process_id=``) to join a multi-host job via
+    JAX's coordination service — the etcd-registration analog
+    (go/pserver/etcd_client.go:67-166); afterwards jax.devices() spans
+    every host and meshes/collectives ride ICI within a slice and DCN
+    across (see parallel.mesh.hybrid_mesh).
     """
     import jax  # deferred so flag 'platform' can take effect first
+
+    coord = kwargs.pop("coordinator_address", None)
+    nproc = kwargs.pop("num_processes", None)
+    pid = kwargs.pop("process_id", None)
+    enforce_that(coord is not None or (nproc is None and pid is None),
+                 "num_processes/process_id need coordinator_address= — "
+                 "refusing to silently run single-host", context="init")
+    if coord is not None:
+        prev = _state.get("distributed")
+        enforce_that(prev is None or prev == coord,
+                     f"jax.distributed already initialized against {prev}; "
+                     f"cannot re-initialize against {coord}", context="init")
+        if prev is None:
+            dist_kw = {"coordinator_address": coord}
+            if nproc is not None:
+                dist_kw["num_processes"] = int(nproc)
+            if pid is not None:
+                dist_kw["process_id"] = int(pid)
+            jax.distributed.initialize(**dist_kw)
+            _state["distributed"] = coord
 
     FLAGS.update(**kwargs)
     if FLAGS.platform:
