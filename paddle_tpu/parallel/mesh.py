@@ -50,6 +50,15 @@ def hybrid_mesh(ici_shape: Sequence[int], dcn_shape: Sequence[int],
                  "ici_shape/dcn_shape/axis_names must have the same rank",
                  context="hybrid_mesh")
     devs = list(devices) if devices is not None else pdevice.devices()
+    # BOTH branches require the exact device count (create_hybrid_device_
+    # mesh does; the fallback must not be laxer, or CPU-validated configs
+    # would fail only on real hardware). Pass devices= for a sub-mesh.
+    shape = tuple(int(i) * int(d) for i, d in zip(ici_shape, dcn_shape))
+    n = int(np.prod(shape))
+    enforce_that(n == len(devs),
+                 f"hybrid mesh {shape} needs exactly {n} devices, got "
+                 f"{len(devs)} (pass devices= to build a sub-mesh)",
+                 context="hybrid_mesh")
     has_slice_topology = all(
         getattr(d, "slice_index", None) is not None for d in devs)
     if has_slice_topology:
@@ -62,12 +71,7 @@ def hybrid_mesh(ici_shape: Sequence[int], dcn_shape: Sequence[int],
     else:
         # no slice topology exposed (CPU tests / single slice): plain
         # reshape — every hop is equivalent anyway
-        shape = tuple(int(i) * int(d) for i, d in zip(ici_shape, dcn_shape))
-        n = int(np.prod(shape))
-        enforce_that(n <= len(devs),
-                     f"hybrid mesh {shape} needs {n} devices, have "
-                     f"{len(devs)}", context="hybrid_mesh")
-        arr = np.asarray(devs[:n]).reshape(shape)
+        arr = np.asarray(devs).reshape(shape)
     return jax.sharding.Mesh(arr, tuple(axis_names))
 
 

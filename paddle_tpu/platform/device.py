@@ -64,7 +64,17 @@ def init(**kwargs) -> None:
                 dist_kw["num_processes"] = int(nproc)
             if pid is not None:
                 dist_kw["process_id"] = int(pid)
-            jax.distributed.initialize(**dist_kw)
+            try:
+                jax.distributed.initialize(**dist_kw)
+            except RuntimeError as e:
+                # most common cause: some paddle/jax API already touched
+                # the backend (jax.devices() etc.) — surface the ordering
+                # requirement instead of the deep-JAX error
+                raise EnforceError(
+                    "paddle.init(coordinator_address=...) must be the "
+                    "FIRST paddle/jax call in the process (the JAX "
+                    f"backend is already initialized): {e}",
+                    context="init") from e
             _state["distributed"] = coord
 
     FLAGS.update(**kwargs)

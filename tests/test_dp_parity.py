@@ -80,35 +80,14 @@ def test_mesh2x4_dp_axis_matches_single_device():
 def test_hybrid_mesh_dp_parity():
     """2-slice x 4-chip hybrid 'data' mesh trains identically to a single
     device (the multi-slice DCN analog on the virtual mesh fallback)."""
-    import numpy as np
-
-    import paddle_tpu as paddle
-    from paddle_tpu import layer, optimizer, trainer
     from paddle_tpu.parallel import hybrid_mesh
 
     mesh = hybrid_mesh((4,), (2,), ("data",))
     assert tuple(mesh.devices.shape) == (8,)
-    rng = np.random.RandomState(0)
-    batches = [[(rng.randn(8).astype(np.float32), int(rng.randint(2)))
-                for _ in range(16)] for _ in range(4)]
-
-    def run(m):
-        paddle.topology.reset_name_scope()
-        x = layer.data(name="x", type=paddle.data_type.dense_vector(8))
-        y = layer.data(name="y", type=paddle.data_type.integer_value(2))
-        cost = layer.classification_cost(
-            input=layer.fc(input=x, size=2), label=y)
-        params = paddle.Parameters.from_topology(
-            paddle.topology.Topology([cost]), seed=1)
-        sgd = trainer.SGD(cost=cost, parameters=params,
-                          update_equation=optimizer.Momentum(
-                              momentum=0.9, learning_rate=0.1), mesh=m)
-        sgd.train(lambda: iter(list(batches)), num_passes=1)
-        return {k: np.asarray(sgd.parameters[k])
-                for k in sgd.parameters.names()}
-
-    ref = run(None)
-    got = run(mesh)
+    opt = lambda: optimizer.Momentum(momentum=0.9, learning_rate=0.05)
+    batches = _batches(3, n_batches=4)
+    ref = _train(None, batches, opt)
+    got = _train(mesh, batches, opt)
     for k in ref:
-        np.testing.assert_allclose(got[k], ref[k], rtol=1e-5, atol=1e-6,
+        np.testing.assert_allclose(got[k], ref[k], rtol=2e-5, atol=2e-6,
                                    err_msg=k)
