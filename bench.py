@@ -210,19 +210,33 @@ def worker_lstm():
     paddle = _init_paddle()
     from paddle_tpu.models import text_lstm
 
+    from paddle_tpu.platform.flags import FLAGS
+
     batch, seq_len, hidden = 64, 100, 512
-    paddle.topology.reset_name_scope()
-    words, label, logits, cost = text_lstm.build(hidden=hidden)
-    topo = paddle.topology.Topology([cost])
-    params = paddle.Parameters.from_topology(topo, seed=0)
-    sgd = _make_sgd(cost, params)
     rng = np.random.RandomState(0)
-    samples = [(rng.randint(0, 30000, size=seq_len).tolist(),
-                int(rng.randint(2))) for _ in range(batch)]
-    feeds = sgd._make_feeder(None).feed(samples)
-    sec = _time_steps(sgd._build_step(), _step_args(sgd, feeds), iters=20)
-    print(json.dumps({"lstm_ms_per_batch": round(sec * 1000, 3),
-                      "lstm_config": f"h={hidden} bs={batch} seq={seq_len}"}))
+
+    def measure(use_pallas):
+        FLAGS.use_pallas = use_pallas
+        paddle.topology.reset_name_scope()
+        words, label, logits, cost = text_lstm.build(hidden=hidden)
+        topo = paddle.topology.Topology([cost])
+        params = paddle.Parameters.from_topology(topo, seed=0)
+        sgd = _make_sgd(cost, params)
+        samples = [(rng.randint(0, 30000, size=seq_len).tolist(),
+                    int(rng.randint(2))) for _ in range(batch)]
+        feeds = sgd._make_feeder(None).feed(samples)
+        return _time_steps(sgd._build_step(), _step_args(sgd, feeds),
+                           iters=20)
+
+    sec_plain = measure(False)
+    sec_fused = measure(True)
+    # headline = the shipping default path (use_pallas on)
+    sec = sec_fused
+    print(json.dumps({
+        "lstm_ms_per_batch": round(sec * 1000, 3),
+        "lstm_fused_pallas_ms": round(sec_fused * 1000, 3),
+        "lstm_plain_xla_ms": round(sec_plain * 1000, 3),
+        "lstm_config": f"h={hidden} bs={batch} seq={seq_len}"}))
 
 
 def worker_attention():
