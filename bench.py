@@ -215,7 +215,7 @@ def worker_lstm():
     batch, seq_len, hidden = 64, 100, 512
     rng = np.random.RandomState(0)
 
-    def measure(use_pallas):
+    def measure(use_pallas, iters=20):
         FLAGS.use_pallas = use_pallas
         paddle.topology.reset_name_scope()
         words, label, logits, cost = text_lstm.build(hidden=hidden)
@@ -226,17 +226,21 @@ def worker_lstm():
                     int(rng.randint(2))) for _ in range(batch)]
         feeds = sgd._make_feeder(None).feed(samples)
         return _time_steps(sgd._build_step(), _step_args(sgd, feeds),
-                           iters=20)
+                           iters=iters)
 
-    sec_plain = measure(False)
+    # headline (shipping default, use_pallas on) FIRST; the plain-XLA
+    # comparison is diagnostic and must never gate the headline
     sec_fused = measure(True)
-    # headline = the shipping default path (use_pallas on)
-    sec = sec_fused
-    print(json.dumps({
-        "lstm_ms_per_batch": round(sec * 1000, 3),
+    out = {
+        "lstm_ms_per_batch": round(sec_fused * 1000, 3),
         "lstm_fused_pallas_ms": round(sec_fused * 1000, 3),
-        "lstm_plain_xla_ms": round(sec_plain * 1000, 3),
-        "lstm_config": f"h={hidden} bs={batch} seq={seq_len}"}))
+        "lstm_config": f"h={hidden} bs={batch} seq={seq_len}",
+    }
+    try:
+        out["lstm_plain_xla_ms"] = round(measure(False, iters=8) * 1000, 3)
+    except Exception as e:
+        out["lstm_plain_xla_error"] = repr(e)
+    print(json.dumps(out))
 
 
 def worker_attention():
@@ -371,8 +375,8 @@ def worker_scaling():
 
     devs = jax.devices()
     assert len(devs) >= 8, f"need 8 virtual devices, have {len(devs)}"
-    t1 = build_and_time(None, iters=3)
-    t8 = build_and_time(make_mesh((8,), ("data",), devs[:8]), iters=3)
+    t1 = build_and_time(None, iters=2)
+    t8 = build_and_time(make_mesh((8,), ("data",), devs[:8]), iters=2)
     print(json.dumps({
         "scaling_virtual8": {
             "model": f"resnet{depth}_img{img}_bs{batch}",
