@@ -143,3 +143,60 @@ def test_cloud_reader_inproc(dataset):
     got = list(reader())
     assert sorted(got) == sorted(
         f"rec-{i}-{j}".encode() for i in range(2) for j in range(10))
+
+
+def test_concurrent_trainers_consume_each_record_once(tmp_path):
+    """4 trainer threads over ONE TCP master: every record of the pass is
+    delivered exactly once across the fleet (the reference's multi-trainer
+    dispatch invariant, go/master/service.go todo/pending/done)."""
+    import threading
+
+    paths = []
+    for i in range(3):
+        p = str(tmp_path / f"c{i}.rio")
+        recordio_write(p, [f"r-{i}-{j}".encode() for j in range(40)])
+        paths.append(p)
+
+    # pin BOTH leases long: the trainer TTL below and the task timeout
+    # here — a CI pause past the default 60s task lease would requeue a
+    # held task and spuriously fail the exactly-once assertion
+    svc = Service(chunks_per_task=7, timeout_s=1e6)
+    srv = MasterServer(service=svc).start()
+    try:
+        boot = MasterClient(srv.address)
+        boot.set_dataset(paths)
+        boot.close()
+
+        got = []
+        lock = threading.Lock()
+        errs = []
+
+        def worker():
+            try:
+                c = MasterClient(srv.address)
+                c.register(ttl_s=1e6)
+                while True:
+                    rec = c.next_record()
+                    if rec is None:
+                        break
+                    with lock:
+                        got.append(rec)
+                c.close()
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive(), "worker thread hung"
+        assert not errs, errs
+        want = sorted(f"r-{i}-{j}".encode() for i in range(3)
+                      for j in range(40))
+        assert sorted(got) == want, (
+            f"{len(got)} records delivered, {len(want)} expected "
+            "(duplicates or losses under concurrency)")
+    finally:
+        srv.stop()
