@@ -148,6 +148,35 @@ def worker_resnet50():
         sec = _time_steps(step, args, iters=iters)
         return sec, flops
 
+    kind = jax.devices()[0].device_kind
+    peak = _peak_for(kind)
+
+    def emit(results, first_err):
+        batch, (sec, flops) = max(
+            results.items(), key=lambda kv: kv[0] / kv[1][0])
+        flops_source = "xla_cost_analysis"
+        if flops is None:
+            # analytic: ResNet-50 fwd ~4.09 GFLOP/img (2*MACs); ~3x train
+            flops = 3 * 4.089e9 * batch
+            flops_source = "analytic"
+        achieved = flops / sec
+        extra = ({"batch_sweep_error": repr(first_err)} if first_err else {})
+        print(json.dumps({
+            **extra,
+            "resnet50_images_per_sec_per_chip": round(batch / sec, 1),
+            "resnet50_ms_per_batch": round(sec * 1000, 2),
+            "resnet50_achieved_tflops": round(achieved / 1e12, 2),
+            "resnet50_mfu": round(achieved / peak, 4),
+            "resnet50_flops_per_step": flops,
+            "flops_source": flops_source,
+            "device_kind": kind,
+            "peak_tflops_assumed": peak / 1e12,
+            "batch": batch,
+            "batch_sweep": {str(b): round(b / s, 1)
+                            for b, (s, _) in results.items()},
+            "feed_layout": "NHWC device-resident",
+        }), flush=True)
+
     results = {}
     first_err = None
     for batch in (128, 256):
@@ -156,35 +185,13 @@ def worker_resnet50():
         except Exception as e:  # keep the smaller-batch result if any
             first_err = e
             break
+        # print after EVERY successful size: a hang in the next sweep
+        # step can only lose the sweep, never the measured headline
+        emit(results, first_err)
     if not results:
         raise first_err  # surface the root cause, not an empty-max error
-    batch, (sec, flops) = max(
-        results.items(), key=lambda kv: kv[0] / kv[1][0])
-    flops_source = "xla_cost_analysis"
-    if flops is None:
-        # analytic: ResNet-50 fwd ~4.09 GFLOP/img (2*MACs); train ~3x fwd
-        flops = 3 * 4.089e9 * batch
-        flops_source = "analytic"
-
-    kind = jax.devices()[0].device_kind
-    peak = _peak_for(kind)
-    achieved = flops / sec
-    extra = ({"batch_sweep_error": repr(first_err)} if first_err else {})
-    print(json.dumps({
-        **extra,
-        "resnet50_images_per_sec_per_chip": round(batch / sec, 1),
-        "resnet50_ms_per_batch": round(sec * 1000, 2),
-        "resnet50_achieved_tflops": round(achieved / 1e12, 2),
-        "resnet50_mfu": round(achieved / peak, 4),
-        "resnet50_flops_per_step": flops,
-        "flops_source": flops_source,
-        "device_kind": kind,
-        "peak_tflops_assumed": peak / 1e12,
-        "batch": batch,
-        "batch_sweep": {str(b): round(b / s, 1)
-                        for b, (s, _) in results.items()},
-        "feed_layout": "NHWC device-resident",
-    }))
+    if first_err is not None:
+        emit(results, first_err)
 
 
 def worker_alexnet():
@@ -419,6 +426,20 @@ WORKERS = {
 # ---------------------------------------------------------------------------
 
 
+def _last_json_line(text):
+    """Parse the last JSON object line from worker stdout (or None)."""
+    if isinstance(text, bytes):
+        text = text.decode(errors="ignore")
+    for line in reversed((text or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                pass
+    return None
+
+
 def _run_worker(name, deadline, cpu=False, attempt_timeout=420,
                 max_attempts=3):
     """Run one worker in a subprocess with retry/backoff under the global
@@ -445,31 +466,27 @@ def _run_worker(name, deadline, cpu=False, attempt_timeout=420,
         except subprocess.TimeoutExpired as te:
             # salvage a partial result: workers print their headline JSON
             # early (before diagnostics) exactly so a later hang doesn't
-            # lose the measurement
-            partial = te.stdout
-            if isinstance(partial, bytes):
-                partial = partial.decode(errors="ignore")
-            for line in reversed((partial or "").strip().splitlines()):
-                line = line.strip()
-                if line.startswith("{"):
-                    try:
-                        return json.loads(line), None
-                    except json.JSONDecodeError:
-                        pass
+            # lose the measurement — but MARK the run as cut short
+            got = _last_json_line(te.stdout)
+            if got is not None:
+                got["salvaged_after"] = "timeout"
+                return got, None
             last_err = f"{name}: timeout (attempt {attempt})"
             if attempt >= max_attempts:
                 return None, last_err
             continue
         if r.returncode == 0:
-            for line in reversed(r.stdout.strip().splitlines()):
-                line = line.strip()
-                if line.startswith("{"):
-                    try:
-                        return json.loads(line), None
-                    except json.JSONDecodeError:
-                        pass
+            got = _last_json_line(r.stdout)
+            if got is not None:
+                return got, None
             last_err = f"{name}: no JSON in output"
         else:
+            # a crash AFTER the early headline print still keeps the
+            # measurement (annotated) instead of burning retries
+            got = _last_json_line(r.stdout)
+            if got is not None:
+                got["salvaged_after"] = f"rc={r.returncode}"
+                return got, None
             tail = (r.stderr or r.stdout or "").strip().splitlines()[-3:]
             last_err = f"{name}: rc={r.returncode} {' | '.join(tail)}"
         if attempt >= max_attempts:
