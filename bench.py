@@ -31,7 +31,7 @@ import subprocess
 import sys
 import time
 
-GLOBAL_DEADLINE_S = 560.0
+GLOBAL_DEADLINE_S = 780.0
 ALEXNET_BASELINE_MS = 334.0   # reference Paddle, AlexNet bs=128, K40m
 LSTM_BASELINE_MS = 184.0      # reference Paddle, IMDB LSTM h=512 bs=64, K40m
 
@@ -254,6 +254,51 @@ def worker_lstm():
     print(json.dumps(out))
 
 
+def worker_transformer():
+    """Decoder-only transformer LM (models/transformer.py): tokens/sec and
+    MFU. The high-MFU headline: all FLOPs are large bf16 MXU matmuls, so
+    this is where the framework's compute efficiency shows without the
+    HBM-roofline ceiling that bounds ResNet-50's BN traffic (BENCH_NOTES)."""
+    import jax
+    import numpy as np
+
+    paddle = _init_paddle()
+    from paddle_tpu.models import transformer
+
+    vocab, d, layers, heads, seq, bs = 32768, 2048, 8, 16, 1024, 8
+    rng = np.random.RandomState(0)
+    paddle.topology.reset_name_scope()
+    tokens, pos, target, logits, cost = transformer.build(
+        vocab_size=vocab, d_model=d, n_layers=layers, n_heads=heads,
+        max_len=seq)
+    topo = paddle.topology.Topology([cost])
+    params = paddle.Parameters.from_topology(topo, seed=0)
+    sgd = _make_sgd(cost, params)
+    samples = []
+    for _ in range(bs):
+        t = rng.randint(0, vocab, size=seq)
+        samples.append((t.tolist(), list(range(seq)),
+                        np.roll(t, -1).tolist()))
+    feeds = sgd._make_feeder({"tokens": 0, "pos": 1, "target": 2}).feed(samples)
+    step = sgd._build_step()
+    args = _step_args(sgd, feeds)
+    flops = _compiled_flops(step, args)
+    sec = _time_steps(step, args, iters=6)
+    n_tokens = bs * seq
+    kind = jax.devices()[0].device_kind
+    peak = _peak_for(kind)
+    out = {
+        "transformer_tokens_per_sec": round(n_tokens / sec, 1),
+        "transformer_ms_per_batch": round(sec * 1000, 2),
+        "transformer_config": f"d{d} L{layers} h{heads} seq{seq} bs{bs} "
+                              f"vocab{vocab}",
+    }
+    if flops:
+        out["transformer_mfu"] = round(flops / sec / peak, 4)
+        out["transformer_achieved_tflops"] = round(flops / sec / 1e12, 2)
+    print(json.dumps(out))
+
+
 def worker_attention():
     """Flash-attention BACKWARD: pallas dQ/dKV kernels vs the plain-JAX
     blockwise fallback (FLAGS.use_pallas toggle), long-context shape."""
@@ -416,6 +461,7 @@ WORKERS = {
     "resnet50": worker_resnet50,
     "alexnet": worker_alexnet,
     "lstm": worker_lstm,
+    "transformer": worker_transformer,
     "attention": worker_attention,
     "scaling": worker_scaling,
 }
@@ -514,7 +560,8 @@ def main():
                               max_attempts=3)
     if probe:
         record.update(probe)
-        for name in ("resnet50", "alexnet", "lstm", "attention"):
+        for name in ("resnet50", "alexnet", "lstm", "transformer",
+                     "attention"):
             out, err = _run_worker(name, deadline)
             if out:
                 record.update(out)

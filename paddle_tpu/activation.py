@@ -105,13 +105,21 @@ class LogActivation(BaseActivation):
     fn = staticmethod(jnp.log)
 
 
+class GeluActivation(BaseActivation):
+    """Gaussian error linear unit (tanh form) — transformer-era extension
+    beyond the reference's 14 (ActivationFunction.cpp); the FFN activation
+    of the transformer LM family (models/transformer.py)."""
+    name = "gelu"
+    fn = staticmethod(jax.nn.gelu)
+
+
 _REGISTRY = {
     cls.name: cls
     for cls in [
         LinearActivation, SigmoidActivation, TanhActivation, STanhActivation,
         ReluActivation, BReluActivation, SoftReluActivation, SoftmaxActivation,
         SequenceSoftmaxActivation, AbsActivation, SquareActivation, ExpActivation,
-        ReciprocalActivation, SqrtActivation, LogActivation,
+        ReciprocalActivation, SqrtActivation, LogActivation, GeluActivation,
     ]
 }
 

@@ -907,6 +907,39 @@ def batch_norm(input, act=None, name: Optional[str] = None,
 
 
 @_export
+def layer_norm(input, act=None, name: Optional[str] = None, param_attr=None,
+               bias_attr=None, epsilon: float = 1e-5, **_kw) -> LayerOutput:
+    """Per-row layer normalization over the feature axis (ops/norm.py
+    layer_norm) — transformer-era extension beyond the reference's norm
+    inventory (BatchNorm/CrossMapNorm, gserver/layers/*NormLayer.cpp);
+    the normalization of the transformer LM family (models/transformer.py).
+    Stats are per row, so packed variable-length sequences need no segment
+    metadata."""
+    inp = input
+    name = name or unique_name("layer_norm")
+    activation = _resolve_act(act)
+    params = {
+        "gamma": ParamSpec((inp.size,), ParamAttr.to_attr(param_attr)
+                           if param_attr else ParamAttr(initializer=Constant(1.0))),
+        "beta": ParamSpec((inp.size,), ParamAttr.to_attr(bias_attr)
+                          if bias_attr else ParamAttr(initializer=Constant(0.0))),
+    }
+
+    def compute(ctx, p, ins):
+        v = ins[0]
+        x = _data_of(v)
+        # normalize in f32 (bf16 row stats lose mantissa), emit in x.dtype
+        y = pnorm.layer_norm(x.astype(jnp.float32),
+                             p["gamma"], p["beta"], eps=epsilon).astype(x.dtype)
+        y = _apply_act(activation, y)
+        return _like(v, y) if isinstance(v, SequenceBatch) else y
+
+    return LayerOutput(name=name, layer_type="layer_norm", inputs=[inp],
+                       fn=compute, params=params, size=inp.size,
+                       is_sequence=inp.is_sequence)
+
+
+@_export
 def img_cmrnorm(input, size: int = 5, scale: float = 0.0001, power: float = 0.75,
                 name: Optional[str] = None, **_kw) -> LayerOutput:
     """Local response normalization across maps (reference: img_cmrnorm_layer

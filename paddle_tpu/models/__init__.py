@@ -11,3 +11,4 @@ from paddle_tpu.models import gan
 from paddle_tpu.models import vae
 from paddle_tpu.models import sequence_tagging
 from paddle_tpu.models import srl
+from paddle_tpu.models import transformer

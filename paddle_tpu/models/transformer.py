@@ -1,0 +1,59 @@
+"""Decoder-only transformer language model (GPT-2 style, pre-LN).
+
+The transformer-era flagship of the model zoo: the reference predates
+transformers (its attention surface is simple_attention /
+dot_product_attention, python/paddle/trainer_config_helpers/networks.py:1304,
+1402), so this is the new-build extension that exercises the same machinery
+at modern scale — packed variable-length sequences (SequenceBatch, the
+Argument.sequenceStartPositions analog), the pallas flash-attention kernel
+(ops/attention.py) via layer.multi_head_attention, layer_norm, and per-token
+classification cost.
+
+On TPU this family is the high-MFU headline: all FLOPs live in large bf16
+matmuls (QKV/out projections, the 4x FFN, the vocab head) that tile straight
+onto the MXU, with flash attention keeping the S^2 term out of HBM.
+"""
+
+from __future__ import annotations
+
+import paddle_tpu as paddle
+from paddle_tpu import layer
+
+
+def block(x, *, n_heads: int, ffn_mult: int = 4, name: str):
+    """One pre-LN decoder block: x + MHA(LN(x)); x + FFN(LN(x))."""
+    a = layer.layer_norm(x, name=f"{name}_ln1")
+    a = layer.multi_head_attention(a, num_heads=n_heads, causal=True,
+                                   name=f"{name}_attn")
+    x = layer.addto(input=[x, a], name=f"{name}_res1")
+    f = layer.layer_norm(x, name=f"{name}_ln2")
+    f = layer.fc(input=f, size=x.size * ffn_mult, act="gelu",
+                 name=f"{name}_ffn_up")
+    f = layer.fc(input=f, size=x.size, name=f"{name}_ffn_down")
+    return layer.addto(input=[x, f], name=f"{name}_res2")
+
+
+def build(vocab_size: int = 32768, d_model: int = 512, n_layers: int = 6,
+          n_heads: int = 8, max_len: int = 1024, ffn_mult: int = 4):
+    """Returns (tokens, positions, target, logits, cost).
+
+    Feeds: ``tokens`` / ``target`` are integer sequences (next-token
+    targets), ``pos`` is the 0-based position within each sequence
+    (fed as data so packed buffers need no in-graph segment arithmetic).
+    """
+    tokens = layer.data(name="tokens",
+                        type=paddle.data_type.integer_value_sequence(vocab_size))
+    pos = layer.data(name="pos",
+                     type=paddle.data_type.integer_value_sequence(max_len))
+    target = layer.data(name="target",
+                        type=paddle.data_type.integer_value_sequence(vocab_size))
+
+    tok_emb = layer.embedding(input=tokens, size=d_model, name="tok_embed")
+    pos_emb = layer.embedding(input=pos, size=d_model, name="pos_embed")
+    x = layer.addto(input=[tok_emb, pos_emb], name="embed_sum")
+    for i in range(n_layers):
+        x = block(x, n_heads=n_heads, ffn_mult=ffn_mult, name=f"blk{i}")
+    x = layer.layer_norm(x, name="final_ln")
+    logits = layer.fc(input=x, size=vocab_size, name="lm_head")
+    cost = layer.classification_cost(input=logits, label=target)
+    return tokens, pos, target, logits, cost

@@ -29,13 +29,17 @@ def batch_norm(x: jax.Array, gamma: jax.Array, beta: jax.Array,
     """
     reduce_axes = tuple(range(x.ndim - 1))
     use_batch_stats = train and not (use_global_stats or False)
-    # stats always in f32: with bf16 activations (FLAGS.bf16_activations) a
-    # bf16 mean/var over N*H*W elements loses too many mantissa bits
-    x32 = x.astype(jnp.float32)
+    n = x.size // x.shape[-1]
     if use_batch_stats:
-        mean = jnp.mean(x32, axis=reduce_axes)
-        var = jnp.var(x32, axis=reduce_axes)
-        n = x.size // x.shape[-1]
+        # stats in f32 (bf16 mean/var over N*H*W elements loses too many
+        # mantissa bits), via ONE fused pass: sum and sum-of-squares are a
+        # multi-output reduction XLA fuses into a single read of x, where
+        # the mean-then-squared-deviation formulation costs two passes —
+        # for a bandwidth-bound BN that second read is the dominant cost
+        s1 = jnp.sum(x.astype(jnp.float32), axis=reduce_axes)
+        s2 = jnp.sum(jnp.square(x.astype(jnp.float32)), axis=reduce_axes)
+        mean = s1 / n
+        var = jnp.maximum(s2 / n - jnp.square(mean), 0.0)
         unbiased = var * (n / max(1, n - 1))
         new_mean = momentum * moving_mean + (1.0 - momentum) * mean
         new_var = momentum * moving_var + (1.0 - momentum) * unbiased
@@ -43,8 +47,13 @@ def batch_norm(x: jax.Array, gamma: jax.Array, beta: jax.Array,
         mean, var = moving_mean, moving_var
         new_mean, new_var = moving_mean, moving_var
     inv = jax.lax.rsqrt(var + eps)
-    y = (x32 - mean) * inv * gamma + beta
-    return y.astype(x.dtype), new_mean, new_var
+    # fold the whole affine into per-channel scale/bias (f32, C-sized) and
+    # apply in the activation dtype: the elementwise pass stays bf16 when
+    # activations are bf16 instead of round-tripping the tensor through f32
+    scale = (inv * gamma).astype(x.dtype)
+    bias = (beta - mean * inv * gamma).astype(x.dtype)
+    y = x * scale + bias
+    return y, new_mean, new_var
 
 
 def layer_norm(x: jax.Array, gamma: jax.Array, beta: jax.Array,

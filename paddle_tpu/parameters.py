@@ -84,6 +84,16 @@ class Parameters:
     def items(self):
         return self._values.items()
 
+    def get(self, name: str):
+        """Parameter value as a host numpy array (reference:
+        python/paddle/v2/parameters.py Parameters.get / __getitem__ —
+        the accessor every v2 demo uses, e.g. parameters.get('embedding'))."""
+        return np.asarray(self[name])
+
+    def set(self, name: str, value) -> None:
+        """Assign a parameter from host data (reference v2 Parameters.set)."""
+        self[name] = value
+
     def get_spec(self, name: str) -> Optional[ParamSpec]:
         return self._specs.get(name)
 
