@@ -12,3 +12,5 @@ from paddle_tpu.models import vae
 from paddle_tpu.models import sequence_tagging
 from paddle_tpu.models import srl
 from paddle_tpu.models import transformer
+from paddle_tpu.models import quick_start
+from paddle_tpu.models import traffic_prediction

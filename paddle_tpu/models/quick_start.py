@@ -1,0 +1,106 @@
+"""quick_start text-classification family — the 7 architectures of
+v1_api_demo/quick_start/trainer_config.{lr,emb,cnn,lstm,bidi-lstm,db-lstm,
+resnet-lstm}.py, each a sentiment classifier over word-id sequences
+(bag-of-words for ``lr``).
+
+``build(arch)`` returns (word, label, output, cost) where ``output`` is the
+class-score layer (logits — classification_cost fuses the softmax, this
+framework's convention; argmax/max_id at predict time is softmax-invariant).
+"""
+
+from __future__ import annotations
+
+import paddle_tpu as paddle
+from paddle_tpu import layer, pooling
+from paddle_tpu.attr import ExtraAttr
+from paddle_tpu.networks import (bidirectional_lstm, sequence_conv_pool,
+                                 simple_lstm)
+
+ARCHS = ("lr", "emb", "cnn", "lstm", "bidi_lstm", "db_lstm", "resnet_lstm")
+
+
+def _lr(word, dict_size, emb_size):
+    # trainer_config.lr.py: bag-of-words -> softmax fc. The BoW vector is
+    # the dense data input itself (dataprovider_bow).
+    return word
+
+
+def _emb_avg(word, dict_size, emb_size):
+    emb = layer.embedding(input=word, size=emb_size)
+    return layer.pooling(input=emb, pooling_type=pooling.AvgPooling())
+
+
+def _cnn(word, dict_size, emb_size):
+    emb = layer.embedding(input=word, size=emb_size)
+    return sequence_conv_pool(emb, context_len=3, hidden_size=512)
+
+
+def _lstm(word, dict_size, emb_size):
+    emb = layer.embedding(input=word, size=emb_size)
+    lstm = simple_lstm(emb, size=emb_size)
+    lstm = layer.dropout(lstm, 0.25)
+    return layer.pooling(input=lstm, pooling_type=pooling.MaxPooling())
+
+
+def _bidi_lstm(word, dict_size, emb_size):
+    emb = layer.embedding(input=word, size=emb_size)
+    bi = bidirectional_lstm(emb, size=emb_size)
+    return layer.pooling(input=bi, pooling_type=pooling.MaxPooling())
+
+
+def _db_lstm(word, dict_size, emb_size, depth: int = 4):
+    # trainer_config.db-lstm.py: alternating-direction stacked LSTM; each
+    # level's fc sees [previous fc, previous lstm]
+    emb = layer.embedding(input=word, size=emb_size)
+    hidden = layer.fc(input=emb, size=emb_size)
+    lstm = layer.lstmemory(
+        input=layer.fc(input=hidden, size=emb_size * 4, name="db0_proj"),
+        size=emb_size, layer_attr=ExtraAttr(drop_rate=0.1))
+    inputs = [hidden, lstm]
+    for i in range(1, depth):
+        fc = layer.fc(input=inputs, size=emb_size)
+        lstm = layer.lstmemory(
+            input=layer.fc(input=fc, size=emb_size * 4, name=f"db{i}_proj"),
+            size=emb_size, reverse=(i % 2) == 1,
+            layer_attr=ExtraAttr(drop_rate=0.1))
+        inputs = [fc, lstm]
+    return layer.pooling(input=lstm, pooling_type=pooling.MaxPooling())
+
+
+def _resnet_lstm(word, dict_size, emb_size, depth: int = 3):
+    # trainer_config.resnet-lstm.py (GNMT-style residual LSTM stack):
+    # level input = previous input + previous hidden state
+    emb = layer.embedding(input=word, size=emb_size)
+    prev_input, prev_hidden = emb, simple_lstm(emb, size=emb_size)
+    for i in range(depth):
+        cur = layer.addto(input=[prev_input, prev_hidden])
+        hidden = simple_lstm(cur, size=emb_size, name=f"res_lstm{i}")
+        prev_input, prev_hidden = cur, hidden
+    return layer.pooling(input=prev_hidden,
+                         pooling_type=pooling.MaxPooling())
+
+
+_BUILDERS = {
+    "lr": _lr, "emb": _emb_avg, "cnn": _cnn, "lstm": _lstm,
+    "bidi_lstm": _bidi_lstm, "db_lstm": _db_lstm, "resnet_lstm": _resnet_lstm,
+}
+
+
+def build(arch: str = "cnn", dict_size: int = 30000, emb_size: int = 128,
+          num_classes: int = 2):
+    """Returns (word, label, output, cost) for one of ARCHS."""
+    if arch not in _BUILDERS:
+        raise KeyError(f"unknown quick_start arch {arch!r}; one of {ARCHS}")
+    if arch == "lr":
+        word = layer.data(name="word",
+                          type=paddle.data_type.dense_vector(dict_size))
+    else:
+        word = layer.data(
+            name="word",
+            type=paddle.data_type.integer_value_sequence(dict_size))
+    label = layer.data(name="label",
+                       type=paddle.data_type.integer_value(num_classes))
+    feat = _BUILDERS[arch](word, dict_size, emb_size)
+    output = layer.fc(input=feat, size=num_classes)
+    cost = layer.classification_cost(input=output, label=label)
+    return word, label, output, cost

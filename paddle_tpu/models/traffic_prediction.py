@@ -1,0 +1,42 @@
+"""Traffic-speed forecasting demo — the multi-task shared-weight model of
+v1_api_demo/traffic_prediction/trainer_config.py: one encoded history window
+(TERM_NUM readings) feeds FORECASTING_NUM per-horizon heads; every head's
+first projection shares ONE parameter (`ParamAttr(name='_link_vec.w')`, the
+reference's cross-task weight sharing), then predicts a 4-class speed bucket.
+
+Exercises: parameter aliasing across layers, multi-cost training (the
+trainer sums the per-horizon classification costs, MultiNetwork-style).
+"""
+
+from __future__ import annotations
+
+import paddle_tpu as paddle
+from paddle_tpu import layer
+from paddle_tpu.attr import ParamAttr
+
+TERM_NUM = 24
+FORECASTING_NUM = 24
+NUM_BUCKETS = 4
+
+
+def build(term_num: int = TERM_NUM, forecasting_num: int = FORECASTING_NUM,
+          emb_size: int = 16):
+    """Returns (link_encode, labels, scores, costs): per-horizon score
+    layers (logits over 4 speed buckets) and their classification costs."""
+    link_encode = layer.data(
+        name="link_encode", type=paddle.data_type.dense_vector(term_num))
+    labels, scores, costs = [], [], []
+    shared = ParamAttr(name="_link_vec.w")
+    for i in range(forecasting_num):
+        link_vec = layer.fc(input=link_encode, size=emb_size,
+                            param_attr=shared, name=f"link_vec_{i}")
+        score = layer.fc(input=link_vec, size=NUM_BUCKETS,
+                         name=f"score_{(i + 1) * 5}min")
+        label = layer.data(name=f"label_{(i + 1) * 5}min",
+                           type=paddle.data_type.integer_value(NUM_BUCKETS))
+        cost = layer.classification_cost(input=score, label=label,
+                                         name=f"cost_{(i + 1) * 5}min")
+        labels.append(label)
+        scores.append(score)
+        costs.append(cost)
+    return link_encode, labels, scores, costs

@@ -13,8 +13,26 @@ from paddle_tpu import pooling as P
 from paddle_tpu.topology import LayerOutput, unique_name
 
 __all__ = ["simple_img_conv_pool", "img_conv_group", "vgg_16_network",
-           "simple_lstm", "simple_gru", "bidirectional_lstm",
-           "bidirectional_gru", "simple_attention", "dot_product_attention"]
+           "sequence_conv_pool", "simple_lstm", "simple_gru",
+           "bidirectional_lstm", "bidirectional_gru", "simple_attention",
+           "dot_product_attention"]
+
+
+def sequence_conv_pool(input, context_len: int, hidden_size: int,
+                       name: Optional[str] = None, context_start: int = None,
+                       pool_type=None, fc_act=None) -> LayerOutput:
+    """Text convolution pooling group (reference: networks.py:40
+    sequence_conv_pool): context projection -> fc -> pooling — the text-CNN
+    used by the quick_start cnn config (v1_api_demo/quick_start/
+    trainer_config.cnn.py)."""
+    name = name or unique_name("seq_conv_pool")
+    ctx = L.mixed(size=input.size * context_len,
+                  input=[L.context_projection(input, context_len=context_len,
+                                              context_start=context_start)],
+                  name=f"{name}_ctx")
+    hidden = L.fc(input=ctx, size=hidden_size, act=fc_act or "tanh",
+                  name=f"{name}_fc")
+    return L.pooling(input=hidden, pooling_type=pool_type or P.MaxPooling())
 
 
 def simple_img_conv_pool(input, filter_size: int, num_filters: int,

@@ -1,0 +1,90 @@
+"""quick_start (7 text-classification archs) + traffic_prediction demos.
+
+Reference: v1_api_demo/quick_start/trainer_config.*.py and
+v1_api_demo/traffic_prediction/trainer_config.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import layer, optimizer, trainer
+from paddle_tpu.models import quick_start, traffic_prediction
+
+DICT = 100
+
+
+def _text_samples(rng, n=24, bow=False):
+    """Class-separable synthetic text: class 0 uses low ids, 1 high ids."""
+    out = []
+    for i in range(n):
+        y = i % 2
+        length = int(rng.randint(4, 12))
+        ids = rng.randint(0 if y == 0 else DICT // 2,
+                          DICT // 2 if y == 0 else DICT, size=length)
+        if bow:
+            vec = np.zeros(DICT, np.float32)
+            vec[ids] = 1.0
+            out.append((vec, y))
+        else:
+            out.append((ids.tolist(), y))
+    return out
+
+
+@pytest.mark.parametrize("arch", quick_start.ARCHS)
+def test_quick_start_arch_trains(rng, arch):
+    paddle.topology.reset_name_scope()
+    word, label, output, cost = quick_start.build(
+        arch=arch, dict_size=DICT, emb_size=16)
+    params = paddle.Parameters.from_topology(
+        paddle.topology.Topology([cost]), seed=0)
+    sgd = trainer.SGD(cost=cost, parameters=params,
+                      update_equation=optimizer.Adam(learning_rate=5e-3))
+    step = sgd._build_step()
+    feeds = sgd._make_feeder({"word": 0, "label": 1}).feed(
+        _text_samples(rng, bow=(arch == "lr")))
+    import jax
+
+    p, o, m = sgd.parameters.as_dict(), sgd.opt_state, sgd.model_state
+    key = jax.random.PRNGKey(0)
+    losses = []
+    for _ in range(25):
+        loss, p, o, m, _ = step(p, o, m, key, feeds)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7, (arch, losses[0], losses[-1])
+
+
+def test_traffic_prediction_shared_weights_train(rng):
+    paddle.topology.reset_name_scope()
+    link, labels, scores, costs = traffic_prediction.build(
+        forecasting_num=4, emb_size=8)
+    topo = paddle.topology.Topology(costs)
+    params = paddle.Parameters.from_topology(topo, seed=0)
+    # cross-head weight sharing: ONE parameter backs all head projections
+    assert "_link_vec.w" in params.names()
+    assert not any(n.startswith("link_vec_") and n.endswith(".w0")
+                   for n in params.names())
+    sgd = trainer.SGD(cost=costs, parameters=params,
+                      update_equation=optimizer.Adam(learning_rate=1e-2))
+    step = sgd._build_step()
+    samples = []
+    for _ in range(32):
+        x = rng.randn(traffic_prediction.TERM_NUM).astype(np.float32)
+        ys = [int(x[: 6 * (i + 1)].sum() > 0) for i in range(4)]
+        samples.append(tuple([x] + ys))
+    feeding = {"link_encode": 0}
+    feeding.update({f"label_{(i + 1) * 5}min": i + 1 for i in range(4)})
+    feeds = sgd._make_feeder(feeding).feed(samples)
+    import jax
+
+    p, o, m = sgd.parameters.as_dict(), sgd.opt_state, sgd.model_state
+    w0 = np.asarray(p["_link_vec.w"]).copy()  # step donates its inputs
+    key = jax.random.PRNGKey(0)
+    losses = []
+    for _ in range(30):
+        loss, p, o, m, _ = step(p, o, m, key, feeds)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.8
+    # the shared weight received updates
+    moved = np.abs(np.asarray(p["_link_vec.w"]) - w0).max()
+    assert moved > 1e-4
