@@ -87,8 +87,11 @@ _BUILDERS = {
 
 
 def build(arch: str = "cnn", dict_size: int = 30000, emb_size: int = 128,
-          num_classes: int = 2):
-    """Returns (word, label, output, cost) for one of ARCHS."""
+          num_classes: int = 2, **arch_kwargs):
+    """Returns (word, label, output, cost) for one of ARCHS.
+
+    ``arch_kwargs`` forward to the arch builder (e.g. ``depth=`` for
+    db_lstm / resnet_lstm stack depth)."""
     if arch not in _BUILDERS:
         raise KeyError(f"unknown quick_start arch {arch!r}; one of {ARCHS}")
     if arch == "lr":
@@ -100,7 +103,7 @@ def build(arch: str = "cnn", dict_size: int = 30000, emb_size: int = 128,
             type=paddle.data_type.integer_value_sequence(dict_size))
     label = layer.data(name="label",
                        type=paddle.data_type.integer_value(num_classes))
-    feat = _BUILDERS[arch](word, dict_size, emb_size)
+    feat = _BUILDERS[arch](word, dict_size, emb_size, **arch_kwargs)
     output = layer.fc(input=feat, size=num_classes)
     cost = layer.classification_cost(input=output, label=label)
     return word, label, output, cost

@@ -32,7 +32,8 @@ def sequence_conv_pool(input, context_len: int, hidden_size: int,
                   name=f"{name}_ctx")
     hidden = L.fc(input=ctx, size=hidden_size, act=fc_act or "tanh",
                   name=f"{name}_fc")
-    return L.pooling(input=hidden, pooling_type=pool_type or P.MaxPooling())
+    return L.pooling(input=hidden, pooling_type=pool_type or P.MaxPooling(),
+                     name=name)
 
 
 def simple_img_conv_pool(input, filter_size: int, num_filters: int,

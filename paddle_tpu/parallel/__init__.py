@@ -12,3 +12,4 @@ from paddle_tpu.parallel.api import (shard_batch, replicate, param_sharding,
                                      DataParallel)
 from paddle_tpu.parallel.placement import (stage_attrs, model_parallel_fc,
                                            model_parallel_mlp)
+from paddle_tpu.parallel.pipeline import pipeline_apply, stack_stage_params

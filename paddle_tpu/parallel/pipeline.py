@@ -1,0 +1,109 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a mesh axis.
+
+The reference's model parallelism places whole layers on devices and streams
+work through per-device compute threads (ParallelNeuralNetwork.h:15-70
+dispatchByDeviceId; MultiGradientMachine.h:41-165 pipelines its ring copies
+between trainer threads).  The TPU-native carry-over of that capability is a
+collective-permute pipeline:
+
+  - the model is S identical stages; each stage's parameters live ONLY on
+    its device along the ``stage`` mesh axis (stacked leading dim, sharded),
+  - microbatches enter at stage 0 and hop stage->stage+1 each tick via
+    ``lax.ppermute`` over ICI,
+  - one ``lax.scan`` runs M + S - 1 ticks (the GPipe fill+drain schedule);
+    the last stage accumulates per-microbatch outputs,
+  - everything is a plain shard_map program: ``jax.grad`` differentiates
+    through scan + ppermute (ppermute's transpose is the reverse hop), so
+    pipeline-parallel TRAINING needs no hand-written backward schedule.
+
+This trades the 1F1B memory optimisation for compiler-visible simplicity —
+the XLA analog of GPipe, not PipeDream; remat (jax.checkpoint) on stage_fn
+recovers most of the memory if needed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+try:  # jax >= 0.6 top-level; experimental path is deprecated
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+
+def stack_stage_params(param_list: Sequence[Any], mesh: Mesh = None,
+                       axis: str = "stage"):
+    """Stack S per-stage pytrees into one pytree with leading dim S (the
+    stage axis), placed so each stage's slice lives on its own device —
+    the 'weights live only on their stage' layout."""
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *param_list)
+    if mesh is not None:
+        def _place(x):
+            spec = P(axis, *([None] * (x.ndim - 1)))
+            return jax.device_put(x, NamedSharding(mesh, spec))
+
+        stacked = jax.tree.map(_place, stacked)
+    return stacked
+
+
+def pipeline_apply(mesh: Mesh, stage_fn: Callable[[Any, jax.Array], jax.Array],
+                   stacked_params, microbatches: jax.Array,
+                   axis: str = "stage") -> jax.Array:
+    """Run M microbatches through S pipeline stages; returns [M, ...] outputs.
+
+    ``stacked_params``: pytree with leading dim S (see stack_stage_params).
+    ``microbatches``: [M, mb, ...] array, replicated (every stage sees the
+    feed; only stage 0 reads it — the cheap choice at small M, and the
+    scan/ppermute structure is identical either way).
+    ``stage_fn(params, x) -> y`` with y.shape == x.shape (homogeneous
+    stages — the classic collective-permute pipeline contract).
+    """
+    n_stages = mesh.shape[axis]
+    m = microbatches.shape[0]
+    ticks = m + n_stages - 1
+
+    def per_device(params_blk, mbs):
+        # params_blk leaves: [1, ...] (this device's stage); drop the dim
+        params = jax.tree.map(lambda x: x[0], params_blk)
+        stage = lax.axis_index(axis)
+        out_shape = mbs.shape[1:]
+        acc0 = jnp.zeros((m,) + out_shape, mbs.dtype)
+        recv0 = jnp.zeros(out_shape, mbs.dtype)
+        if hasattr(lax, "pvary"):
+            # newer shard_map tracks varying-manual-axes (VMA): the carry
+            # becomes stage-varying after one tick, so it must start so
+            acc0, recv0 = lax.pvary((acc0, recv0), (axis,))
+
+        def tick(carry, t):
+            acc, recv = carry
+            mb_idx = jnp.clip(t, 0, m - 1)
+            feed = lax.dynamic_index_in_dim(mbs, mb_idx, keepdims=False)
+            x = jnp.where(stage == 0, feed, recv)
+            y = stage_fn(params, x)
+            # hop to the next stage (no wraparound: stage 0's input is the
+            # feed; ppermute fills missing receivers with zeros)
+            nxt = lax.ppermute(y, axis,
+                               [(i, i + 1) for i in range(n_stages - 1)])
+            # last stage emits microbatch t-(S-1) at tick t
+            out_idx = jnp.clip(t - (n_stages - 1), 0, m - 1)
+            take = (stage == n_stages - 1) & (t >= n_stages - 1)
+            cur = lax.dynamic_index_in_dim(acc, out_idx, keepdims=False)
+            upd = jnp.where(take, y, cur)
+            acc = lax.dynamic_update_index_in_dim(acc, upd, out_idx, 0)
+            return (acc, nxt), None
+
+        (acc, _), _ = lax.scan(tick, (acc0, recv0), jnp.arange(ticks))
+        # replicate the last stage's outputs to every device (psum of a
+        # one-hot-masked buffer); its transpose distributes cotangents back
+        acc = lax.psum(jnp.where(stage == n_stages - 1, acc, 0.0), axis)
+        return acc
+
+    in_params_spec = jax.tree.map(lambda _: P(axis), stacked_params)
+    return shard_map(per_device, mesh=mesh,
+                     in_specs=(in_params_spec, P()),
+                     out_specs=P())(stacked_params, microbatches)

@@ -79,6 +79,45 @@ def make_model_diagram(topology: Topology,
     return "\n".join(lines)
 
 
+def param_to_text(value, path: str) -> None:
+    """Dump one parameter as the embedding-model text format (reference:
+    v1_api_demo/model_zoo/embedding/paraconvert.py binary2text — header
+    line ``version,floatSize,paraCount`` then comma-joined rows)."""
+    arr = np.asarray(value, dtype=np.float32)
+    rows = arr.reshape(-1, arr.shape[-1]) if arr.ndim > 1 else arr.reshape(1, -1)
+    with open(path, "w") as f:
+        f.write(f"0,4,{arr.size}\n")
+        for row in rows:
+            f.write(",".join(f"{x:.7f}" for x in row) + "\n")
+
+
+def text_to_param(path: str, dim: Optional[int] = None) -> np.ndarray:
+    """Load a text-format parameter file (paraconvert.py text2binary
+    analog). Returns [rows, dim] float32 (or flat when rows carry no
+    consistent dim)."""
+    with open(path) as f:
+        header = f.readline().strip().split(",")
+        count = int(header[2])
+        rows = [np.array(line.strip().split(","), dtype=np.float32)
+                for line in f if line.strip()]
+    flat = np.concatenate(rows) if rows else np.zeros(0, np.float32)
+    if flat.size != count:
+        raise ValueError(f"{path}: header says {count} values, got {flat.size}")
+    if dim:
+        return flat.reshape(-1, dim)
+    widths = {r.size for r in rows}
+    return flat.reshape(len(rows), rows[0].size) if len(widths) == 1 else flat
+
+
+def extract_embedding(parameters: Parameters, name: str,
+                      word_ids) -> np.ndarray:
+    """Slice pretrained embedding rows for a word subset (reference:
+    v1_api_demo/model_zoo/embedding/extract_para.py — the paragraph-vector
+    extraction workflow: trained table -> the rows your task dict needs)."""
+    table = np.asarray(parameters[name])
+    return table[np.asarray(list(word_ids), dtype=np.int64)]
+
+
 def torch2paddle(state_dict, parameters: Parameters,
                  name_map: Optional[Dict[str, str]] = None,
                  transpose_linear: bool = True) -> List[str]:

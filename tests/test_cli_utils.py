@@ -124,3 +124,44 @@ def test_torch2paddle_import(rng):
         np.testing.assert_allclose(np.asarray(got[0]), expect, atol=1e-4)
     finally:
         FLAGS.use_bf16 = old
+
+
+def test_param_text_round_trip(rng, tmp_path):
+    """paraconvert.py analog: text dump <-> load round trip."""
+    from paddle_tpu import utils
+
+    table = rng.randn(7, 5).astype("float32")
+    path = str(tmp_path / "emb.txt")
+    utils.param_to_text(table, path)
+    back = utils.text_to_param(path, dim=5)
+    assert back.shape == (7, 5)
+    import numpy as np
+
+    np.testing.assert_allclose(back, table, atol=1e-6)
+    # header count mismatch is detected
+    lines = open(path).read().splitlines()
+    open(path, "w").write("\n".join([lines[0]] + lines[2:]) + "\n")
+    import pytest
+
+    with pytest.raises(ValueError):
+        utils.text_to_param(path, dim=5)
+
+
+def test_extract_embedding_rows(rng):
+    """extract_para.py analog: slice trained embedding rows by word id."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import layer, utils
+
+    paddle.topology.reset_name_scope()
+    words = layer.data(name="w",
+                       type=paddle.data_type.integer_value_sequence(50))
+    emb = layer.embedding(input=words, size=8, name="emb")
+    fc = layer.fc(input=layer.pooling(
+        input=emb, pooling_type=paddle.pooling.AvgPooling()), size=2)
+    params = paddle.Parameters.from_topology(
+        paddle.topology.Topology([fc]), seed=0)
+    got = utils.extract_embedding(params, "emb.w", [3, 1, 4])
+    table = np.asarray(params["emb.w"])
+    np.testing.assert_allclose(got, table[[3, 1, 4]])
