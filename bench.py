@@ -113,40 +113,49 @@ def _compiled_flops(step, args):
 # ---------------------------------------------------------------------------
 
 
-def worker_resnet50():
-    """ResNet-50 train step, images/sec/chip + MFU.
-
-    Feeds are device-resident NHWC 4-D (the framework's native layout:
-    layer._to_nhwc passes 4-D through, so the per-step CHW-flat ->
-    NHWC transpose is off the hot path). Batch sweep picks the best
-    throughput; activations ride bf16 (FLAGS.bf16_activations)."""
+def _measure_image_model(build_fn, img, batch, iters=20, with_flops=False,
+                         **build_kw):
+    """Shared image-model measurement harness: build -> SGD -> device-resident
+    NHWC feeds (layer._to_nhwc passes 4-D through, so no per-step layout
+    change) -> timed chained steps. Returns sec or (sec, flops)."""
     import jax
     import numpy as np
+
+    import paddle_tpu as paddle
+
+    rng = np.random.RandomState(0)
+    paddle.topology.reset_name_scope()
+    images, label, logits, cost = build_fn(img_size=img, **build_kw)
+    topo = paddle.topology.Topology([cost])
+    params = paddle.Parameters.from_topology(topo, seed=0)
+    sgd = _make_sgd(cost, params)
+    feeds = {
+        "image": jax.device_put(
+            rng.randn(batch, img, img, 3).astype(np.float32)),
+        "label": jax.device_put(
+            rng.randint(0, logits.size, size=batch).astype(np.int32)),
+    }
+    step = sgd._build_step()
+    args = _step_args(sgd, feeds)
+    flops = _compiled_flops(step, args) if with_flops else None
+    sec = _time_steps(step, args, iters=iters)
+    return (sec, flops) if with_flops else sec
+
+
+def worker_resnet50():
+    """ResNet-50 train step, images/sec/chip + MFU. Batch sweep picks the
+    best throughput; activations ride bf16 (FLAGS.bf16_activations)."""
+    import jax
 
     paddle = _init_paddle()
     from paddle_tpu.models import resnet
 
     img = 224
-    rng = np.random.RandomState(0)
 
     def measure(batch, iters=20):
-        paddle.topology.reset_name_scope()
-        images, label, logits, cost = resnet.build(depth=50, img_size=img,
-                                                   num_classes=1000)
-        topo = paddle.topology.Topology([cost])
-        params = paddle.Parameters.from_topology(topo, seed=0)
-        sgd = _make_sgd(cost, params)
-        feeds = {
-            "image": jax.device_put(
-                rng.randn(batch, img, img, 3).astype(np.float32)),
-            "label": jax.device_put(
-                rng.randint(0, 1000, size=batch).astype(np.int32)),
-        }
-        step = sgd._build_step()
-        args = _step_args(sgd, feeds)
-        flops = _compiled_flops(step, args)
-        sec = _time_steps(step, args, iters=iters)
-        return sec, flops
+        return _measure_image_model(resnet.build, img, batch, iters=iters,
+                                    with_flops=True, depth=50,
+                                    num_classes=1000)
 
     kind = jax.devices()[0].device_kind
     peak = _peak_for(kind)
@@ -251,6 +260,28 @@ def worker_lstm():
         out["lstm_plain_xla_ms"] = round(measure(False, iters=8) * 1000, 3)
     except Exception as e:
         out["lstm_plain_xla_error"] = repr(e)
+    print(json.dumps(out))
+
+
+def worker_convnets():
+    """GoogleNet + SmallNet train ms/batch at the reference's benchmark
+    batch sizes (BASELINE.md: GoogleNet 613 ms bs=64 / 1149 ms bs=128,
+    SmallNet 10.46 ms bs=64 — all K40m)."""
+    _init_paddle()
+    from paddle_tpu.models import googlenet, smallnet
+
+    g64 = round(_measure_image_model(googlenet.build, 224, 64, iters=15)
+                * 1000, 2)
+    out = {"googlenet_bs64_ms": g64,
+           "googlenet_vs_baseline_bs64": round(613.0 / g64, 1)}
+    print(json.dumps(out), flush=True)  # headline-first (relay hang rule)
+    out["smallnet_bs64_ms"] = round(
+        _measure_image_model(smallnet.build, 32, 64, iters=30) * 1000, 3)
+    out["smallnet_vs_baseline_bs64"] = round(10.463 / out["smallnet_bs64_ms"], 1)
+    print(json.dumps(out), flush=True)
+    out["googlenet_bs128_ms"] = round(
+        _measure_image_model(googlenet.build, 224, 128, iters=15) * 1000, 2)
+    out["googlenet_vs_baseline_bs128"] = round(1149.0 / out["googlenet_bs128_ms"], 1)
     print(json.dumps(out))
 
 
@@ -461,6 +492,7 @@ WORKERS = {
     "resnet50": worker_resnet50,
     "alexnet": worker_alexnet,
     "lstm": worker_lstm,
+    "convnets": worker_convnets,
     "transformer": worker_transformer,
     "attention": worker_attention,
     "scaling": worker_scaling,
@@ -561,7 +593,7 @@ def main():
     if probe:
         record.update(probe)
         for name in ("resnet50", "alexnet", "lstm", "transformer",
-                     "attention"):
+                     "convnets", "attention"):
             out, err = _run_worker(name, deadline)
             if out:
                 record.update(out)

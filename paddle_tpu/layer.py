@@ -546,8 +546,16 @@ def concat(input, name: Optional[str] = None, act=None, layer_attr=None) -> Laye
         out = _apply_act(activation, out)
         return _apply_extra(ctx, name, out, layer_attr)
 
-    return LayerOutput(name=name, layer_type="concat", inputs=inputs, fn=compute,
+    node = LayerOutput(name=name, layer_type="concat", inputs=inputs, fn=compute,
                        size=size, is_sequence=inputs[0].is_sequence)
+    # channel concat of same-geometry images (inception towers): carry
+    # (H, W, sum C) so downstream conv/pool keep the geometry
+    shapes = [_img_shape_of(i) for i in inputs]
+    if all(s is not None for s in shapes) and \
+            len({(h, w) for h, w, _ in shapes}) == 1:
+        h, w, _ = shapes[0]
+        node.img_shape = (h, w, sum(c for _, _, c in shapes))
+    return node
 
 
 @_export

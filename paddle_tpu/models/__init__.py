@@ -14,3 +14,5 @@ from paddle_tpu.models import srl
 from paddle_tpu.models import transformer
 from paddle_tpu.models import quick_start
 from paddle_tpu.models import traffic_prediction
+from paddle_tpu.models import googlenet
+from paddle_tpu.models import smallnet
