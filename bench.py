@@ -96,12 +96,6 @@ def _step_args(sgd, feeds):
             jax.random.PRNGKey(0), feeds)
 
 
-def _compiled_flops(step, args):
-    """Compiler-reported FLOPs for one train step (falls back to None)."""
-    _, flops = _aot_compile(step, args)
-    return flops
-
-
 def _aot_compile(step, args):
     """Compile ONCE via AOT lowering; returns (callable, flops-or-None).
 
@@ -110,13 +104,16 @@ def _aot_compile(step, args):
     (resnet sweep, transformer) that halves the compile budget."""
     try:
         compiled = step.lower(*args).compile()
+    except Exception:
+        return step, None
+    try:  # a cost-analysis failure must not discard the compile
         cost = compiled.cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0] if cost else {}
         f = float(cost.get("flops", 0.0))
         return compiled, (f if f > 0 else None)
     except Exception:
-        return step, None
+        return compiled, None
 
 
 # ---------------------------------------------------------------------------
@@ -363,11 +360,18 @@ def worker_transformer():
     # ~400M-param config sized for one v5e chip (params+momentum+grads
     # ~6.5GB f32, saved activations ~4GB at 4096 tokens); the fallback
     # config halves the model if the big one OOMs on a future chip
+    fallback_reason = None
     try:
         out = measure(d=2048, layers=8, heads=16, seq=1024, bs=4)
     except Exception as e:
+        # record and EXIT the except first: e.__traceback__ pins the failed
+        # attempt's frame (its device buffers included); the fallback must
+        # allocate after those are droppable
+        fallback_reason = repr(e)
+        out = None
+    if out is None:
         out = measure(d=1024, layers=8, heads=16, seq=1024, bs=4)
-        out["transformer_fallback_reason"] = repr(e)
+        out["transformer_fallback_reason"] = fallback_reason
     print(json.dumps(out))
 
 
