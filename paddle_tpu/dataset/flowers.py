@@ -12,7 +12,6 @@ Real pipeline with a synthetic fallback when offline.
 from __future__ import annotations
 
 import functools
-import tarfile
 from typing import Dict
 
 import numpy as np

@@ -7,7 +7,6 @@ deterministic synthetic digit set when offline (no egress in CI).
 from __future__ import annotations
 
 import gzip
-import os
 import struct
 
 import numpy as np
