@@ -361,8 +361,9 @@ def worker_transformer():
     # ~6.5GB f32, saved activations ~4GB at 4096 tokens); the fallback
     # config halves the model if the big one OOMs on a future chip
     fallback_reason = None
+    d_used = 2048
     try:
-        out = measure(d=2048, layers=8, heads=16, seq=1024, bs=4)
+        out = measure(d=d_used, layers=8, heads=16, seq=1024, bs=4)
     except Exception as e:
         # record and EXIT the except first: e.__traceback__ pins the failed
         # attempt's frame (its device buffers included); the fallback must
@@ -370,8 +371,21 @@ def worker_transformer():
         fallback_reason = repr(e)
         out = None
     if out is None:
-        out = measure(d=1024, layers=8, heads=16, seq=1024, bs=4)
+        d_used = 1024
+        out = measure(d=d_used, layers=8, heads=16, seq=1024, bs=4)
         out["transformer_fallback_reason"] = fallback_reason
+    print(json.dumps(out), flush=True)  # headline before the flag variant
+    try:  # bf16 residual-stream variant (FLAGS.bf16_dense_activations)
+        from paddle_tpu.platform.flags import FLAGS
+
+        FLAGS.bf16_dense_activations = True
+        bf = measure(d=d_used, layers=8, heads=16, seq=1024, bs=4)
+        out["transformer_bf16_resid_tokens_per_sec"] = \
+            bf["transformer_tokens_per_sec"]
+        if "transformer_mfu" in bf:
+            out["transformer_bf16_resid_mfu"] = bf["transformer_mfu"]
+    except Exception as e:
+        out["transformer_bf16_resid_error"] = repr(e)
     print(json.dumps(out))
 
 

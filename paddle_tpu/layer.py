@@ -200,6 +200,7 @@ def fc(input, size: int, act=None, name: Optional[str] = None,
             total = y if total is None else total + y
         if has_bias:
             total = total + p["b"]
+        total = total.astype(pmath.dense_activation_dtype())
         out = _like(ins[0], total) if isinstance(ins[0], SequenceBatch) else total
         out = _apply_act(activation, out)
         return _apply_extra(ctx, name, out, layer_attr)
@@ -222,7 +223,7 @@ def embedding(input, size: int, name: Optional[str] = None,
         v = ins[0]
         ids = _data_of(v)
         out = embedding_lookup(p["w"], ids)
-        return _like(v, out)
+        return _like(v, out.astype(pmath.dense_activation_dtype()))
 
     return LayerOutput(name=name, layer_type="embedding", inputs=[inp],
                        fn=compute, params=params, size=size,
@@ -936,9 +937,8 @@ def layer_norm(input, act=None, name: Optional[str] = None, param_attr=None,
     def compute(ctx, p, ins):
         v = ins[0]
         x = _data_of(v)
-        # normalize in f32 (bf16 row stats lose mantissa), emit in x.dtype
-        y = pnorm.layer_norm(x.astype(jnp.float32),
-                             p["gamma"], p["beta"], eps=epsilon).astype(x.dtype)
+        # pnorm.layer_norm reduces stats in f32 and emits x.dtype
+        y = pnorm.layer_norm(x, p["gamma"], p["beta"], eps=epsilon)
         y = _apply_act(activation, y)
         return _like(v, y) if isinstance(v, SequenceBatch) else y
 
@@ -1761,7 +1761,7 @@ def multi_head_attention(query, key=None, value=None, *, num_heads: int,
             q, k, v, segment_ids=qs.segment_ids[None, :],
             kv_segment_ids=ks.segment_ids[None, :], causal=causal)
         y = pmath.matmul(out.reshape(cap_q, size), p["wo"])
-        y = qs.with_data(y)
+        y = qs.with_data(y.astype(pmath.dense_activation_dtype()))
         return _apply_extra(ctx, name, y, layer_attr)
 
     node = LayerOutput(name=name, layer_type="multi_head_attention",

@@ -20,7 +20,12 @@ import jax.numpy as jnp
 
 
 def softmax_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
-    """Integer labels; fused log-softmax (reference: classification_cost)."""
+    """Integer labels; fused log-softmax (reference: classification_cost).
+
+    Always reduces in f32: with bf16 activation storage
+    (FLAGS.bf16_dense_activations) a bf16 logsumexp over a 32k vocab loses
+    the loss signal's low bits."""
+    logits = logits.astype(jnp.float32)
     logz = jax.nn.logsumexp(logits, axis=-1)
     picked = jnp.take_along_axis(logits, labels[..., None].astype(jnp.int32),
                                  axis=-1)[..., 0]

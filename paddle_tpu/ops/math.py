@@ -35,6 +35,17 @@ def matmul(a: jax.Array, b: jax.Array, *, trans_a: bool = False,
                       preferred_element_type=jnp.dtype(out_dtype))
 
 
+def dense_activation_dtype() -> jnp.dtype:
+    """Storage dtype for dense/sequence layer outputs (fc, embedding,
+    attention — the transformer residual stream). The dense analog of
+    ops/conv.py activation_dtype: bf16 halves residual-stream HBM traffic;
+    norm statistics and losses still reduce in f32 (ops/norm.py layer_norm,
+    ops/losses.py softmax_cross_entropy upcast internally)."""
+    if FLAGS.use_bf16 and FLAGS.bf16_dense_activations:
+        return jnp.dtype(jnp.bfloat16)
+    return jnp.dtype(jnp.float32)
+
+
 def fc(x: jax.Array, w: jax.Array, b: Optional[jax.Array] = None) -> jax.Array:
     """y = x @ w (+ b) — FullyConnectedLayer::forward analog
     (reference: gserver/layers/FullyConnectedLayer.cpp:69-88)."""

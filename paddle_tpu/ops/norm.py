@@ -58,9 +58,14 @@ def batch_norm(x: jax.Array, gamma: jax.Array, beta: jax.Array,
 
 def layer_norm(x: jax.Array, gamma: jax.Array, beta: jax.Array,
                eps: float = 1e-5) -> jax.Array:
-    mean = jnp.mean(x, axis=-1, keepdims=True)
-    var = jnp.var(x, axis=-1, keepdims=True)
-    return (x - mean) * jax.lax.rsqrt(var + eps) * gamma + beta
+    """Row-stat normalization; like batch_norm above, statistics always
+    reduce in f32 (bf16 residual streams exist under
+    FLAGS.bf16_dense_activations), output in the input dtype."""
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    return ((x32 - mean) * jax.lax.rsqrt(var + eps) * gamma
+            + beta).astype(x.dtype)
 
 
 def cross_map_norm(x: jax.Array, size: int = 5, scale: float = 1e-4,

@@ -96,6 +96,11 @@ FLAGS.define("bf16_activations", True,
              "store inter-layer image activations in bfloat16 (halves HBM "
              "traffic between fused conv blocks; stats/losses stay f32). "
              "Only active when use_bf16 is also on.")
+FLAGS.define("bf16_dense_activations", False,
+             "store fc/embedding/attention outputs (the transformer "
+             "residual stream) in bfloat16. Norm statistics and losses "
+             "still reduce in f32. Off by default: flip for bandwidth-"
+             "bound dense models. Only active when use_bf16 is also on.")
 FLAGS.define("save_dir", "./output", "default checkpoint output directory")
 FLAGS.define("log_level", "INFO", "logging level")
 FLAGS.define("prealloc_mem", False, "let XLA preallocate the whole HBM arena")
