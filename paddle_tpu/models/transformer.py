@@ -16,6 +16,8 @@ onto the MXU, with flash attention keeping the S^2 term out of HBM.
 
 from __future__ import annotations
 
+import functools
+
 import paddle_tpu as paddle
 from paddle_tpu import layer
 
@@ -57,3 +59,144 @@ def build(vocab_size: int = 32768, d_model: int = 512, n_layers: int = 6,
     logits = layer.fc(input=x, size=vocab_size, name="lm_head")
     cost = layer.classification_cost(input=logits, label=target)
     return tokens, pos, target, logits, cost
+
+
+# ---------------------------------------------------------------------------
+# autoregressive decoding with a KV cache — the transformer-era analog of the
+# RNN beam-search generation path (generation.py / SequenceGenerator.cpp):
+# one jitted lax.scan over decode steps, dense [max_len] K/V caches per
+# layer, greedy or temperature sampling. Pure function over the SAME
+# parameter dict the trainer produces (names from build() above).
+# ---------------------------------------------------------------------------
+
+
+def _ln(x, g, b):
+    # the training graph's normalization (f32 stats, emit in x.dtype)
+    from paddle_tpu.ops.norm import layer_norm
+
+    return layer_norm(x, g, b)
+
+
+def _step_token(p, x_t, caches, t, *, n_layers, n_heads, max_len):
+    """One decode step for a [d] embedding; returns (hidden, new caches).
+
+    caches: list of (k, v) with k/v [max_len, H, Dh]; positions >= t are
+    zeros and masked out of the attention softmax.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    d = x_t.shape[-1]
+    head_dim = d // n_heads
+    new_caches = []
+    for i in range(n_layers):
+        k_cache, v_cache = caches[i]
+        a_in = _ln(x_t, p[f"blk{i}_ln1.gamma"], p[f"blk{i}_ln1.beta"])
+        q = (a_in @ p[f"blk{i}_attn.wq"]).reshape(n_heads, head_dim)
+        k = (a_in @ p[f"blk{i}_attn.wk"]).reshape(n_heads, head_dim)
+        v = (a_in @ p[f"blk{i}_attn.wv"]).reshape(n_heads, head_dim)
+        k_cache = jax.lax.dynamic_update_index_in_dim(k_cache, k, t, 0)
+        v_cache = jax.lax.dynamic_update_index_in_dim(v_cache, v, t, 0)
+        # attend over positions [0, t]
+        scores = jnp.einsum("hd,shd->hs", q.astype(jnp.float32),
+                            k_cache.astype(jnp.float32)) / jnp.sqrt(
+                                jnp.float32(head_dim))
+        mask = jnp.arange(max_len) <= t
+        scores = jnp.where(mask[None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("hs,shd->hd", probs,
+                         v_cache.astype(jnp.float32)).reshape(d)
+        attn = ctx.astype(x_t.dtype) @ p[f"blk{i}_attn.wo"]
+        x_t = x_t + attn
+        f_in = _ln(x_t, p[f"blk{i}_ln2.gamma"], p[f"blk{i}_ln2.beta"])
+        h = jax.nn.gelu(f_in @ p[f"blk{i}_ffn_up.w0"] + p[f"blk{i}_ffn_up.b"])
+        h = h @ p[f"blk{i}_ffn_down.w0"] + p[f"blk{i}_ffn_down.b"]
+        x_t = x_t + h
+        new_caches.append((k_cache, v_cache))
+    return x_t, new_caches
+
+
+def generate(params, prompt_ids, max_new_tokens: int, *, n_layers: int,
+             n_heads: int, max_len: int = 1024, temperature: float = 0.0,
+             rng=None, eos_id: int = -1):
+    """Greedy/temperature decode continuing ``prompt_ids``.
+
+    params: the trainer's parameter dict (Parameters.as_dict() or a plain
+    {name: array}). Returns an int32 array of generated token ids
+    (length max_new_tokens; positions after an ``eos_id`` hit repeat eos).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    p = {k: jnp.asarray(v) for k, v in dict(params).items()}
+    prompt = jnp.asarray(np.asarray(prompt_ids), jnp.int32)
+    n_prompt = int(prompt.shape[0])
+    if n_prompt < 1:
+        raise ValueError("generate() needs a non-empty prompt")
+    total = n_prompt + max_new_tokens
+    if total > max_len:
+        raise ValueError(f"prompt+new = {total} exceeds max_len {max_len}")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    run = _decode_fn(n_layers, n_heads, max_len, n_prompt, int(total),
+                     float(temperature), int(eos_id))
+    return np.asarray(run(p, prompt, rng))
+
+
+@functools.lru_cache(maxsize=32)
+def _decode_fn(n_layers, n_heads, max_len, n_prompt, total, temperature,
+               eos_id):
+    """Build (and cache) the jitted decode scan for one static config.
+
+    Params/prompt/rng are ARGUMENTS of the jitted function, so repeated
+    generate() calls with the same shapes hit both this cache and jax's
+    compile cache instead of re-tracing with the weights baked in as
+    constants."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(p, prompt, rng):
+        d = p["tok_embed.w"].shape[1]
+        head_dim = d // n_heads
+        caches = [(jnp.zeros((max_len, n_heads, head_dim), jnp.float32),
+                   jnp.zeros((max_len, n_heads, head_dim), jnp.float32))
+                  for _ in range(n_layers)]
+
+        def flatten(cs):
+            return tuple(x for kv in cs for x in kv)
+
+        def unflatten(flat):
+            return [(flat[2 * i], flat[2 * i + 1]) for i in range(n_layers)]
+
+        def scan_fn(carry, t):
+            tok, flat, rng, done = carry
+            x_t = p["tok_embed.w"][tok] + p["pos_embed.w"][t]
+            h, cs = _step_token(p, x_t, unflatten(flat), t,
+                                n_layers=n_layers, n_heads=n_heads,
+                                max_len=max_len)
+            h = _ln(h, p["final_ln.gamma"], p["final_ln.beta"])
+            logits = (h @ p["lm_head.w0"] + p["lm_head.b"]).astype(jnp.float32)
+            rng, sub = jax.random.split(rng)
+            if temperature > 0.0:
+                nxt = jax.random.categorical(sub, logits / temperature)
+            else:
+                nxt = jnp.argmax(logits)
+            nxt = nxt.astype(jnp.int32)
+            # inside the prompt, force-feed the given token (teacher forcing)
+            in_prompt = t + 1 < n_prompt
+            forced = jnp.where(in_prompt, prompt[jnp.minimum(t + 1,
+                                                             n_prompt - 1)],
+                               nxt)
+            forced = jnp.where(done, eos_id, forced)
+            done = done | (~in_prompt & (forced == eos_id))
+            return (forced, flatten(cs), rng, done), forced
+
+        init = (prompt[0], flatten(caches), rng, jnp.bool_(False))
+        _, toks = jax.lax.scan(scan_fn, init, jnp.arange(total - 1))
+        # toks[t] is the token at position t+1; generation starts after
+        # the prompt
+        return toks[n_prompt - 1:]
+
+    return run

@@ -164,3 +164,58 @@ def test_transformer_bf16_dense_activations(rng):
     assert bf16[-1] < bf16[0] * 0.6           # still learns
     # same start (loss reduces in f32 either way), close early trajectory
     assert abs(bf16[0] - f32[0]) / f32[0] < 0.05
+
+
+def test_transformer_generate_matches_iterative_forward(rng):
+    """KV-cache decode == greedy argmax over repeated full forwards."""
+    import jax
+
+    vocab, d, layers, heads = 67, 32, 2, 4
+    paddle.topology.reset_name_scope()
+    tokens, pos, target, logits, cost = transformer.build(
+        vocab_size=vocab, d_model=d, n_layers=layers, n_heads=heads,
+        max_len=64)
+    topo_logits = paddle.topology.Topology([logits])
+    params = paddle.Parameters.from_topology(
+        paddle.topology.Topology([cost]), seed=3)
+    sgd = trainer.SGD(cost=cost, parameters=params,
+                      update_equation=optimizer.Sgd())
+    pdict = sgd.parameters.as_dict()
+    needed = {k: pdict[k] for k in topo_logits.param_specs()}
+
+    prompt = rng.randint(0, vocab, size=5).tolist()
+    max_new = 6
+
+    # oracle: full forward on the sequence so far, argmax of last position
+    seq = list(prompt)
+    for _ in range(max_new):
+        feeder = sgd._make_feeder({"tokens": 0, "pos": 1, "target": 2})
+        feeds = feeder.feed([(seq, list(range(len(seq))),
+                              [0] * len(seq))])
+        outs, _ = topo_logits.forward(needed, {}, feeds, train=False)
+        lg = np.asarray(outs[0].data)[len(seq) - 1]
+        seq.append(int(np.argmax(lg)))
+    want = seq[len(prompt):]
+
+    got = transformer.generate(pdict, prompt, max_new, n_layers=layers,
+                               n_heads=heads, max_len=64)
+    assert got.tolist() == want, (got.tolist(), want)
+
+
+def test_transformer_generate_eos_padding():
+    """After eos is produced, subsequent positions repeat eos."""
+    vocab, d, layers, heads = 13, 16, 1, 2
+    paddle.topology.reset_name_scope()
+    tokens, pos, target, logits, cost = transformer.build(
+        vocab_size=vocab, d_model=d, n_layers=layers, n_heads=heads,
+        max_len=32)
+    params = paddle.Parameters.from_topology(
+        paddle.topology.Topology([cost]), seed=0)
+    pdict = {k: v for k, v in params.items()}
+    first = int(transformer.generate(pdict, [1, 2], 1, n_layers=layers,
+                                     n_heads=heads, max_len=32)[0])
+    out = transformer.generate(pdict, [1, 2], 8, n_layers=layers,
+                               n_heads=heads, max_len=32, eos_id=first)
+    # the first generated token IS the eos we chose; everything after
+    # must repeat it
+    assert all(t == first for t in out.tolist())
