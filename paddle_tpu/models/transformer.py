@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import functools
 
+import numpy as np
+
 import paddle_tpu as paddle
 from paddle_tpu import layer
 
@@ -126,22 +128,141 @@ def generate(params, prompt_ids, max_new_tokens: int, *, n_layers: int,
     (length max_new_tokens; positions after an ``eos_id`` hit repeat eos).
     """
     import jax
+
+    p, prompt, n_prompt, total = _prep_decode(
+        params, prompt_ids, max_new_tokens, max_len, "generate")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    run = _decode_fn(n_layers, n_heads, max_len, n_prompt, total,
+                     float(temperature), int(eos_id))
+    return np.asarray(run(p, prompt, rng))
+
+
+def _prep_decode(params, prompt_ids, max_new_tokens, max_len, fn_name):
+    """Shared argument conversion/validation for the decode entry points."""
     import jax.numpy as jnp
-    import numpy as np
 
     p = {k: jnp.asarray(v) for k, v in dict(params).items()}
     prompt = jnp.asarray(np.asarray(prompt_ids), jnp.int32)
     n_prompt = int(prompt.shape[0])
     if n_prompt < 1:
-        raise ValueError("generate() needs a non-empty prompt")
-    total = n_prompt + max_new_tokens
+        raise ValueError(f"{fn_name}() needs a non-empty prompt")
+    total = n_prompt + int(max_new_tokens)
     if total > max_len:
         raise ValueError(f"prompt+new = {total} exceeds max_len {max_len}")
-    if rng is None:
-        rng = jax.random.PRNGKey(0)
-    run = _decode_fn(n_layers, n_heads, max_len, n_prompt, int(total),
-                     float(temperature), int(eos_id))
-    return np.asarray(run(p, prompt, rng))
+    return p, prompt, n_prompt, total
+
+
+def _flatten_caches(cs):
+    return tuple(x for kv in cs for x in kv)
+
+
+def _unflatten_caches(flat):
+    return [(flat[2 * i], flat[2 * i + 1]) for i in range(len(flat) // 2)]
+
+
+def beam_generate(params, prompt_ids, max_new_tokens: int, *, n_layers: int,
+                  n_heads: int, beam_size: int = 4, max_len: int = 1024,
+                  eos_id: int = -1, length_penalty: float = 0.0):
+    """Beam-search decode (the transformer analog of generation.py's in-jit
+    RNN beam loop / RecurrentGradientMachine::beamSearch).
+
+    Returns (tokens [max_new_tokens] int32, score float) of the best beam.
+    Scores are sum of token log-probs, normalized by length**length_penalty
+    at the final selection (0 = pure sum, 1 = mean log-prob).
+    """
+    p, prompt, n_prompt, total = _prep_decode(
+        params, prompt_ids, max_new_tokens, max_len, "beam_generate")
+    if max_new_tokens == 0:
+        return np.zeros((0,), np.int32), 0.0
+    run = _beam_fn(n_layers, n_heads, max_len, n_prompt, total,
+                   int(beam_size), int(eos_id), float(length_penalty))
+    toks, score = run(p, prompt)
+    return np.asarray(toks), float(score)
+
+
+@functools.lru_cache(maxsize=32)
+def _beam_fn(n_layers, n_heads, max_len, n_prompt, total, beam_size, eos_id,
+             length_penalty):
+    """Jitted beam-search scan for one static config (weights are args)."""
+    import jax
+    import jax.numpy as jnp
+
+    NEG = -1e30
+
+    @jax.jit
+    def run(p, prompt):
+        d = p["tok_embed.w"].shape[1]
+        head_dim = d // n_heads
+        k = beam_size
+        max_new = total - n_prompt
+
+        def step_one(tok, caches, t):
+            x_t = p["tok_embed.w"][tok] + p["pos_embed.w"][t]
+            h, cs = _step_token(p, x_t, caches, t, n_layers=n_layers,
+                                n_heads=n_heads, max_len=max_len)
+            h = _ln(h, p["final_ln.gamma"], p["final_ln.beta"])
+            logits = (h @ p["lm_head.w0"] + p["lm_head.b"]).astype(jnp.float32)
+            return jax.nn.log_softmax(logits), cs
+
+        # ---- prefill: ONE beam consumes the prompt (no k-times waste) ---
+        pre_caches = [(jnp.zeros((max_len, n_heads, head_dim), jnp.float32),
+                       jnp.zeros((max_len, n_heads, head_dim), jnp.float32))
+                      for _ in range(n_layers)]
+
+        def prefill_fn(flat, t):
+            _, cs = step_one(prompt[t], _unflatten_caches(flat), t)
+            return _flatten_caches(cs), None
+
+        flat, _ = jax.lax.scan(prefill_fn, _flatten_caches(pre_caches),
+                               jnp.arange(n_prompt - 1))
+        # broadcast the prefilled caches to k beams
+        flat = jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (k,) + x.shape), flat)
+
+        batched = jax.vmap(step_one,
+                           in_axes=(0, [(0, 0)] * n_layers, None),
+                           out_axes=(0, [(0, 0)] * n_layers))
+
+        def scan_fn(carry, t):
+            toks, flat, scores, done, hist = carry
+            logp, cs = batched(toks, _unflatten_caches(flat), t)  # [k,V]
+            vocab = logp.shape[-1]
+            # done beams may only extend with eos at no cost; live beams
+            # add token log-probs
+            eos_row = jnp.full((vocab,), NEG).at[eos_id].set(0.0)
+            logp = jnp.where(done[:, None], eos_row[None, :], logp)
+            cand = scores[:, None] + logp                      # [k,V]
+
+            flat_cand = cand.reshape(-1)
+            top_scores, top_idx = jax.lax.top_k(flat_cand, k)
+            parent = top_idx // vocab
+            tok_next = (top_idx % vocab).astype(jnp.int32)
+
+            cs_sel = jax.tree.map(lambda x: x[parent], _flatten_caches(cs))
+            new_done = done[parent] | (tok_next == eos_id)
+            hist = hist[parent]
+            hist = jax.lax.dynamic_update_index_in_dim(
+                hist, tok_next, t - (n_prompt - 1), 1)
+            return ((tok_next, cs_sel, top_scores, new_done, hist),
+                    None)
+
+        hist0 = jnp.zeros((k, max_new), jnp.int32)
+        toks0 = jnp.broadcast_to(prompt[n_prompt - 1], (k,)).astype(jnp.int32)
+        # only beam 0 is live at entry (all beams share the prompt prefix)
+        scores0 = jnp.where(jnp.arange(k) == 0, 0.0, NEG)
+        carry = (toks0, flat, scores0, jnp.zeros((k,), jnp.bool_), hist0)
+        (toks, _, scores, done, hist), _ = jax.lax.scan(
+            scan_fn, carry, jnp.arange(n_prompt - 1, total - 1))
+        # length-normalized final selection (done beams ended at eos)
+        gen_len = jnp.where(done,
+                            jnp.argmax(hist == eos_id, axis=1) + 1, max_new)
+        norm = jnp.power(jnp.maximum(gen_len, 1).astype(jnp.float32),
+                         length_penalty)
+        best = jnp.argmax(scores / norm)
+        return hist[best], scores[best]
+
+    return run
 
 
 @functools.lru_cache(maxsize=32)
@@ -163,12 +284,7 @@ def _decode_fn(n_layers, n_heads, max_len, n_prompt, total, temperature,
         caches = [(jnp.zeros((max_len, n_heads, head_dim), jnp.float32),
                    jnp.zeros((max_len, n_heads, head_dim), jnp.float32))
                   for _ in range(n_layers)]
-
-        def flatten(cs):
-            return tuple(x for kv in cs for x in kv)
-
-        def unflatten(flat):
-            return [(flat[2 * i], flat[2 * i + 1]) for i in range(n_layers)]
+        flatten, unflatten = _flatten_caches, _unflatten_caches
 
         def scan_fn(carry, t):
             tok, flat, rng, done = carry

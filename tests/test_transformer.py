@@ -219,3 +219,67 @@ def test_transformer_generate_eos_padding():
     # the first generated token IS the eos we chose; everything after
     # must repeat it
     assert all(t == first for t in out.tolist())
+
+
+def test_beam1_matches_greedy(rng):
+    """beam_size=1 beam search must equal greedy KV-cache decode."""
+    vocab, d, layers, heads = 41, 24, 2, 3
+    paddle.topology.reset_name_scope()
+    tokens, pos, target, logits, cost = transformer.build(
+        vocab_size=vocab, d_model=d, n_layers=layers, n_heads=heads,
+        max_len=32)
+    params = paddle.Parameters.from_topology(
+        paddle.topology.Topology([cost]), seed=11)
+    pdict = {k: v for k, v in params.items()}
+    prompt = rng.randint(0, vocab, size=4).tolist()
+    greedy = transformer.generate(pdict, prompt, 7, n_layers=layers,
+                                  n_heads=heads, max_len=32)
+    beam, score = transformer.beam_generate(pdict, prompt, 7,
+                                            n_layers=layers, n_heads=heads,
+                                            beam_size=1, max_len=32)
+    assert beam.tolist() == greedy.tolist()
+    assert np.isfinite(score)
+
+
+def test_beam_finds_higher_likelihood_than_greedy(rng):
+    """A wider beam's sum-log-prob must be >= the greedy sequence's."""
+    import jax
+    import jax.numpy as jnp
+
+    vocab, d, layers, heads = 29, 24, 1, 3
+    paddle.topology.reset_name_scope()
+    tokens, pos, target, logits, cost = transformer.build(
+        vocab_size=vocab, d_model=d, n_layers=layers, n_heads=heads,
+        max_len=32)
+    params = paddle.Parameters.from_topology(
+        paddle.topology.Topology([cost]), seed=5)
+    pdict = {k: v for k, v in params.items()}
+    topo_logits = paddle.topology.Topology([logits])
+    sgd = trainer.SGD(cost=cost, parameters=params,
+                      update_equation=optimizer.Sgd())
+    needed = {k: pdict[k] for k in topo_logits.param_specs()}
+
+    def seq_logprob(seq):
+        """Sum log P(seq[i] | seq[:i]) for i >= len(prompt)."""
+        feeder = sgd._make_feeder({"tokens": 0, "pos": 1, "target": 2})
+        feeds = feeder.feed([(seq, list(range(len(seq))), [0] * len(seq))])
+        outs, _ = topo_logits.forward(needed, {}, feeds, train=False)
+        lg = np.asarray(outs[0].data)[: len(seq)]
+        lp = np.asarray(jax.nn.log_softmax(jnp.asarray(lg), axis=-1))
+        return sum(lp[i - 1, seq[i]] for i in range(4, len(seq)))
+
+    prompt = rng.randint(0, vocab, size=4).tolist()
+    greedy = transformer.generate(pdict, prompt, 6, n_layers=layers,
+                                  n_heads=heads, max_len=32)
+    beam, score = transformer.beam_generate(pdict, prompt, 6,
+                                            n_layers=layers, n_heads=heads,
+                                            beam_size=8, max_len=32)
+    lp_greedy = seq_logprob(prompt + greedy.tolist())
+    lp_beam = seq_logprob(prompt + beam.tolist())
+    # NOTE: beam >= greedy is not guaranteed in general (the greedy path
+    # can be pruned); it holds for this fixed seed/config and mainly
+    # guards against gross scoring bugs. The load-bearing assertion is
+    # the next one: the reported score must equal the true sequence
+    # log-prob computed by an independent full forward.
+    assert lp_beam >= lp_greedy - 1e-4
+    np.testing.assert_allclose(score, lp_beam, atol=2e-3)
