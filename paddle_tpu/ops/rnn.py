@@ -77,41 +77,84 @@ def gru_cell(x_proj: jax.Array, h: jax.Array, w_h: jax.Array,
 # ---------------------------------------------------------------------------
 
 
-def _lstm_fused_kernel(xp_ref, h_ref, c_ref, wh_ref, b_ref, newh_ref,
-                       newc_ref, acts_ref=None):
-    xp = xp_ref[...].astype(jnp.float32)
-    h = h_ref[...].astype(jnp.float32)
-    c = c_ref[...].astype(jnp.float32)
+def _lstm_fused_kernel_tiled(xp_ref, h_ref, c_ref, wh_ref, b_ref, newh_ref,
+                             newc_ref, acts_ref=None):
+    """Hidden-tiled variant: this grid step owns hidden units [jT, (j+1)T).
+
+    xp/b/wh arrive pre-reshaped with a separate gate axis ([B,4,T], [1,4,T],
+    [H,4,T]) so a BlockSpec can slice one hidden tile of all four gates;
+    the full previous h ([B,H]) is the gemm contraction input and is the
+    same for every tile."""
+    xp = xp_ref[...].astype(jnp.float32)            # [B, 4, T]
+    h = h_ref[...].astype(jnp.float32)              # [B, H]
+    c = c_ref[...].astype(jnp.float32)              # [B, T]
+    wh = wh_ref[...].astype(jnp.float32)            # [H, 4, T]
     gates = xp + jax.lax.dot_general(
-        h, wh_ref[...].astype(jnp.float32), (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        h, wh, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)          # [B, 4, T]
     gates = gates + b_ref[...].astype(jnp.float32)
-    hd = h.shape[1]
-    i = jax.nn.sigmoid(gates[:, :hd])
-    f = jax.nn.sigmoid(gates[:, hd:2 * hd])
-    g = jnp.tanh(gates[:, 2 * hd:3 * hd])
-    o = jax.nn.sigmoid(gates[:, 3 * hd:])
+    i = jax.nn.sigmoid(gates[:, 0])
+    f = jax.nn.sigmoid(gates[:, 1])
+    g = jnp.tanh(gates[:, 2])
+    o = jax.nn.sigmoid(gates[:, 3])
     new_c = f * c + i * g
     tanh_nc = jnp.tanh(new_c)
     newh_ref[...] = (o * tanh_nc).astype(newh_ref.dtype)
     newc_ref[...] = new_c.astype(newc_ref.dtype)
-    if acts_ref is not None:  # training variant: save for the backward
-        acts_ref[...] = jnp.concatenate([i, f, g, o, tanh_nc], axis=1)
+    if acts_ref is not None:
+        acts_ref[...] = jnp.stack([i, f, g, o, tanh_nc], axis=1)  # [B,5,T]
+
+
+def _lstm_tile(H: int, B: int):
+    """Largest hidden tile for the fused kernel: H itself (grid=(1,), the
+    whole-cell case) or a lane-aligned (multiple-of-128) divisor of H.
+    Accounting matches the 17-row single-block guard at t == H:
+    w_h slice [H,4,t] f32 + full h [B,H] + 16 [B,t] rows.
+    None = no admissible tile -> plain-XLA fallback."""
+    cands = [H] + [d for d in range(128, H, 128) if H % d == 0]
+    for t in sorted(cands, reverse=True):
+        if (H * 4 * t + B * H + B * 16 * t) * 4 <= _FUSED_VMEM_BUDGET:
+            return t
+    return None
 
 
 def _fused_call(xp, h, c, w_h, bias, interpret, save_acts: bool):
     B, H = h.shape
-    out_shape = [
+    t = _lstm_tile(H, B)
+    if t is None:
+        raise ValueError(f"no fused-LSTM tile for H={H} B={B}; "
+                         "_use_fused should have fallen back")
+    n = H // t
+    enums = [
         jax.ShapeDtypeStruct((B, H), xp.dtype),
         jax.ShapeDtypeStruct((B, H), jnp.float32),
     ]
+    out_specs = [
+        pl.BlockSpec((B, t), lambda j: (0, j)),
+        pl.BlockSpec((B, t), lambda j: (0, j)),
+    ]
     if save_acts:
-        out_shape.append(jax.ShapeDtypeStruct((B, 5 * H), jnp.float32))
-    return pl.pallas_call(
-        _lstm_fused_kernel,
-        out_shape=out_shape,
+        enums.append(jax.ShapeDtypeStruct((B, 5, H), jnp.float32))
+        out_specs.append(pl.BlockSpec((B, 5, t), lambda j: (0, 0, j)))
+    outs = pl.pallas_call(
+        _lstm_fused_kernel_tiled,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((B, 4, t), lambda j: (0, 0, j)),     # xp
+            pl.BlockSpec((B, H), lambda j: (0, 0)),           # h (full)
+            pl.BlockSpec((B, t), lambda j: (0, j)),           # c tile
+            pl.BlockSpec((H, 4, t), lambda j: (0, 0, j)),     # w_h tile
+            pl.BlockSpec((1, 4, t), lambda j: (0, 0, j)),     # bias tile
+        ],
+        out_shape=enums,
+        out_specs=out_specs,
         interpret=interpret,
-    )(xp, h, c, w_h, bias.reshape(1, -1))
+    )(xp.reshape(B, 4, H), h, c, w_h.reshape(H, 4, H),
+      bias.reshape(1, 4, H))
+    if save_acts:
+        new_h, new_c, acts = outs
+        return new_h, new_c, acts.reshape(B, 5 * H)
+    return outs
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
@@ -255,7 +298,7 @@ def _use_fused(batch: int, w_h, gate_act, cell_act, out_act) -> bool:
     return (FLAGS.use_pallas and w_h is not None
             and gate_act is jax.nn.sigmoid and cell_act is jnp.tanh
             and out_act is jnp.tanh
-            and _fused_vmem_ok(w_h, batch, 17))
+            and _lstm_tile(w_h.shape[0], batch) is not None)
 
 
 def lstm_scan(x: jax.Array, mask: jax.Array, w_x: Optional[jax.Array],

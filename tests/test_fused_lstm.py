@@ -89,13 +89,20 @@ def test_fused_no_bias_and_custom_acts_fallback(rng):
     assert np.isfinite(np.asarray(hs3)).all()
 
 
-def test_vmem_guard_falls_back_for_large_hidden():
-    """Hidden sizes whose weights exceed the per-kernel VMEM budget must
-    take the plain-XLA path instead of failing to compile."""
-    big_wh = jnp.zeros((2048, 4 * 2048), jnp.float32)
-    assert not rnn._use_fused(64, big_wh, jax.nn.sigmoid, jnp.tanh, jnp.tanh)
+def test_vmem_guard_and_tiling_coverage():
+    """Hidden sizes beyond the single-block VMEM budget now use the
+    hidden-tiled kernel when a lane-aligned tile divides H; otherwise the
+    guard still falls back to plain XLA instead of failing to compile."""
     small_wh = jnp.zeros((128, 4 * 128), jnp.float32)
     assert rnn._use_fused(64, small_wh, jax.nn.sigmoid, jnp.tanh, jnp.tanh)
+    # 2048 = 16*128: too big for one block, but tiles at t=256
+    big_wh = jnp.zeros((2048, 4 * 2048), jnp.float32)
+    assert rnn._fused_vmem_ok(big_wh, 64, 17) is False
+    assert rnn._lstm_tile(2048, 64) == 256
+    assert rnn._use_fused(64, big_wh, jax.nn.sigmoid, jnp.tanh, jnp.tanh)
+    # 1000 has no multiple-of-128 divisor: genuine plain-XLA fallback
+    odd_wh = jnp.zeros((1000, 4 * 1000), jnp.float32)
+    assert not rnn._use_fused(64, odd_wh, jax.nn.sigmoid, jnp.tanh, jnp.tanh)
 
 
 @pytest.mark.parametrize("reverse", [False, True])
@@ -127,3 +134,34 @@ def test_fused_gru_matches_plain(rng, reverse):
                                atol=1e-5)
     for a, b in zip(g_f, g_p):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_fused_tiled_large_hidden_matches_plain(rng):
+    """h=1280-class cells: w_h alone exceeds the single-block VMEM budget,
+    so the hidden-tiled grid kernel runs — values AND grads must still
+    match the plain path (covers the reference RNN benchmark's h=1280 row)."""
+    B, T, D, H = 3, 3, 5, 1280
+    assert rnn._lstm_tile(H, B) == 256  # tiled path actually engages
+    x = jnp.asarray(rng.randn(B, T, D).astype(np.float32))
+    mask = jnp.asarray(np.ones((B, T), bool))
+    w_x = jnp.asarray(rng.randn(D, 4 * H).astype(np.float32) * 0.1)
+    w_h = jnp.asarray((rng.randn(H, 4 * H) * 0.02).astype(np.float32))
+    bias = jnp.asarray(rng.randn(4 * H).astype(np.float32) * 0.1)
+
+    def loss(w_h):
+        hs, _ = rnn.lstm_scan(x, mask, w_x, w_h, bias)
+        return jnp.sum(hs ** 2)
+
+    old = FLAGS.use_pallas
+    try:
+        FLAGS.use_pallas = True
+        assert rnn._use_fused(B, w_h, jax.nn.sigmoid, jnp.tanh, jnp.tanh)
+        hs_f, _ = rnn.lstm_scan(x, mask, w_x, w_h, bias)
+        g_f = jax.grad(loss)(w_h)
+        FLAGS.use_pallas = False
+        hs_p, _ = rnn.lstm_scan(x, mask, w_x, w_h, bias)
+        g_p = jax.grad(loss)(w_h)
+    finally:
+        FLAGS.use_pallas = old
+    np.testing.assert_allclose(np.asarray(hs_f), np.asarray(hs_p), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(g_f), np.asarray(g_p), atol=1e-4)
