@@ -41,18 +41,47 @@ def cmd_train(args) -> int:
 
     cfg = _load_config(args.config)
     cost = cfg["cost"]
-    reader = cfg.get("reader")
-    if reader is None:
-        print("config must define reader() for train", file=sys.stderr)
-        return 2
     optimizer = cfg.get("optimizer") or opt_mod.Adam(learning_rate=1e-3)
     batch_size = int(cfg.get("batch_size", 32))
+    # cheap config guards BEFORE init/parameter construction: a missing
+    # reader must not pay a full random init of a large model first
+    if getattr(args, "job", "train") == "train" and cfg.get("reader") is None:
+        print("config must define reader() for train", file=sys.stderr)
+        return 2
 
     paddle.init()
     params = paddle.Parameters.from_topology(
         paddle.topology.Topology([cost]))
     sgd = trainer.SGD(cost=cost, parameters=params,
                       update_equation=optimizer)
+
+    if getattr(args, "job", "train") == "test":
+        # `paddle train --job=test` analog (Tester.cpp): evaluate a saved
+        # model on the config's test_reader (falls back to reader)
+        reader = cfg.get("test_reader") or cfg.get("reader")
+        if reader is None:
+            print("config must define test_reader()/reader() for --job=test",
+                  file=sys.stderr)
+            return 2
+        if args.init_model_tar:
+            with open(args.init_model_tar, "rb") as f:
+                sgd.parameters.init_from_tar(f)
+        elif args.save_dir:
+            # the canonical resume path: restores model state too and
+            # re-places params on the mesh
+            sgd.load_checkpoint(args.save_dir)
+        else:
+            print("--job=test needs --save_dir or --init_model_tar",
+                  file=sys.stderr)
+            return 2
+        result = sgd.test(paddle.batch(reader, batch_size))
+        metrics = " ".join(f"{k}={v:.6g}" for k, v in
+                           sorted(result.metrics.items()))
+        print(f"Test cost={result.cost:.6g}" + (f" {metrics}" if metrics
+                                                else ""))
+        return 0
+
+    reader = cfg["reader"]
     sgd.train(paddle.batch(reader, batch_size),
               num_passes=args.num_passes,
               save_dir=args.save_dir, start_pass=args.start_pass,
@@ -128,10 +157,14 @@ def main(argv: Optional[list] = None) -> int:
         description="TPU-native trainer CLI (the `paddle` script analog)")
     sub = p.add_subparsers(dest="cmd", required=True)
 
-    t = sub.add_parser("train", help="train a config")
+    t = sub.add_parser("train", help="train or evaluate a config")
     t.add_argument("--config", required=True)
+    t.add_argument("--job", choices=("train", "test"), default="train",
+                   help="test = evaluate a saved model (Tester analog)")
     t.add_argument("--num_passes", type=int, default=1)
     t.add_argument("--save_dir", default=None)
+    t.add_argument("--init_model_tar", default=None,
+                   help="parameter tar to evaluate with --job=test")
     t.add_argument("--start_pass", type=int, default=0)
     t.add_argument("--saving_period", type=int, default=1)
     t.set_defaults(fn=cmd_train)

@@ -165,3 +165,21 @@ def test_extract_embedding_rows(rng):
     got = utils.extract_embedding(params, "emb.w", [3, 1, 4])
     table = np.asarray(params["emb.w"])
     np.testing.assert_allclose(got, table[[3, 1, 4]])
+
+
+def test_cli_job_test_evaluates_saved_model(config_file, tmp_path, capsys):
+    """`paddle train --job=test` (Tester analog): train with save_dir,
+    then evaluate the checkpoint and print the test cost."""
+    from paddle_tpu import cli
+
+    save = str(tmp_path / "out")
+    assert cli.main(["train", "--config", config_file, "--num_passes", "2",
+                     "--save_dir", save]) == 0
+    capsys.readouterr()
+    assert cli.main(["train", "--config", config_file, "--job", "test",
+                     "--save_dir", save]) == 0
+    out = capsys.readouterr().out
+    assert "Test cost=" in out
+    cost = float(out.split("Test cost=")[1].split()[0])
+    # the trained model must beat untrained ~log(3)
+    assert cost < 0.9
