@@ -521,8 +521,8 @@ def worker_scaling():
 
     devs = jax.devices()
     assert len(devs) >= 8, f"need 8 virtual devices, have {len(devs)}"
-    t1 = build_and_time(None, iters=2)
-    t8 = build_and_time(make_mesh((8,), ("data",), devs[:8]), iters=2)
+    t1 = build_and_time(None, iters=3)
+    t8 = build_and_time(make_mesh((8,), ("data",), devs[:8]), iters=3)
     print(json.dumps({
         "scaling_virtual8": {
             "model": f"resnet{depth}_img{img}_bs{batch}",
