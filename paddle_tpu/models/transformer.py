@@ -161,6 +161,49 @@ def _unflatten_caches(flat):
     return [(flat[2 * i], flat[2 * i + 1]) for i in range(len(flat) // 2)]
 
 
+def block_apply(p_block, x, *, n_heads: int):
+    """Functional full-sequence decoder block: x [S, d] -> [S, d].
+
+    ``p_block`` uses the block-local names (ln1.gamma, attn.wq, ffn_up.w0,
+    ...) — one stage's slice of the training parameters. Identical math to
+    the layer-DSL block() above (causal self-attention, pre-LN, gelu FFN),
+    so a stack of these IS the trained model body; being a pure
+    (params, x) -> y function of fixed shape, it is directly a
+    parallel.pipeline stage_fn — pipeline parallelism over the flagship
+    architecture (test_pipeline_transformer pins it to the sequential
+    oracle)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.attention import mha_reference
+
+    s, d = x.shape
+    n_hd = d // n_heads
+    a_in = _ln(x, p_block["ln1.gamma"], p_block["ln1.beta"])
+    q = (a_in @ p_block["attn.wq"]).reshape(1, s, n_heads, n_hd)
+    k = (a_in @ p_block["attn.wk"]).reshape(1, s, n_heads, n_hd)
+    v = (a_in @ p_block["attn.wv"]).reshape(1, s, n_heads, n_hd)
+    out = mha_reference(q, k, v, causal=True)[0].reshape(s, d)
+    x = x + out.astype(x.dtype) @ p_block["attn.wo"]
+    f_in = _ln(x, p_block["ln2.gamma"], p_block["ln2.beta"])
+    h = jax.nn.gelu(f_in @ p_block["ffn_up.w0"] + p_block["ffn_up.b"])
+    x = x + (h @ p_block["ffn_down.w0"] + p_block["ffn_down.b"])
+    return x
+
+
+def stage_params(params, n_layers: int):
+    """Split a trained parameter dict into per-block param dicts with the
+    block-local names block_apply expects (for parallel.pipeline
+    stack_stage_params)."""
+    items = list(dict(params).items())
+    out = []
+    for i in range(n_layers):
+        prefix = f"blk{i}_"
+        out.append({k[len(prefix):]: v for k, v in items
+                    if k.startswith(prefix)})
+    return out
+
+
 def beam_generate(params, prompt_ids, max_new_tokens: int, *, n_layers: int,
                   n_heads: int, beam_size: int = 4, max_len: int = 1024,
                   eos_id: int = -1, length_penalty: float = 0.0):

@@ -94,3 +94,89 @@ def test_pipeline_training_learns(rng):
         l, p = step(p)
         losses.append(float(l))
     assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_pipeline_over_transformer_blocks(rng):
+    """GPipe over the FLAGSHIP architecture: 4 real decoder blocks as
+    pipeline stages must match applying the same trained blocks
+    sequentially — forward and grads — and the functional block must match
+    the layer-DSL training graph it mirrors."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import transformer
+    from paddle_tpu.platform.flags import FLAGS
+
+    old = FLAGS.use_bf16
+    FLAGS.use_bf16 = False
+    try:
+        vocab, d, layers, heads = 31, 16, 4, 2
+        paddle.topology.reset_name_scope()
+        tokens, pos, target, logits, cost = transformer.build(
+            vocab_size=vocab, d_model=d, n_layers=layers, n_heads=heads,
+            max_len=16)
+        topo = paddle.topology.Topology([cost])
+        params = paddle.Parameters.from_topology(topo, seed=2)
+        pdict = {k: v for k, v in params.items()}
+        blocks = transformer.stage_params(pdict, layers)
+
+        # tie the functional block to the DSL graph: embedding -> blocks
+        # sequentially == topology forward up to final_ln's input
+        toks = rng.randint(0, vocab, size=10)
+        feeder = paddle.DataFeeder(
+            [(n.name, n.input_type) for n in topo.data_nodes],
+            {"tokens": 0, "pos": 1, "target": 2})
+        feeds = feeder.feed([(toks.tolist(), list(range(10)),
+                              np.roll(toks, -1).tolist())])
+        topo_body = paddle.topology.Topology(
+            [topo.by_name[f"blk{layers - 1}_res2"]])
+        needed = {k: pdict[k] for k in topo_body.param_specs()}
+        outs, _ = topo_body.forward(needed, {}, feeds, train=False)
+        want_body = np.asarray(outs[0].data)[:10]
+
+        x = (np.asarray(pdict["tok_embed.w"])[toks]
+             + np.asarray(pdict["pos_embed.w"])[:10])
+        seq = jnp.asarray(x, jnp.float32)
+        for bp in blocks:
+            seq = transformer.block_apply(bp, seq, n_heads=heads)
+        np.testing.assert_allclose(np.asarray(seq), want_body,
+                                   atol=2e-4, rtol=1e-3)
+
+        # GPipe over the blocks == sequential blocks (fwd + grads)
+        mesh = make_mesh((layers,), ("stage",), jax.devices()[:layers])
+        stacked = stack_stage_params(blocks, mesh)
+        mbs = jnp.asarray(
+            rng.randn(5, 10, d).astype(np.float32))  # 5 microbatches
+
+        def stage_fn(p, xb):
+            return transformer.block_apply(p, xb, n_heads=heads)
+
+        got = pipeline_apply(mesh, stage_fn, stacked, mbs)
+        want = []
+        for i in range(mbs.shape[0]):
+            xb = mbs[i]
+            for bp in blocks:
+                xb = transformer.block_apply(bp, xb, n_heads=heads)
+            want.append(xb)
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.asarray(jnp.stack(want)),
+                                   atol=1e-4, rtol=1e-3)
+
+        def pipe_loss(p):
+            return jnp.sum(pipeline_apply(mesh, stage_fn, p, mbs) ** 2)
+
+        def seq_loss(plist):
+            tot = 0.0
+            for i in range(mbs.shape[0]):
+                xb = mbs[i]
+                for bp in plist:
+                    xb = transformer.block_apply(bp, xb, n_heads=heads)
+                tot = tot + jnp.sum(xb ** 2)
+            return tot
+
+        g_pipe = jax.grad(pipe_loss)(stacked)
+        g_seq = jax.grad(seq_loss)(blocks)
+        g_seq_st = jax.tree.map(lambda *xs: jnp.stack(xs), *g_seq)
+        for a, b in zip(jax.tree.leaves(g_pipe), jax.tree.leaves(g_seq_st)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-3, rtol=1e-2)
+    finally:
+        FLAGS.use_bf16 = old
