@@ -79,6 +79,88 @@ def make_model_diagram(topology: Topology,
     return "\n".join(lines)
 
 
+def gradient_check(cost, parameters, feeds, *, sample_entries: int = 8,
+                   eps: float = 1e-3, seed: int = 0,
+                   rtol: float = 2e-2) -> Dict[str, float]:
+    """Numeric-vs-analytic gradient check over a whole topology — the user
+    surface of the reference trainer's gradient check job
+    (Trainer::train's test_all_data_in_one_period gradient path and the
+    per-layer testLayerGrad strategy, gserver/tests/LayerGradUtil.h:298).
+
+    For each parameter, ``sample_entries`` random entries are perturbed
+    (central differences, f64 accumulation of the cost) and compared to
+    jax.grad of the summed cost. Returns {param_name: max relative error}
+    and raises EnforceError when any exceeds ``rtol``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.platform.flags import FLAGS
+    from paddle_tpu.trainer import _reduce_cost  # local: avoids a cycle
+
+    old_bf16 = FLAGS.use_bf16
+    FLAGS.use_bf16 = False  # central differences drown in bf16 loss noise
+    try:
+        topo = Topology([cost])
+        specs = topo.param_specs()
+        pdict = {k: jnp.asarray(v) for k, v in dict(
+            parameters.as_dict() if hasattr(parameters, "as_dict")
+            else parameters).items() if k in specs}
+        state = topo.init_state()
+
+        def loss_fn(p):
+            outs, _ = topo.forward(p, state, feeds, train=False)
+            return _reduce_cost(outs[0])
+
+        analytic = jax.grad(loss_fn)(pdict)
+        loss_jit = jax.jit(loss_fn)
+        rng = np.random.RandomState(seed)
+        report: Dict[str, float] = {}
+
+        def loss_at(name, val, i, delta):
+            flat = np.asarray(val, np.float64).ravel()
+            flat[i] += delta
+            return float(loss_jit(
+                {**pdict, name: jnp.asarray(flat.reshape(val.shape),
+                                            val.dtype)}))
+
+        for name, val in pdict.items():
+            flat_size = int(np.asarray(val).size)
+            idxs = rng.choice(flat_size, size=min(sample_entries, flat_size),
+                              replace=False)
+            worst = 0.0
+            for i in idxs:
+                ana = float(np.asarray(analytic[name]).ravel()[i])
+
+                def rel_err(e):
+                    num = (loss_at(name, val, i, +e)
+                           - loss_at(name, val, i, -e)) / (2 * e)
+                    return abs(num - ana) / max(abs(num), abs(ana), 1e-4)
+
+                err = rel_err(eps)
+                if err > rtol:
+                    # two ways central differences fail on a CORRECT
+                    # gradient: a kink (relu/abs) inside ±eps — smaller
+                    # eps shrinks the window — and f32 loss resolution
+                    # drowning a small slope — larger eps lifts the
+                    # signal above the ~1e-7 relative ulp. Retry both
+                    # before calling it wrong (the reference's
+                    # perturbation checks share these caveats,
+                    # LayerGradUtil.h:203); a genuinely wrong analytic
+                    # gradient fails at every eps.
+                    err = min(err, rel_err(eps / 8), rel_err(eps * 8))
+                worst = max(worst, err)
+            report[name] = worst
+        # full report first, ONE failure listing every offender
+        bad = {k: v for k, v in report.items() if v > rtol}
+        enforce_that(not bad, "gradient check failed: " + ", ".join(
+            f"{k}: rel err {v:.4g} > {rtol}" for k, v in sorted(bad.items())),
+            context="gradient_check")
+        return report
+    finally:
+        FLAGS.use_bf16 = old_bf16
+
+
 def param_to_text(value, path: str) -> None:
     """Dump one parameter as the embedding-model text format (reference:
     v1_api_demo/model_zoo/embedding/paraconvert.py binary2text — header

@@ -183,3 +183,42 @@ def test_cli_job_test_evaluates_saved_model(config_file, tmp_path, capsys):
     cost = float(out.split("Test cost=")[1].split()[0])
     # the trained model must beat untrained ~log(3)
     assert cost < 0.9
+
+
+def test_gradient_check_passes_and_catches_corruption(rng, monkeypatch):
+    """utils.gradient_check: numeric == analytic on a small net, and a
+    genuinely wrong analytic gradient is caught."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import layer, utils
+    from paddle_tpu.platform.enforce import EnforceError
+
+    paddle.topology.reset_name_scope()
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(6))
+    y = layer.data(name="y", type=paddle.data_type.integer_value(3))
+    h = layer.fc(input=x, size=8, act="tanh")
+    cost = layer.classification_cost(input=layer.fc(input=h, size=3),
+                                     label=y)
+    topo = paddle.topology.Topology([cost])
+    params = paddle.Parameters.from_topology(topo, seed=0)
+    feeds = {
+        "x": jax.numpy.asarray(rng.randn(4, 6).astype("float32")),
+        "y": jax.numpy.asarray(rng.randint(0, 3, size=(4, 1))),
+    }
+    report = utils.gradient_check(cost, params, feeds)
+    assert report and all(v <= 2e-2 for v in report.values())
+
+    # corrupt the ANALYTIC side for real: scale jax.grad's output 2x —
+    # the numeric side is untouched, so detection must fire
+    import pytest
+
+    real_grad = jax.grad
+
+    def bad_grad(f, *a, **kw):
+        g = real_grad(f, *a, **kw)
+        return lambda p: jax.tree.map(lambda x: 2.0 * x, g(p))
+
+    monkeypatch.setattr(jax, "grad", bad_grad)
+    with pytest.raises(EnforceError):
+        utils.gradient_check(cost, params, feeds)
