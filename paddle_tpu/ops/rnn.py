@@ -105,17 +105,23 @@ def _lstm_fused_kernel_tiled(xp_ref, h_ref, c_ref, wh_ref, b_ref, newh_ref,
         acts_ref[...] = jnp.stack([i, f, g, o, tanh_nc], axis=1)  # [B,5,T]
 
 
-def _lstm_tile(H: int, B: int):
-    """Largest hidden tile for the fused kernel: H itself (grid=(1,), the
-    whole-cell case) or a lane-aligned (multiple-of-128) divisor of H.
-    Accounting matches the 17-row single-block guard at t == H:
-    w_h slice [H,4,t] f32 + full h [B,H] + 16 [B,t] rows.
-    None = no admissible tile -> plain-XLA fallback."""
+def _hidden_tile(H: int, B: int, gate_cols: int, io_rows: int):
+    """Largest hidden tile for a fused RNN kernel: H itself (grid=(1,),
+    the whole-cell case) or a lane-aligned (multiple-of-128) divisor of H.
+    Per-tile residents: weight slice [H, gate_cols, t] f32 + the full h
+    [B, H] + ``io_rows`` [B, t] rows. None = no admissible tile ->
+    plain-XLA fallback."""
     cands = [H] + [d for d in range(128, H, 128) if H % d == 0]
     for t in sorted(cands, reverse=True):
-        if (H * 4 * t + B * H + B * 16 * t) * 4 <= _FUSED_VMEM_BUDGET:
+        if (H * gate_cols * t + B * H + B * io_rows * t) * 4 \
+                <= _FUSED_VMEM_BUDGET:
             return t
     return None
+
+
+def _lstm_tile(H: int, B: int):
+    # accounting matches the 17-row single-block guard at t == H
+    return _hidden_tile(H, B, 4, 16)
 
 
 def _fused_call(xp, h, c, w_h, bias, interpret, save_acts: bool):
@@ -225,17 +231,108 @@ def _gru_fused_kernel(xp_ref, h_ref, wh_ref, b_ref, newh_ref, acts_ref=None):
         acts_ref[...] = jnp.concatenate([z, r, c], axis=1)
 
 
+def _gru_zr_kernel_tiled(xp_ref, h_ref, wzr_ref, b_ref, z_ref, r_ref):
+    """Phase 1, hidden tile: update/reset gates for units [jT, (j+1)T)."""
+    h = h_ref[...].astype(jnp.float32)                       # [B, H]
+    zr = xp_ref[...].astype(jnp.float32) + jax.lax.dot_general(
+        h, wzr_ref[...].astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) + b_ref[...].astype(jnp.float32)
+    z_ref[...] = jax.nn.sigmoid(zr[:, 0])
+    r_ref[...] = jax.nn.sigmoid(zr[:, 1])
+
+
+def _gru_cand_kernel_tiled(rh_ref, xpc_ref, wc_ref, bc_ref, z_ref, h_ref,
+                           newh_ref, c_ref=None):
+    """Phase 2, hidden tile: candidate + output for units [jT, (j+1)T).
+    Needs the COMPLETE r*h (phase-1 result) as the gemm input — the reset
+    gate couples every hidden unit into every candidate column, which is
+    why the GRU needs two kernels where the LSTM needs one. ``c_ref``
+    (backward residual) is only written when training asks for it."""
+    rh = rh_ref[...].astype(jnp.float32)                     # [B, H]
+    c = jnp.tanh(xpc_ref[...].astype(jnp.float32) + jax.lax.dot_general(
+        rh, wc_ref[...].astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) + bc_ref[...].astype(jnp.float32))
+    z = z_ref[...].astype(jnp.float32)
+    h = h_ref[...].astype(jnp.float32)
+    newh_ref[...] = ((1.0 - z) * h + z * c).astype(newh_ref.dtype)
+    if c_ref is not None:
+        c_ref[...] = c
+
+
+def _gru_tile(H: int, B: int):
+    # the binding constraint is phase 1's w_zr slice [H, 2, t]
+    return _hidden_tile(H, B, 2, 10)
+
+
+def _gru_fused_plan(H: int, B: int, w_h):
+    """THE fused-GRU dispatch decision (used by gru_scan AND
+    _gru_fused_call so they cannot drift): "block", a tile size, or None
+    (plain-XLA fallback)."""
+    if _fused_vmem_ok(w_h, B, 11):
+        return "block"
+    return _gru_tile(H, B)
+
+
 def _gru_fused_call(xp, h, w_h, bias, interpret, save_acts: bool):
     B, H = h.shape
-    out_shape = [jax.ShapeDtypeStruct((B, H), xp.dtype)]
-    if save_acts:
-        out_shape.append(jax.ShapeDtypeStruct((B, 3 * H), jnp.float32))
-    out = pl.pallas_call(
-        _gru_fused_kernel,
-        out_shape=out_shape,
+    plan = _gru_fused_plan(H, B, w_h)
+    if plan == "block":                 # single-block fast path
+        out_shape = [jax.ShapeDtypeStruct((B, H), xp.dtype)]
+        if save_acts:
+            out_shape.append(jax.ShapeDtypeStruct((B, 3 * H), jnp.float32))
+        out = pl.pallas_call(
+            _gru_fused_kernel,
+            out_shape=out_shape,
+            interpret=interpret,
+        )(xp, h, w_h, bias.reshape(1, -1))
+        return out if save_acts else (out[0], None)
+    # two-phase hidden-tiled path (large H): zr gates, then candidate
+    t = plan
+    if t is None:
+        raise ValueError(f"no fused-GRU tile for H={H} B={B}; the caller "
+                         "should have taken the plain-XLA path")
+    n = H // t
+    z, r = pl.pallas_call(
+        _gru_zr_kernel_tiled,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((B, 2, t), lambda j: (0, 0, j)),      # xp_zr
+            pl.BlockSpec((B, H), lambda j: (0, 0)),            # h full
+            pl.BlockSpec((H, 2, t), lambda j: (0, 0, j)),      # w_zr
+            pl.BlockSpec((1, 2, t), lambda j: (0, 0, j)),      # b_zr
+        ],
+        out_shape=[jax.ShapeDtypeStruct((B, H), jnp.float32),
+                   jax.ShapeDtypeStruct((B, H), jnp.float32)],
+        out_specs=[pl.BlockSpec((B, t), lambda j: (0, j)),
+                   pl.BlockSpec((B, t), lambda j: (0, j))],
         interpret=interpret,
-    )(xp, h, w_h, bias.reshape(1, -1))
-    return out if save_acts else (out[0], None)
+    )(xp[:, : 2 * H].reshape(B, 2, H), h, w_h[:, : 2 * H].reshape(H, 2, H),
+      bias[: 2 * H].reshape(1, 2, H))
+    rh = (r * h.astype(jnp.float32))
+    out_shape = [jax.ShapeDtypeStruct((B, H), xp.dtype)]
+    out_specs = [pl.BlockSpec((B, t), lambda j: (0, j))]
+    if save_acts:  # c is a backward residual; inference skips the write
+        out_shape.append(jax.ShapeDtypeStruct((B, H), jnp.float32))
+        out_specs.append(pl.BlockSpec((B, t), lambda j: (0, j)))
+    outs = pl.pallas_call(
+        _gru_cand_kernel_tiled,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((B, H), lambda j: (0, 0)),            # r*h full
+            pl.BlockSpec((B, t), lambda j: (0, j)),            # xp_c
+            pl.BlockSpec((H, t), lambda j: (0, j)),            # w_c
+            pl.BlockSpec((1, t), lambda j: (0, j)),            # b_c
+            pl.BlockSpec((B, t), lambda j: (0, j)),            # z
+            pl.BlockSpec((B, t), lambda j: (0, j)),            # h
+        ],
+        out_shape=out_shape,
+        out_specs=out_specs,
+        interpret=interpret,
+    )(rh, xp[:, 2 * H:], w_h[:, 2 * H:], bias[2 * H:].reshape(1, H), z, h)
+    if save_acts:
+        new_h, c = outs
+        return new_h, jnp.concatenate([z, r, c], axis=1)
+    return outs[0], None
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
@@ -360,7 +457,7 @@ def gru_scan(x: jax.Array, mask: jax.Array, w_x: Optional[jax.Array],
     xp = matmul(x, w_x) if w_x is not None else x  # [B, T, 3H]
     h0 = init if init is not None else jnp.zeros((B, H), xp.dtype)
 
-    fused = FLAGS.use_pallas and _fused_vmem_ok(w_h, B, 11)
+    fused = FLAGS.use_pallas and _gru_fused_plan(H, B, w_h) is not None
     if interpret is None:
         from paddle_tpu.ops.kernel_util import interpret_default
 

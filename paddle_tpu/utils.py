@@ -125,12 +125,13 @@ def gradient_check(cost, parameters, feeds, *, sample_entries: int = 8,
                                             val.dtype)}))
 
         for name, val in pdict.items():
-            flat_size = int(np.asarray(val).size)
+            flat_size = int(val.size)
             idxs = rng.choice(flat_size, size=min(sample_entries, flat_size),
                               replace=False)
+            ana_flat = np.asarray(analytic[name]).ravel()  # one D2H copy
             worst = 0.0
             for i in idxs:
-                ana = float(np.asarray(analytic[name]).ravel()[i])
+                ana = float(ana_flat[i])
 
                 def rel_err(e):
                     num = (loss_at(name, val, i, +e)

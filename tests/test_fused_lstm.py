@@ -165,3 +165,33 @@ def test_fused_tiled_large_hidden_matches_plain(rng):
         FLAGS.use_pallas = old
     np.testing.assert_allclose(np.asarray(hs_f), np.asarray(hs_p), atol=1e-5)
     np.testing.assert_allclose(np.asarray(g_f), np.asarray(g_p), atol=1e-4)
+
+
+def test_fused_gru_tiled_large_hidden_matches_plain(rng):
+    """Large-hidden GRU runs the two-phase tiled kernels; values AND grads
+    must match the plain path."""
+    B, T, D, H = 3, 3, 5, 1280
+    assert rnn._gru_tile(H, B) is not None
+    assert not rnn._fused_vmem_ok(jnp.zeros((H, 3 * H)), B, 11)
+    x = jnp.asarray(rng.randn(B, T, D).astype(np.float32))
+    mask = jnp.asarray(np.ones((B, T), bool))
+    w_x = jnp.asarray(rng.randn(D, 3 * H).astype(np.float32) * 0.1)
+    w_h = jnp.asarray((rng.randn(H, 3 * H) * 0.02).astype(np.float32))
+    bias = jnp.asarray(rng.randn(3 * H).astype(np.float32) * 0.1)
+
+    def loss(w_h):
+        hs, _ = rnn.gru_scan(x, mask, w_x, w_h, bias)
+        return jnp.sum(hs ** 2)
+
+    old = FLAGS.use_pallas
+    try:
+        FLAGS.use_pallas = True
+        hs_f, _ = rnn.gru_scan(x, mask, w_x, w_h, bias)
+        g_f = jax.grad(loss)(w_h)
+        FLAGS.use_pallas = False
+        hs_p, _ = rnn.gru_scan(x, mask, w_x, w_h, bias)
+        g_p = jax.grad(loss)(w_h)
+    finally:
+        FLAGS.use_pallas = old
+    np.testing.assert_allclose(np.asarray(hs_f), np.asarray(hs_p), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(g_f), np.asarray(g_p), atol=1e-4)
