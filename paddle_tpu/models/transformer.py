@@ -24,21 +24,27 @@ import paddle_tpu as paddle
 from paddle_tpu import layer
 
 
-def block(x, *, n_heads: int, ffn_mult: int = 4, name: str):
-    """One pre-LN decoder block: x + MHA(LN(x)); x + FFN(LN(x))."""
+def block(x, *, n_heads: int, ffn_mult: int = 4, name: str,
+          dropout: float = 0.0):
+    """One pre-LN decoder block: x + drop(MHA(LN(x))); x + drop(FFN(LN(x)))."""
     a = layer.layer_norm(x, name=f"{name}_ln1")
     a = layer.multi_head_attention(a, num_heads=n_heads, causal=True,
                                    name=f"{name}_attn")
+    if dropout > 0.0:
+        a = layer.dropout(a, dropout, name=f"{name}_attn_drop")
     x = layer.addto(input=[x, a], name=f"{name}_res1")
     f = layer.layer_norm(x, name=f"{name}_ln2")
     f = layer.fc(input=f, size=x.size * ffn_mult, act="gelu",
                  name=f"{name}_ffn_up")
     f = layer.fc(input=f, size=x.size, name=f"{name}_ffn_down")
+    if dropout > 0.0:
+        f = layer.dropout(f, dropout, name=f"{name}_ffn_drop")
     return layer.addto(input=[x, f], name=f"{name}_res2")
 
 
 def build(vocab_size: int = 32768, d_model: int = 512, n_layers: int = 6,
-          n_heads: int = 8, max_len: int = 1024, ffn_mult: int = 4):
+          n_heads: int = 8, max_len: int = 1024, ffn_mult: int = 4,
+          dropout: float = 0.0):
     """Returns (tokens, positions, target, logits, cost).
 
     Feeds: ``tokens`` / ``target`` are integer sequences (next-token
@@ -56,7 +62,8 @@ def build(vocab_size: int = 32768, d_model: int = 512, n_layers: int = 6,
     pos_emb = layer.embedding(input=pos, size=d_model, name="pos_embed")
     x = layer.addto(input=[tok_emb, pos_emb], name="embed_sum")
     for i in range(n_layers):
-        x = block(x, n_heads=n_heads, ffn_mult=ffn_mult, name=f"blk{i}")
+        x = block(x, n_heads=n_heads, ffn_mult=ffn_mult, name=f"blk{i}",
+                  dropout=dropout)
     x = layer.layer_norm(x, name="final_ln")
     logits = layer.fc(input=x, size=vocab_size, name="lm_head")
     cost = layer.classification_cost(input=logits, label=target)
