@@ -1751,12 +1751,17 @@ def multi_head_attention(query, key=None, value=None, *, num_heads: int,
                      f"key/value capacities differ ({cap_k} vs "
                      f"{vs.capacity}) — they must come from the same "
                      "feeder bucket", context="multi_head_attention")
-        q = pmath.matmul(qs.data, p["wq"]).reshape(1, cap_q, num_heads,
-                                                   head_dim)
-        k = pmath.matmul(ks.data, p["wk"]).reshape(1, cap_k, num_heads,
-                                                   head_dim)
-        v = pmath.matmul(vs.data, p["wv"]).reshape(1, cap_k, num_heads,
-                                                   head_dim)
+        # q/k/v ride bf16 into the flash kernel under the global policy
+        # (the kernel accumulates scores/output in f32). The projections
+        # still ACCUMULATE in f32 (matmul's preferred_element_type) and
+        # round once on the way out — the policy ops/math.py documents.
+        qkv_t = pmath.compute_dtype(qs.data)
+        q = pmath.matmul(qs.data, p["wq"]).astype(qkv_t).reshape(
+            1, cap_q, num_heads, head_dim)
+        k = pmath.matmul(ks.data, p["wk"]).astype(qkv_t).reshape(
+            1, cap_k, num_heads, head_dim)
+        v = pmath.matmul(vs.data, p["wv"]).astype(qkv_t).reshape(
+            1, cap_k, num_heads, head_dim)
         out = pattn.flash_attention(
             q, k, v, segment_ids=qs.segment_ids[None, :],
             kv_segment_ids=ks.segment_ids[None, :], causal=causal)

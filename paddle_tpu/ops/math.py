@@ -17,10 +17,15 @@ import jax.numpy as jnp
 from paddle_tpu.platform.flags import FLAGS
 
 
-def _compute_dtype(x: jax.Array) -> jnp.dtype:
+def compute_dtype(x: jax.Array) -> jnp.dtype:
+    """Matmul/conv INPUT dtype under the global policy (bf16 when
+    FLAGS.use_bf16; accumulation stays f32 via preferred_element_type)."""
     if FLAGS.use_bf16 and x.dtype in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
         return jnp.dtype(jnp.bfloat16)
     return x.dtype
+
+
+_compute_dtype = compute_dtype  # internal callers predate the public name
 
 
 def matmul(a: jax.Array, b: jax.Array, *, trans_a: bool = False,
