@@ -25,21 +25,36 @@ from paddle_tpu import layer
 
 
 def block(x, *, n_heads: int, ffn_mult: int = 4, name: str,
-          dropout: float = 0.0):
-    """One pre-LN decoder block: x + drop(MHA(LN(x))); x + drop(FFN(LN(x)))."""
-    a = layer.layer_norm(x, name=f"{name}_ln1")
-    a = layer.multi_head_attention(a, num_heads=n_heads, causal=True,
+          dropout: float = 0.0, causal: bool = True, memory=None):
+    """One pre-LN transformer block: x + drop(MHA(LN(x))) [+ x +
+    cross-MHA(LN(x), memory) when ``memory`` is given]; x + drop(FFN(LN(x))).
+
+    causal=True/memory=None is the decoder-only LM block; causal=False is
+    the encoder block; memory= adds the cross-attention sub-block of the
+    encoder-decoder translation model (build_seq2seq)."""
+    idx = 1
+    a = layer.layer_norm(x, name=f"{name}_ln{idx}")
+    a = layer.multi_head_attention(a, num_heads=n_heads, causal=causal,
                                    name=f"{name}_attn")
     if dropout > 0.0:
         a = layer.dropout(a, dropout, name=f"{name}_attn_drop")
-    x = layer.addto(input=[x, a], name=f"{name}_res1")
-    f = layer.layer_norm(x, name=f"{name}_ln2")
+    x = layer.addto(input=[x, a], name=f"{name}_res{idx}")
+    if memory is not None:
+        idx += 1
+        c = layer.layer_norm(x, name=f"{name}_ln{idx}")
+        c = layer.multi_head_attention(c, key=memory, num_heads=n_heads,
+                                       causal=False, name=f"{name}_cross")
+        if dropout > 0.0:
+            c = layer.dropout(c, dropout, name=f"{name}_cross_drop")
+        x = layer.addto(input=[x, c], name=f"{name}_res{idx}")
+    idx += 1
+    f = layer.layer_norm(x, name=f"{name}_ln{idx}")
     f = layer.fc(input=f, size=x.size * ffn_mult, act="gelu",
                  name=f"{name}_ffn_up")
     f = layer.fc(input=f, size=x.size, name=f"{name}_ffn_down")
     if dropout > 0.0:
         f = layer.dropout(f, dropout, name=f"{name}_ffn_drop")
-    return layer.addto(input=[x, f], name=f"{name}_res2")
+    return layer.addto(input=[x, f], name=f"{name}_res{idx}")
 
 
 def build(vocab_size: int = 32768, d_model: int = 512, n_layers: int = 6,
@@ -68,6 +83,53 @@ def build(vocab_size: int = 32768, d_model: int = 512, n_layers: int = 6,
     logits = layer.fc(input=x, size=vocab_size, name="lm_head")
     cost = layer.classification_cost(input=logits, label=target)
     return tokens, pos, target, logits, cost
+
+
+def build_seq2seq(src_vocab: int = 30000, trg_vocab: int = 30000,
+                  d_model: int = 256, n_layers: int = 3, n_heads: int = 4,
+                  max_len: int = 256, ffn_mult: int = 4):
+    """Encoder-decoder transformer for translation — the modern successor
+    of models/seq2seq.py's RNN+attention (reference: demo/seqToseq +
+    networks.py simple_attention). Cross-attention rides the same packed
+    flash kernel (layer.multi_head_attention with key=encoder memory).
+
+    Returns (src, src_pos, trg, trg_pos, label, logits, cost). Feeds:
+    ``trg`` is the shifted-right target (<s> prefix convention is the
+    caller's), ``label`` the gold next tokens.
+    """
+    src = layer.data(name="src",
+                     type=paddle.data_type.integer_value_sequence(src_vocab))
+    src_pos = layer.data(name="src_pos",
+                         type=paddle.data_type.integer_value_sequence(max_len))
+    trg = layer.data(name="trg",
+                     type=paddle.data_type.integer_value_sequence(trg_vocab))
+    trg_pos = layer.data(name="trg_pos",
+                         type=paddle.data_type.integer_value_sequence(max_len))
+    label = layer.data(name="label",
+                       type=paddle.data_type.integer_value_sequence(trg_vocab))
+
+    # encoder: bidirectional (non-causal) self-attention blocks
+    enc = layer.addto(input=[
+        layer.embedding(input=src, size=d_model, name="src_embed"),
+        layer.embedding(input=src_pos, size=d_model, name="src_pos_embed"),
+    ], name="enc_embed_sum")
+    for i in range(n_layers):
+        enc = block(enc, n_heads=n_heads, ffn_mult=ffn_mult,
+                    name=f"enc{i}", causal=False)
+    memory = layer.layer_norm(enc, name="enc_final_ln")
+
+    # decoder: causal self-attention + cross-attention over the memory
+    dec = layer.addto(input=[
+        layer.embedding(input=trg, size=d_model, name="trg_embed"),
+        layer.embedding(input=trg_pos, size=d_model, name="trg_pos_embed"),
+    ], name="dec_embed_sum")
+    for i in range(n_layers):
+        dec = block(dec, n_heads=n_heads, ffn_mult=ffn_mult,
+                    name=f"dec{i}", causal=True, memory=memory)
+    dec = layer.layer_norm(dec, name="dec_final_ln")
+    logits = layer.fc(input=dec, size=trg_vocab, name="trg_head")
+    cost = layer.classification_cost(input=logits, label=label)
+    return src, src_pos, trg, trg_pos, label, logits, cost
 
 
 # ---------------------------------------------------------------------------

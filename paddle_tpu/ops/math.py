@@ -25,9 +25,6 @@ def compute_dtype(x: jax.Array) -> jnp.dtype:
     return x.dtype
 
 
-_compute_dtype = compute_dtype  # internal callers predate the public name
-
-
 def matmul(a: jax.Array, b: jax.Array, *, trans_a: bool = False,
            trans_b: bool = False, out_dtype=jnp.float32) -> jax.Array:
     """MXU matmul with bf16 inputs / f32 accumulation under the global policy."""
@@ -35,7 +32,7 @@ def matmul(a: jax.Array, b: jax.Array, *, trans_a: bool = False,
         a = jnp.swapaxes(a, -1, -2)
     if trans_b:
         b = jnp.swapaxes(b, -1, -2)
-    ct = _compute_dtype(a)
+    ct = compute_dtype(a)
     return jnp.matmul(a.astype(ct), b.astype(ct),
                       preferred_element_type=jnp.dtype(out_dtype))
 
