@@ -34,6 +34,19 @@ def _load_config(path: str) -> dict:
     return runpy.run_path(path)
 
 
+def _load_errors():
+    """Exception classes meaning "the model artifact on disk is missing or
+    corrupt" — a config mistake worth a one-line exit-2 message. Deliberately
+    narrow: failures AFTER a successful disk read (mesh placement, shape
+    mismatch in update_from) must keep their traceback."""
+    import tarfile
+
+    from paddle_tpu.platform.enforce import EnforceError
+
+    return (OSError, tarfile.ReadError, EnforceError, EOFError, KeyError,
+            ValueError)
+
+
 def cmd_train(args) -> int:
     import paddle_tpu as paddle
     from paddle_tpu import optimizer as opt_mod
@@ -58,18 +71,32 @@ def cmd_train(args) -> int:
     if getattr(args, "job", "train") == "test":
         # `paddle train --job=test` analog (Tester.cpp): evaluate a saved
         # model on the config's test_reader (falls back to reader)
+        _LOAD_ERRORS = _load_errors()
         reader = cfg.get("test_reader") or cfg.get("reader")
         if reader is None:
             print("config must define test_reader()/reader() for --job=test",
                   file=sys.stderr)
             return 2
         if args.init_model_tar:
-            with open(args.init_model_tar, "rb") as f:
-                sgd.parameters.init_from_tar(f)
+            try:
+                with open(args.init_model_tar, "rb") as f:
+                    sgd.parameters.init_from_tar(f)
+            except _LOAD_ERRORS as e:  # missing/corrupt tar is a config
+                print(f"cannot load model tar {args.init_model_tar}: {e}",
+                      file=sys.stderr)  # mistake, not a crash
+                return 2
         elif args.save_dir:
             # the canonical resume path: restores model state too and
-            # re-places params on the mesh
-            sgd.load_checkpoint(args.save_dir)
+            # re-places params on the mesh. Only the disk read is guarded;
+            # apply_checkpoint failures keep their traceback.
+            from paddle_tpu import checkpoint as ckpt
+            try:
+                loaded = ckpt.load_checkpoint(args.save_dir)
+            except _LOAD_ERRORS as e:
+                print(f"cannot load checkpoint from {args.save_dir}: {e}",
+                      file=sys.stderr)
+                return 2
+            sgd.apply_checkpoint(loaded)
         else:
             print("--job=test needs --save_dir or --init_model_tar",
                   file=sys.stderr)
@@ -109,13 +136,19 @@ def cmd_merge_model(args) -> int:
 
     cfg = _load_config(args.config)
     outputs = cfg.get("outputs") or cfg["cost"].inputs[0]
-    if args.model_dir:
-        params, _, _, _ = ckpt.load_checkpoint(args.model_dir)
-    elif args.model_tar:
-        with open(args.model_tar, "rb") as f:
-            params = paddle.Parameters.from_tar(f)
-    else:
-        print("need --model_dir or --model_tar", file=sys.stderr)
+    _LOAD_ERRORS = _load_errors()
+    try:
+        if args.model_dir:
+            params, _, _, _ = ckpt.load_checkpoint(args.model_dir)
+        elif args.model_tar:
+            with open(args.model_tar, "rb") as f:
+                params = paddle.Parameters.from_tar(f)
+        else:
+            print("need --model_dir or --model_tar", file=sys.stderr)
+            return 2
+    except _LOAD_ERRORS as e:
+        print(f"cannot load model from "
+              f"{args.model_dir or args.model_tar}: {e}", file=sys.stderr)
         return 2
     pexport.merge_model(outputs, params, args.output)
     print(f"wrote {args.output}")

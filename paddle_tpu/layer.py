@@ -54,6 +54,27 @@ def _resolve_act(act):
     return act_mod.get(act)
 
 
+def _cast_value(value, dtype):
+    if isinstance(value, SequenceBatch):
+        return value.with_data(value.data.astype(dtype))
+    return value.astype(dtype)
+
+
+def _act_then_cast(activation, value, dtype):
+    """Apply an activation and cast the result to the storage dtype.
+
+    Softmax-family activations normalize across a row — computing them in
+    bf16 collapses small probabilities, so they run on the f32 pre-activation
+    (the matmul accumulator dtype) and only the activated output is cast.
+    Other activations are pointwise and monotone-precision, so the cheaper
+    order (cast first, activate in storage dtype) is used.
+    """
+    if isinstance(activation, (act_mod.SoftmaxActivation,
+                               act_mod.SequenceSoftmaxActivation)):
+        return _cast_value(_apply_act(activation, value), dtype)
+    return _apply_act(activation, _cast_value(value, dtype))
+
+
 def _apply_act(activation, value):
     """Apply an activation to a dense array or tokenwise to a SequenceBatch."""
     if isinstance(activation, act_mod.SequenceSoftmaxActivation):
@@ -200,9 +221,8 @@ def fc(input, size: int, act=None, name: Optional[str] = None,
             total = y if total is None else total + y
         if has_bias:
             total = total + p["b"]
-        total = total.astype(pmath.dense_activation_dtype())
         out = _like(ins[0], total) if isinstance(ins[0], SequenceBatch) else total
-        out = _apply_act(activation, out)
+        out = _act_then_cast(activation, out, pmath.dense_activation_dtype())
         return _apply_extra(ctx, name, out, layer_attr)
 
     return LayerOutput(name=name, layer_type="fc", inputs=inputs, fn=compute,

@@ -32,14 +32,19 @@ def batch_norm(x: jax.Array, gamma: jax.Array, beta: jax.Array,
     n = x.size // x.shape[-1]
     if use_batch_stats:
         # stats in f32 (bf16 mean/var over N*H*W elements loses too many
-        # mantissa bits), via ONE fused pass: sum and sum-of-squares are a
-        # multi-output reduction XLA fuses into a single read of x, where
-        # the mean-then-squared-deviation formulation costs two passes —
-        # for a bandwidth-bound BN that second read is the dominant cost
-        s1 = jnp.sum(x.astype(jnp.float32), axis=reduce_axes)
-        s2 = jnp.sum(jnp.square(x.astype(jnp.float32)), axis=reduce_axes)
-        mean = s1 / n
-        var = jnp.maximum(s2 / n - jnp.square(mean), 0.0)
+        # mantissa bits), via ONE fused pass: both sums are a multi-output
+        # reduction XLA fuses into a single read of x, where the
+        # mean-then-squared-deviation formulation costs two passes — for a
+        # bandwidth-bound BN that second read is the dominant cost. The
+        # sums are taken about the per-channel moving mean as a pilot so
+        # E[d^2]-E[d]^2 subtracts small quantities even when |mean| >> std
+        # (the raw-moment form cancels catastrophically there).
+        pilot = jax.lax.stop_gradient(moving_mean).astype(jnp.float32)
+        d = x.astype(jnp.float32) - pilot
+        s1 = jnp.sum(d, axis=reduce_axes)
+        s2 = jnp.sum(jnp.square(d), axis=reduce_axes)
+        mean = pilot + s1 / n
+        var = jnp.maximum(s2 / n - jnp.square(s1 / n), 0.0)
         unbiased = var * (n / max(1, n - 1))
         new_mean = momentum * moving_mean + (1.0 - momentum) * mean
         new_var = momentum * moving_var + (1.0 - momentum) * unbiased
@@ -47,12 +52,14 @@ def batch_norm(x: jax.Array, gamma: jax.Array, beta: jax.Array,
         mean, var = moving_mean, moving_var
         new_mean, new_var = moving_mean, moving_var
     inv = jax.lax.rsqrt(var + eps)
-    # fold the whole affine into per-channel scale/bias (f32, C-sized) and
-    # apply in the activation dtype: the elementwise pass stays bf16 when
-    # activations are bf16 instead of round-tripping the tensor through f32
-    scale = (inv * gamma).astype(x.dtype)
-    bias = (beta - mean * inv * gamma).astype(x.dtype)
-    y = x * scale + bias
+    # fold the whole affine into per-channel scale/bias kept in f32 (the
+    # folded bias can be large relative to the normalized signal, so
+    # rounding it to bf16 before use adds error); only the final y is cast
+    # to the activation dtype — HBM traffic is the bf16 read of x and
+    # write of y either way, and XLA fuses the f32 elementwise middle
+    scale = inv * gamma.astype(jnp.float32)
+    bias = beta.astype(jnp.float32) - mean * scale
+    y = (x.astype(jnp.float32) * scale + bias).astype(x.dtype)
     return y, new_mean, new_var
 
 

@@ -567,8 +567,15 @@ class SGD:
 
     def load_checkpoint(self, root: str, pass_id: Optional[int] = None) -> None:
         from paddle_tpu import checkpoint as ckpt
-        params, opt_state, model_state, meta = ckpt.load_checkpoint(
-            root, pass_id)
+        self.apply_checkpoint(ckpt.load_checkpoint(root, pass_id))
+
+    def apply_checkpoint(self, loaded) -> None:
+        """Apply an already-read ``checkpoint.load_checkpoint`` result.
+
+        Split from :meth:`load_checkpoint` so callers can separate disk-read
+        failures (missing/corrupt artifact) from apply failures (shape or
+        mesh-placement bugs that deserve a traceback)."""
+        params, opt_state, model_state, meta = loaded
         self.parameters.update_from(params.as_dict())
         if opt_state is not None:
             self.opt_state = opt_state

@@ -185,6 +185,21 @@ def test_cli_job_test_evaluates_saved_model(config_file, tmp_path, capsys):
     assert cost < 0.9
 
 
+def test_cli_job_test_missing_checkpoint_exits_2(config_file, tmp_path, capsys):
+    """A save_dir with no checkpoint (or a corrupt tar) is a config mistake:
+    one-line stderr message and exit code 2, not a traceback."""
+    from paddle_tpu import cli
+
+    assert cli.main(["train", "--config", config_file, "--job", "test",
+                     "--save_dir", str(tmp_path / "nothing-here")]) == 2
+    assert "cannot load checkpoint" in capsys.readouterr().err
+    bad_tar = tmp_path / "bad.tar"
+    bad_tar.write_bytes(b"not a tar at all")
+    assert cli.main(["train", "--config", config_file, "--job", "test",
+                     "--init_model_tar", str(bad_tar)]) == 2
+    assert "cannot load model tar" in capsys.readouterr().err
+
+
 def test_gradient_check_passes_and_catches_corruption(rng, monkeypatch):
     """utils.gradient_check: numeric == analytic on a small net, and a
     genuinely wrong analytic gradient is caught."""
