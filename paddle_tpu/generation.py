@@ -14,10 +14,11 @@ paid a full python→C++ forward per token).
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from paddle_tpu.attr import ParamAttr
 from paddle_tpu.ops.embedding import embedding_lookup
@@ -30,7 +31,23 @@ from paddle_tpu.sequence import SequenceBatch
 from paddle_tpu.topology import (Context, LayerOutput, ParamSpec, Topology,
                                  unique_name)
 
-__all__ = ["GeneratedInput", "beam_search"]
+__all__ = ["GeneratedInput", "BeamState", "beam_search"]
+
+
+class BeamState(NamedTuple):
+    """Read-only beam snapshot handed to the user control hooks (the analog
+    of the reference's beam-search callback arguments,
+    RecurrentGradientMachine.h:73-148).
+
+    All fields are traced jax arrays — hooks run INSIDE the compiled beam
+    scan, so they must be jax-traceable (no data-dependent python control
+    flow; use jnp.where). ``t`` is the current expansion index."""
+
+    t: jax.Array          # scalar int32 — expansion step
+    tokens: jax.Array     # [B, K] int32 — last token of each beam
+    scores: jax.Array     # [B, K] f32  — cumulative log-prob per beam
+    finished: jax.Array   # [B, K] bool — beams that already emitted EOS
+    lengths: jax.Array    # [B, K] int32 — generated length per beam
 
 
 class GeneratedInput:
@@ -44,7 +61,11 @@ class GeneratedInput:
 
 
 def beam_search(step, input, bos_id: int, eos_id: int, beam_size: int = 5,
-                max_length: int = 30, name: Optional[str] = None) -> LayerOutput:
+                max_length: int = 30, name: Optional[str] = None,
+                candidate_adjust: Optional[Callable] = None,
+                host_candidate_adjust: Optional[Callable] = None,
+                path_filter: Optional[Callable] = None,
+                stop_condition: Optional[Callable] = None) -> LayerOutput:
     """Generate with beam search. ``step(*frame_args)`` must return the
     per-step *probability* layer ([B*K, vocab], softmax output), exactly like
     the reference's beam_search step contract.
@@ -52,6 +73,40 @@ def beam_search(step, input, bos_id: int, eos_id: int, beam_size: int = 5,
     The returned node's value is ``(tokens [B, K, max_length] int32,
     lengths [B, K] int32, scores [B, K] float32)`` — beams sorted best-first.
     Evaluate it with paddle.infer / Inference.
+
+    User control hooks (reference: RecurrentGradientMachine.h:73-148
+    ``beamSearchCandidateAdjust``/``stopBeamSearch`` + the host-loop
+    SequenceGenerator, api/SequenceGenerator.cpp:38-96):
+
+    - ``candidate_adjust(logp, beam)``: traced into the beam step. ``logp``
+      is the [B, K, V] continuation log-probs of the LIVE beams before the
+      finished-beam freeze; return an adjusted [B, K, V] (e.g. set a column
+      to -1e9 to forbid a token, add lexical bonuses, length penalties via
+      ``beam.lengths``). ``beam`` is a :class:`BeamState`.
+    - ``host_candidate_adjust(logp, tokens, t)``: the escape hatch for
+      python logic jnp can't express — runs on HOST via
+      ``jax.pure_callback`` with numpy arrays ([B,K,V] f32, [B,K] i32,
+      () i32) and must return a [B,K,V] array. It must be PURE: JAX may
+      cache, elide, or re-invoke it, so hooks must not rely on
+      exactly-once side effects (mutable blacklists, counters — use
+      ``jax.experimental.io_callback`` semantics yourself if you need
+      ordering). COST: one device→host→device round trip per generated
+      token and an XLA fusion break; prefer ``candidate_adjust`` whenever
+      the logic is expressible in jnp (SURVEY §7: host callbacks are
+      dispatch-bound, ~O(ms) per step over PCIe/ICI).
+    - ``path_filter(beam)``: called AFTER top-k selection with the new
+      :class:`BeamState`; return a [B, K] bool keep-mask. Dropped beams get
+      score -1e9, so any surviving alternative outranks them from then on
+      (the reference's candidate-drop). If a row's beams are ALL dropped,
+      top-k must still pick K continuations, so filtered prefixes can
+      reappear with ~-1e9 scores — callers enforcing hard constraints
+      should treat scores below ~-1e8 as "no hypothesis satisfied the
+      filter".
+    - ``stop_condition(beam)``: return a [] or [B] bool; once true for a
+      batch row, that row's beams freeze and remaining steps pass through
+      (XLA's static-shape analog of the reference's stopBeamSearch — the
+      compiled scan still runs max_length iterations, but frozen rows do
+      no state updates, so results match an early exit).
     """
     name = name or unique_name("beam_search")
     inputs = input if isinstance(input, (list, tuple)) else [input]
@@ -163,10 +218,11 @@ def beam_search(step, input, bos_id: int, eos_id: int, beam_size: int = 5,
                        * jnp.ones((B, 1)),
             "finished": jnp.zeros((B, K), bool),
             "lengths": jnp.zeros((B, K), jnp.int32),
+            "stopped": jnp.zeros((B,), bool),
             "mems": init_mems,
         }
 
-        def beam_step(state, _):
+        def beam_step(state, t):
             cur = state["tokens"].reshape(B * K)
             emb = embedding_lookup(emb_table, cur)  # [B*K, E]
             feeds = {gen_node.name: emb}
@@ -181,7 +237,19 @@ def beam_search(step, input, bos_id: int, eos_id: int, beam_size: int = 5,
             logp = jnp.log(jnp.clip(probs, 1e-20, 1.0)).reshape(B, K, V)
 
             fin = state["finished"]
-            # finished beams: freeze (only 'eos' continuation at zero cost)
+            beam_now = BeamState(t, state["tokens"], state["scores"], fin,
+                                 state["lengths"])
+            if candidate_adjust is not None:
+                logp = candidate_adjust(logp, beam_now)
+            if host_candidate_adjust is not None:
+                def _host(lp, tk, tt):
+                    return np.asarray(
+                        host_candidate_adjust(lp, tk, tt), np.float32)
+                logp = jax.pure_callback(
+                    _host, jax.ShapeDtypeStruct(logp.shape, jnp.float32),
+                    logp.astype(jnp.float32), state["tokens"], t)
+            # finished beams: freeze (only 'eos' continuation at zero cost) —
+            # applied AFTER the user adjust so hooks cannot unfreeze them
             cont = jnp.where(fin[..., None],
                              jnp.where(jnp.arange(V)[None, None, :] == eos_id,
                                        0.0, NEG),
@@ -196,6 +264,10 @@ def beam_search(step, input, bos_id: int, eos_id: int, beam_size: int = 5,
             new_fin = fin[batch_ix, parent] | (token == eos_id)
             new_len = state["lengths"][batch_ix, parent] + \
                 jnp.where(fin[batch_ix, parent], 0, 1)
+            if path_filter is not None:
+                keep = path_filter(BeamState(t, token, top_scores, new_fin,
+                                             new_len))
+                top_scores = jnp.where(keep, top_scores, NEG)
             new_mems = {}
             for mi, m in enumerate(memories):
                 lo = outs[1 + mi]
@@ -208,17 +280,39 @@ def beam_search(step, input, bos_id: int, eos_id: int, beam_size: int = 5,
                                 val[batch_ix, parent])
                 new_mems[m.node.name] = sel.reshape(B * K, -1)
 
+            # rows already stopped by stop_condition: pass everything
+            # through untouched and emit identity parents so backtracking
+            # reconstructs the frozen sequences
+            stopped = state["stopped"]
+            if stop_condition is not None:
+                row = stopped[:, None]
+                token = jnp.where(row, jnp.full_like(token, eos_id), token)
+                parent = jnp.where(
+                    row, jnp.broadcast_to(jnp.arange(K)[None, :], (B, K)),
+                    parent)
+                top_scores = jnp.where(row, state["scores"], top_scores)
+                new_fin = jnp.where(row, fin, new_fin)
+                new_len = jnp.where(row, state["lengths"], new_len)
+                new_mems = {
+                    k: jnp.where(jnp.repeat(stopped, K)[:, None],
+                                 state["mems"][k], v)
+                    for k, v in new_mems.items()}
+                stop_now = jnp.asarray(stop_condition(
+                    BeamState(t, token, top_scores, new_fin, new_len)))
+                stopped = stopped | jnp.broadcast_to(stop_now, (B,))
+
             new_state = {
                 "tokens": token,
                 "scores": top_scores,
                 "finished": new_fin,
                 "lengths": new_len,
+                "stopped": stopped,
                 "mems": new_mems,
             }
             return new_state, (token, parent)
 
-        final, (toks, parents) = jax.lax.scan(beam_step, init, None,
-                                              length=max_length)
+        final, (toks, parents) = jax.lax.scan(
+            beam_step, init, jnp.arange(max_length, dtype=jnp.int32))
 
         # backtrack beam parents to recover full sequences [B, K, T]
         def back(nxt_beam, tp):

@@ -440,9 +440,11 @@ def conv_operator(img: LayerOutput, filter: LayerOutput, filter_size: int,
         def compute(self, values):
             x, f = _data_of(values[0]), _data_of(values[1])
             B = x.shape[0]
-            h = int(round((x.shape[-1] // num_channels) ** 0.5)) if x.ndim == 2 else x.shape[1]
             if x.ndim == 2:
-                x = x.reshape(B, h, h, num_channels)
+                # flat dense image slots are CHW-major like every other
+                # image layer (_to_nhwc; reference PyDataProvider2 layout)
+                h = int(round((x.shape[-1] // num_channels) ** 0.5))
+                x = x.reshape(B, num_channels, h, h).transpose(0, 2, 3, 1)
             w = f.reshape(B, filter_size, filter_size, num_channels, num_filters)
 
             def one(xi, wi):
@@ -696,16 +698,19 @@ def clip(input, min: float, max: float, name: Optional[str] = None) -> LayerOutp
 
 @_export
 def resize(input, size: int, name: Optional[str] = None) -> LayerOutput:
-    """Reshape feature dim (reference: ResizeLayer)."""
+    """Reshape the batch matrix to `size` columns, keeping the total element
+    count — the row count becomes B*input.size/size (reference: ResizeLayer).
+    Sequences keep their token structure elsewhere; use seq_reshape for them."""
     name = name or unique_name("resize")
+    enforce_that(not input.is_sequence,
+                 "resize reshapes the dense batch matrix; use seq_reshape "
+                 "for sequences", context="resize")
 
     def compute(ctx, p, ins):
-        d = _data_of(ins[0])
-        return _like(ins[0], d.reshape(d.shape[0], size) if not isinstance(ins[0], SequenceBatch)
-                     else d.reshape(d.shape[0], size))
+        return _data_of(ins[0]).reshape(-1, size)
 
     return LayerOutput(name=name, layer_type="resize", inputs=[input], fn=compute,
-                       size=size, is_sequence=input.is_sequence)
+                       size=size, is_sequence=False)
 
 
 @_export

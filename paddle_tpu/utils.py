@@ -162,6 +162,117 @@ def gradient_check(cost, parameters, feeds, *, sample_entries: int = 8,
         FLAGS.use_bf16 = old_bf16
 
 
+def compare_topologies(node_a, node_b, feeds_a, feeds_b=None, *,
+                       seed: int = 0, param_link: Optional[Dict[str, str]] = None,
+                       check_inputs: tuple = (), rtol: float = 1e-4,
+                       atol: float = 1e-5, flags_a: Optional[Dict] = None,
+                       flags_b: Optional[Dict] = None):
+    """Assert two differently-expressed topologies compute the SAME function:
+    identical outputs AND identical gradients on the same data.
+
+    The network-equivalence harness (reference:
+    gserver/tests/test_NetworkCompare.cpp + trainer/tests/
+    test_CompareTwoNets.cpp — config pairs trained side by side with
+    compareGradient): express one computation two ways (fc vs
+    mixed-projections, lstmemory vs a recurrent_group of lstm_step, flash vs
+    plain attention kernels, ...) and require bit-level agreement to float
+    tolerance.
+
+    Parameters are LINKED BY NAME: each topology is initialized with the
+    same seed, then every parameter name they share (plus ``param_link``
+    entries mapping b-name → a-name) is copied from A into B, so linked
+    weights are identical. Use ``ParamAttr(name=...)`` in the configs to
+    give corresponding weights the same name. Gradients of the
+    mean-reduced first output are compared for every linked parameter and
+    for each feed name in ``check_inputs`` (feeds must then be identical
+    dense arrays in both feed dicts). ``flags_a``/``flags_b`` override
+    FLAGS around each side's forward+grad (e.g. ``flags_b={"use_pallas":
+    False}`` to compare a pallas kernel against its plain-XLA fallback).
+    Returns (out_a, out_b, grads_a, grads_b).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.platform.flags import FLAGS
+    from paddle_tpu.sequence import SequenceBatch
+    from paddle_tpu.trainer import _reduce_cost
+
+    feeds_b = feeds_a if feeds_b is None else feeds_b
+    param_link = dict(param_link or {})
+
+    old_bf16 = FLAGS.use_bf16
+    FLAGS.use_bf16 = False  # bit-compare needs one rounding behavior
+    try:
+        topo_a, topo_b = Topology([node_a]), Topology([node_b])
+        pa = dict(Parameters.from_topology(topo_a, seed=seed).as_dict())
+        pb = dict(Parameters.from_topology(topo_b, seed=seed).as_dict())
+        shared = sorted(set(pa) & set(pb))
+        for nb in shared:
+            param_link.setdefault(nb, nb)
+        enforce_that(bool(param_link) or bool(check_inputs),
+                     "nothing to compare gradients through — link weights "
+                     "via ParamAttr names or pass check_inputs",
+                     context="compare")
+        for nb, na in param_link.items():
+            enforce_that(np.shape(pa[na]) == np.shape(pb[nb]),
+                         f"linked param shape mismatch {na}~{nb}",
+                         context="compare")
+            pb[nb] = pa[na]
+
+        def run(topo, params, feeds, overrides):
+            olds = {k: getattr(FLAGS, k) for k in (overrides or {})}
+            for k, v in (overrides or {}).items():
+                setattr(FLAGS, k, v)
+
+            def loss_fn(p, f):
+                outs, _ = topo.forward(p, topo.init_state(), f, train=False)
+                o = outs[0]
+                return _reduce_cost(o), (o.data if isinstance(o, SequenceBatch)
+                                         else o)
+
+            in_names = [n for n in check_inputs]
+            def wrt_inputs(p, f):
+                return loss_fn(p, f)[0]
+
+            try:
+                (loss, out), gp = jax.value_and_grad(loss_fn, has_aux=True)(
+                    params, feeds)
+                gi = {}
+                if in_names:
+                    gfull = jax.grad(
+                        lambda fv: wrt_inputs(
+                            params, {**feeds, **dict(zip(in_names, fv))}))(
+                        [jnp.asarray(feeds[n], jnp.float32)
+                         for n in in_names])
+                    gi = dict(zip(in_names, gfull))
+            finally:
+                for k, v in olds.items():
+                    setattr(FLAGS, k, v)
+            return out, gp, gi
+
+        out_a, gpa, gia = run(topo_a, pa, feeds_a, flags_a)
+        out_b, gpb, gib = run(topo_b, pb, feeds_b, flags_b)
+
+        oa, ob = np.asarray(out_a), np.asarray(out_b)
+        # image layers may emit [B,H,W,C] where an equivalent mixed/operator
+        # path emits the flat [B,H*W*C]; canonicalize to per-example rows
+        np.testing.assert_allclose(oa.reshape(oa.shape[0], -1),
+                                   ob.reshape(ob.shape[0], -1),
+                                   rtol=rtol, atol=atol,
+                                   err_msg="outputs differ")
+        for nb, na in sorted(param_link.items()):
+            np.testing.assert_allclose(
+                np.asarray(gpa[na]), np.asarray(gpb[nb]), rtol=rtol,
+                atol=atol, err_msg=f"grad differs for linked param {na}~{nb}")
+        for n in check_inputs:
+            np.testing.assert_allclose(
+                np.asarray(gia[n]), np.asarray(gib[n]), rtol=rtol, atol=atol,
+                err_msg=f"grad differs for input {n}")
+        return out_a, out_b, gpa, gpb
+    finally:
+        FLAGS.use_bf16 = old_bf16
+
+
 def param_to_text(value, path: str) -> None:
     """Dump one parameter as the embedding-model text format (reference:
     v1_api_demo/model_zoo/embedding/paraconvert.py binary2text — header

@@ -30,7 +30,7 @@ V, E, B, K, T = 7, 5, 2, 3, 4
 BOS, EOS = 0, 1
 
 
-def _build():
+def _build(**beam_kwargs):
     paddle.topology.reset_name_scope()
     start = layer.data(name="start", type=paddle.data_type.dense_vector(E))
 
@@ -46,12 +46,15 @@ def _build():
                                              embedding_size=E),
                               layer.StaticInput(start)],
                        bos_id=BOS, eos_id=EOS, beam_size=K, max_length=T,
-                       name="gen")
+                       name="gen", **beam_kwargs)
     return start, beam
 
 
-def _numpy_reference(emb, out_w, start_vec):
-    """Replicate the exact beam search in numpy."""
+def _numpy_reference(emb, out_w, start_vec, adjust=None, drop=None,
+                     stop=None):
+    """Replicate the exact beam search in numpy. ``adjust(logp [K,V], t,
+    tokens, lengths)``, ``drop(tokens [K], t) -> keep [K]`` and
+    ``stop(t, lengths) -> bool`` mirror the user control hooks."""
     def soft(x):
         e = np.exp(x - x.max(-1, keepdims=True))
         return e / e.sum(-1, keepdims=True)
@@ -63,9 +66,14 @@ def _numpy_reference(emb, out_w, start_vec):
     finished = np.zeros(K, bool)
     lengths = np.zeros(K, np.int64)
     chains = [[] for _ in range(K)]
+    stopped = False
     for t in range(T):
+        if stopped:
+            break
         new_h = emb[tokens] + mems
         logp = np.log(np.clip(soft(new_h @ out_w), 1e-20, 1.0))
+        if adjust is not None:
+            logp = adjust(logp, t, tokens, lengths)
         cont = np.where(finished[:, None],
                         np.where(np.arange(V)[None, :] == EOS, 0.0, NEG), logp)
         total = scores[:, None] + cont
@@ -82,6 +90,11 @@ def _numpy_reference(emb, out_w, start_vec):
         tokens = tok
         finished = new_fin
         chains = new_chains
+        if drop is not None:
+            keep = np.asarray(drop(tokens, t))
+            scores = np.where(keep, scores, NEG)
+        if stop is not None and stop(t, lengths):
+            stopped = True
     out = np.full((K, T), EOS, np.int64)
     for k in range(K):
         seq = chains[k][: lengths[k]]
@@ -139,3 +152,146 @@ def test_beam_under_jit():
     start_val = jnp.asarray(np.random.RandomState(2).randn(B, E).astype(np.float32))
     tokens, lengths, scores = gen(params.as_dict(), start_val)
     assert tokens.shape == (B, K, T)
+
+
+# ---------------------------------------------------------------------------
+# user control hooks (reference: RecurrentGradientMachine.h:73-148 beam
+# callbacks — candidate adjust / drop / early stop — and the host-loop
+# SequenceGenerator escape hatch)
+# ---------------------------------------------------------------------------
+
+
+def _run(beam, start_val, seed=42):
+    topo = paddle.topology.Topology([beam])
+    params = paddle.Parameters.from_topology(topo, seed=seed)
+    outs, _ = topo.forward(params.as_dict(), topo.init_state(),
+                           {"start": jnp.asarray(start_val)})
+    tokens, lengths, scores = map(np.asarray, outs[0])
+    emb = np.asarray(params["tok_emb"])
+    out_w = np.asarray(params["out_w"])
+    return tokens, lengths, scores, emb, out_w
+
+
+def test_candidate_adjust_forbids_token():
+    """A traced candidate_adjust that bans token 3 must match the numpy
+    oracle with the same ban — and token 3 must never be generated."""
+    FORBID = 3
+
+    def adj(logp, beam):
+        return logp.at[:, :, FORBID].set(-1e9)
+
+    _, beam = _build(candidate_adjust=adj)
+    start_val = np.random.RandomState(0).randn(B, E).astype(np.float32)
+    tokens, lengths, scores, emb, out_w = _run(beam, start_val)
+    assert (tokens != FORBID).all()
+
+    def np_adj(logp, t, toks, lens):
+        logp = logp.copy()
+        logp[:, FORBID] = -1e9
+        return logp
+
+    for b in range(B):
+        ref_toks, ref_lens, ref_scores = _numpy_reference(
+            emb, out_w, start_val[b], adjust=np_adj)
+        np.testing.assert_array_equal(tokens[b], ref_toks)
+        np.testing.assert_array_equal(lengths[b], ref_lens)
+        np.testing.assert_allclose(scores[b], ref_scores, rtol=1e-4,
+                                   atol=1e-4)
+
+
+def test_candidate_adjust_length_reward():
+    """Hooks see the BeamState: reward continuing (discourage EOS) using
+    beam.lengths — generations must get longer than unadjusted ones."""
+    def adj(logp, beam):
+        bonus = jnp.where(beam.lengths < T, 2.0, 0.0)   # anti-EOS pressure
+        return logp.at[:, :, EOS].add(-bonus)
+
+    start_val = np.random.RandomState(3).randn(B, E).astype(np.float32)
+    _, plain = _build()
+    t0, l0, s0, emb, out_w = _run(plain, start_val)
+    _, pushed = _build(candidate_adjust=adj)
+    t1, l1, s1, _, _ = _run(pushed, start_val)
+    assert l1.sum() >= l0.sum()
+
+    def np_adj(logp, t, toks, lens):
+        logp = logp.copy()
+        logp[:, EOS] -= np.where(lens < T, 2.0, 0.0)
+        return logp
+
+    for b in range(B):
+        ref_toks, ref_lens, _ = _numpy_reference(emb, out_w, start_val[b],
+                                                 adjust=np_adj)
+        np.testing.assert_array_equal(t1[b], ref_toks)
+        np.testing.assert_array_equal(l1[b], ref_lens)
+
+
+def test_host_candidate_adjust_matches_traced():
+    """The pure_callback escape hatch gives identical results to the traced
+    hook for the same (pure) adjustment."""
+    FORBID = 2
+
+    def traced(logp, beam):
+        return logp.at[:, :, FORBID].set(-1e9)
+
+    def hosted(logp, tokens, t):
+        out = np.array(logp)
+        out[:, :, FORBID] = -1e9
+        return out
+
+    start_val = np.random.RandomState(5).randn(B, E).astype(np.float32)
+    _, beam_t = _build(candidate_adjust=traced)
+    tt, lt, st, _, _ = _run(beam_t, start_val)
+    _, beam_h = _build(host_candidate_adjust=hosted)
+    th, lh, sh, _, _ = _run(beam_h, start_val)
+    np.testing.assert_array_equal(tt, th)
+    np.testing.assert_array_equal(lt, lh)
+    np.testing.assert_allclose(st, sh, rtol=1e-5)
+    assert (th != FORBID).all()
+
+
+def test_path_filter_drops_beams():
+    """Dropping every beam whose last token is 4 must match the oracle and
+    leave no surviving (finite-score) path through token 4."""
+    BAD = 4
+
+    def filt(beam):
+        return beam.tokens != BAD
+
+    start_val = np.random.RandomState(7).randn(B, E).astype(np.float32)
+    _, beam = _build(path_filter=filt)
+    tokens, lengths, scores, emb, out_w = _run(beam, start_val)
+
+    def np_drop(toks, t):
+        return toks != BAD
+
+    for b in range(B):
+        ref_toks, ref_lens, ref_scores = _numpy_reference(
+            emb, out_w, start_val[b], drop=np_drop)
+        np.testing.assert_array_equal(tokens[b], ref_toks)
+        np.testing.assert_allclose(scores[b], ref_scores, rtol=1e-4,
+                                   atol=1e-4)
+    # any beam that still has a finite score never passed through BAD
+    for b in range(B):
+        for k in range(K):
+            if scores[b, k] > -1e8:
+                assert BAD not in tokens[b, k, : lengths[b, k]]
+
+
+def test_stop_condition_freezes_early():
+    """stop_condition at t>=1 must equal the oracle that breaks after two
+    expansions: lengths never exceed 2 even with max_length=4."""
+    def stop(beam):
+        return beam.t >= 1
+
+    start_val = np.random.RandomState(9).randn(B, E).astype(np.float32)
+    _, beam = _build(stop_condition=stop)
+    tokens, lengths, scores, emb, out_w = _run(beam, start_val)
+    assert (lengths <= 2).all()
+
+    for b in range(B):
+        ref_toks, ref_lens, ref_scores = _numpy_reference(
+            emb, out_w, start_val[b], stop=lambda t, lens: t >= 1)
+        np.testing.assert_array_equal(tokens[b], ref_toks)
+        np.testing.assert_array_equal(lengths[b], ref_lens)
+        np.testing.assert_allclose(scores[b], ref_scores, rtol=1e-4,
+                                   atol=1e-4)

@@ -32,9 +32,12 @@ def f32_math():
 
 
 def check_layer_grad(out_node, feeds, check_inputs=(), delta=1e-3,
-                     rtol=6e-2, atol=6e-3, seed=5):
+                     rtol=4e-2, atol=4e-3, seed=5, coords=8):
     """Mean-of-output loss; numeric grad on sampled coords of every param
-    (and named float inputs) vs jax.grad."""
+    (and named float inputs) vs jax.grad.
+
+    ``coords`` per tensor (reference perturbs systematically,
+    LayerGradUtil.h:203; 8 spread coords is the fast CI gate)."""
     topo = Topology([out_node])
     params = paddle.Parameters.from_topology(topo, seed=seed)
     state = topo.init_state()
@@ -50,8 +53,9 @@ def check_layer_grad(out_node, feeds, check_inputs=(), delta=1e-3,
     loss = jax.jit(loss_fn)
     ana_p = jax.grad(lambda p: loss(p, feeds))(pdict)
 
-    def sample_coords(arr, k=3):
+    def sample_coords(arr, k=None):
         flat = arr.size
+        k = coords if k is None else k
         return np.unique(np.linspace(0, flat - 1, min(k, flat)).astype(int))
 
     for name, val in pdict.items():

@@ -1,0 +1,1123 @@
+"""The FULL layer-registry sweep: every name exported by paddle_tpu.layer
+is exercised — numeric-gradient-checked when differentiable, value-checked
+against a hand oracle when not (argmax/sampling/slicing/decoding layers).
+
+Reference analog: paddle/gserver/tests/test_LayerGrad.cpp — the reference's
+core quality gate gradient-checks essentially every registered layer type
+(testLayerGrad per type, LayerGradUtil.h:298). ``test_sweep_is_complete``
+enforces the breadth: adding a layer without a sweep case fails CI.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import layer
+from paddle_tpu.sequence import SequenceBatch
+from paddle_tpu.topology import Topology
+
+from test_layer_grad import check_layer_grad, dense, make_seq
+
+RNG = np.random.RandomState(23)
+
+
+@pytest.fixture(autouse=True)
+def f32_math():
+    # numeric-vs-analytic comparison needs f32 kernels (same fixture as
+    # test_layer_grad; the bf16 MXU policy is benchmarked separately)
+    from paddle_tpu.platform.flags import FLAGS
+    old = FLAGS.use_bf16
+    FLAGS.use_bf16 = False
+    yield
+    FLAGS.use_bf16 = old
+
+
+CASES = {}
+
+
+def case(*names):
+    def deco(fn):
+        for n in names:
+            CASES[n] = fn
+        return fn
+    return deco
+
+
+def forward(out_node, feeds, seed=3, train=False, rng=None):
+    """Build a topology around one node and run it; returns (output, params)."""
+    topo = Topology([out_node])
+    params = paddle.Parameters.from_topology(topo, seed=seed)
+    outs, _ = topo.forward(params.as_dict(), topo.init_state(), feeds,
+                           train=train, rng=rng)
+    return outs[0], params
+
+
+def img_data(name, h, w, c, n=3, scale=1.0):
+    v = layer.data(name=name, type=paddle.data_type.dense_vector(h * w * c),
+                   height=h, width=w)
+    return v, (RNG.randn(n, h * w * c) * scale).astype(np.float32)
+
+
+def int_seq(name, vocab, lengths, capacity=None):
+    total = sum(lengths)
+    cap = capacity or total
+    seg = np.concatenate([np.full(L, i, np.int32)
+                          for i, L in enumerate(lengths)])
+    v = layer.data(name=name,
+                   type=paddle.data_type.integer_value_sequence(vocab))
+    sb = SequenceBatch(jnp.asarray(RNG.randint(0, vocab, (cap,)), jnp.int32),
+                       jnp.asarray(seg), jnp.asarray(lengths, jnp.int32),
+                       max_len=max(lengths))
+    return v, sb
+
+
+# ---------------------------------------------------------------------------
+# core dense layers + projections + operators (all ride `mixed`)
+# ---------------------------------------------------------------------------
+
+
+@case("data", "fc")
+def _fc():
+    x, fx = dense("x", 6)
+    check_layer_grad(layer.fc(x, size=5, act="tanh"), {"x": fx},
+                     check_inputs=["x"])
+
+
+@case("embedding")
+def _embedding():
+    ids = layer.data(name="ids", type=paddle.data_type.integer_value(11))
+    feed = RNG.randint(0, 11, (4,)).astype(np.int32)
+    check_layer_grad(layer.embedding(ids, size=5), {"ids": feed})
+
+
+@case("mixed", "full_matrix_projection")
+def _full_matrix():
+    x, fx = dense("x", 6)
+    check_layer_grad(layer.mixed(size=5, input=[
+        layer.full_matrix_projection(x, size=5)]), {"x": fx},
+        check_inputs=["x"])
+
+
+@case("trans_full_matrix_projection")
+def _trans_full_matrix():
+    x, fx = dense("x", 6)
+    check_layer_grad(layer.mixed(size=5, input=[
+        layer.trans_full_matrix_projection(x, size=5)]), {"x": fx},
+        check_inputs=["x"])
+
+
+@case("identity_projection")
+def _identity_proj():
+    x, fx = dense("x", 6)
+    check_layer_grad(layer.mixed(size=3, input=[
+        layer.identity_projection(x, offset=2, size=3)]), {"x": fx},
+        check_inputs=["x"])
+
+
+@case("slice_projection")
+def _slice_proj():
+    x, fx = dense("x", 6)
+    out = layer.mixed(size=4, input=[
+        layer.slice_projection(x, slices=[(0, 2), (4, 6)])])
+    check_layer_grad(out, {"x": fx}, check_inputs=["x"])
+    got, _ = forward(out, {"x": fx})
+    np.testing.assert_allclose(np.asarray(got),
+                               np.concatenate([fx[:, 0:2], fx[:, 4:6]], 1),
+                               rtol=1e-5)
+
+
+@case("dotmul_projection")
+def _dotmul_proj():
+    x, fx = dense("x", 6)
+    check_layer_grad(layer.mixed(size=6, input=[
+        layer.dotmul_projection(x)]), {"x": fx}, check_inputs=["x"])
+
+
+@case("scaling_projection")
+def _scaling_proj():
+    x, fx = dense("x", 6)
+    check_layer_grad(layer.mixed(size=6, input=[
+        layer.scaling_projection(x)]), {"x": fx}, check_inputs=["x"])
+
+
+@case("table_projection")
+def _table_proj():
+    ids = layer.data(name="ids", type=paddle.data_type.integer_value(9))
+    feed = RNG.randint(0, 9, (4,)).astype(np.int32)
+    check_layer_grad(layer.mixed(size=5, input=[
+        layer.table_projection(ids, size=5)]), {"ids": feed})
+
+
+@case("context_projection")
+def _context_proj():
+    s, fs = make_seq("s", 3, [3, 2])
+    check_layer_grad(layer.mixed(size=9, input=[
+        layer.context_projection(s, context_len=3, context_start=-1)]),
+        {"s": fs})
+
+
+@case("dotmul_operator")
+def _dotmul_op():
+    a, fa = dense("a", 6)
+    b, fb = dense("b", 6)
+    check_layer_grad(layer.mixed(size=6, input=[
+        layer.dotmul_operator(a, b, scale=1.5)]), {"a": fa, "b": fb},
+        check_inputs=["a", "b"])
+
+
+@case("conv_operator")
+def _conv_op():
+    img, fi = img_data("img", 4, 4, 2)
+    filt, ff = dense("filt", 3 * 3 * 2 * 2, n=3)
+    out = layer.mixed(size=2 * 2 * 2, input=[
+        layer.conv_operator(img, filt, filter_size=3, num_filters=2,
+                            num_channels=2)])
+    check_layer_grad(out, {"img": fi, "filt": ff}, delta=5e-3, rtol=6e-2,
+                     check_inputs=["img", "filt"])
+
+
+# ---------------------------------------------------------------------------
+# elementwise / math layers
+# ---------------------------------------------------------------------------
+
+
+@case("addto")
+def _addto():
+    a, fa = dense("a", 5)
+    b, fb = dense("b", 5)
+    check_layer_grad(layer.addto([a, b], act="tanh", bias_attr=True),
+                     {"a": fa, "b": fb}, check_inputs=["a", "b"])
+
+
+@case("concat")
+def _concat():
+    a, fa = dense("a", 3)
+    b, fb = dense("b", 4)
+    check_layer_grad(layer.concat([a, b], act="sigmoid"),
+                     {"a": fa, "b": fb}, check_inputs=["a", "b"])
+
+
+@case("dotmul")
+def _dotmul():
+    a, fa = dense("a", 5)
+    b, fb = dense("b", 5)
+    check_layer_grad(layer.dotmul(a, b), {"a": fa, "b": fb},
+                     check_inputs=["a", "b"])
+
+
+@case("dotmul_bcast")
+def _dotmul_bcast():
+    a, fa = dense("a", 5)
+    w, fw = dense("w", 1)
+    check_layer_grad(layer.dotmul_bcast(a, w), {"a": fa, "w": fw},
+                     check_inputs=["a", "w"])
+
+
+@case("interpolation")
+def _interpolation():
+    a, fa = dense("a", 4)
+    b, fb = dense("b", 4)
+    w, fw = dense("w", 1)
+    fw = np.clip(np.abs(fw), 0.2, 0.8).astype(np.float32)
+    out = layer.interpolation(input=[a, b], weight=w)
+    check_layer_grad(out, {"a": fa, "b": fb, "w": fw},
+                     check_inputs=["a", "b", "w"])
+    got, _ = forward(out, {"a": fa, "b": fb, "w": fw})
+    np.testing.assert_allclose(np.asarray(got), fw * fa + (1 - fw) * fb,
+                               rtol=1e-5)
+
+
+@case("scaling")
+def _scaling():
+    x, fx = dense("x", 4)
+    w, fw = dense("w", 1)
+    check_layer_grad(layer.scaling(input=x, weight=w), {"x": fx, "w": fw},
+                     check_inputs=["x", "w"])
+
+
+@case("power")
+def _power():
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    fx = (np.abs(RNG.randn(4, 4)) + 0.5).astype(np.float32)
+    w, fw = dense("w", 1)
+    fw = np.clip(fw, 0.5, 2.0).astype(np.float32)
+    check_layer_grad(layer.power(input=x, weight=w), {"x": fx, "w": fw},
+                     check_inputs=["x", "w"], delta=5e-4)
+
+
+@case("slope_intercept")
+def _slope_intercept():
+    x, fx = dense("x", 4)
+    out = layer.slope_intercept(x, slope=2.0, intercept=-1.0)
+    check_layer_grad(out, {"x": fx}, check_inputs=["x"])
+    got, _ = forward(out, {"x": fx})
+    np.testing.assert_allclose(np.asarray(got), 2.0 * fx - 1.0, rtol=1e-5)
+
+
+@case("sum_to_one_norm")
+def _sum_to_one():
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    fx = (np.abs(RNG.randn(3, 4)) + 0.1).astype(np.float32)
+    out = layer.sum_to_one_norm(x)
+    check_layer_grad(out, {"x": fx}, check_inputs=["x"])
+    got, _ = forward(out, {"x": fx})
+    np.testing.assert_allclose(np.asarray(got).sum(-1), 1.0, rtol=1e-4)
+
+
+@case("row_l2_norm")
+def _row_l2():
+    x, fx = dense("x", 4)
+    out = layer.row_l2_norm(x)
+    check_layer_grad(out, {"x": fx}, check_inputs=["x"])
+    got, _ = forward(out, {"x": fx})
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(got), axis=-1), 1.0,
+                               rtol=1e-4)
+
+
+@case("cos_sim")
+def _cos_sim():
+    a, fa = dense("a", 5)
+    b, fb = dense("b", 5)
+    out = layer.cos_sim(a, b, scale=2.0)
+    check_layer_grad(out, {"a": fa, "b": fb}, check_inputs=["a", "b"])
+    got, _ = forward(out, {"a": fa, "b": fb})
+    want = 2.0 * (fa * fb).sum(-1) / (
+        np.linalg.norm(fa, axis=-1) * np.linalg.norm(fb, axis=-1))
+    np.testing.assert_allclose(np.asarray(got)[:, 0], want, rtol=1e-4)
+
+
+@case("clip")
+def _clip():
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    fx = (RNG.rand(3, 4).astype(np.float32) - 0.5)  # interior of [-2, 2]
+    out = layer.clip(x, min=-2.0, max=2.0)
+    check_layer_grad(out, {"x": fx}, check_inputs=["x"])
+    wide = (RNG.randn(3, 4) * 5).astype(np.float32)
+    got, _ = forward(layer.clip(
+        layer.data(name="y", type=paddle.data_type.dense_vector(4)),
+        min=-1.0, max=1.0), {"y": wide})
+    np.testing.assert_allclose(np.asarray(got), np.clip(wide, -1, 1))
+
+
+@case("resize")
+def _resize():
+    x, fx = dense("x", 6, n=4)
+    out = layer.resize(x, size=3)
+    got, _ = forward(out, {"x": fx})
+    assert np.asarray(got).shape == (8, 3)
+    np.testing.assert_allclose(np.asarray(got), fx.reshape(8, 3))
+    check_layer_grad(out, {"x": fx}, check_inputs=["x"])
+
+
+@case("dropout")
+def _dropout():
+    x, fx = dense("x", 8, n=6)
+    out = layer.dropout(x, dropout_rate=0.5)
+    got, _ = forward(out, {"x": fx}, train=False)
+    np.testing.assert_allclose(np.asarray(got), fx, rtol=1e-5)
+    got_tr, _ = forward(out, {"x": fx}, train=True,
+                        rng=jax.random.PRNGKey(4))
+    a = np.asarray(got_tr)
+    assert (a == 0).any()  # some units dropped
+    kept = a != 0
+    np.testing.assert_allclose(a[kept], (fx / 0.5)[kept], rtol=1e-5)
+
+
+@case("data_norm")
+def _data_norm():
+    x, fx = dense("x", 4)
+    mean, std = [1.0, 0.0, -1.0, 2.0], [2.0, 1.0, 0.5, 4.0]
+    got, _ = forward(layer.data_norm(x, mean=mean, std=std), {"x": fx})
+    np.testing.assert_allclose(np.asarray(got),
+                               (fx - np.asarray(mean)) / np.asarray(std),
+                               rtol=1e-5)
+    got_mm, _ = forward(layer.data_norm(
+        layer.data(name="y", type=paddle.data_type.dense_vector(4)),
+        mean=mean, std=std, mode="min-max"), {"y": fx})
+    np.testing.assert_allclose(np.asarray(got_mm),
+                               (fx - np.asarray(mean)) / np.asarray(std),
+                               rtol=1e-5)
+    got_ds, _ = forward(layer.data_norm(
+        layer.data(name="z", type=paddle.data_type.dense_vector(4)),
+        std=[9.0, 99.0, 5.0, 1.0], mode="decimal-scaling"), {"z": fx})
+    np.testing.assert_allclose(np.asarray(got_ds),
+                               fx / np.array([10., 100., 10., 1.]),
+                               rtol=1e-5)
+
+
+@case("trans")
+def _trans():
+    x, fx = dense("x", 5, n=3)
+    got, _ = forward(layer.trans(x), {"x": fx})
+    np.testing.assert_allclose(np.asarray(got), fx.T)
+
+
+@case("switch_order")
+def _switch_order():
+    h, w, c = 2, 3, 2
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(h * w * c),
+                   height=h, width=w)
+    fx = RNG.randn(2, h * w * c).astype(np.float32)
+    got, _ = forward(layer.switch_order(x, reshape_to=("h", "w", "c")),
+                     {"x": fx})
+    want = fx.reshape(2, c, h, w).transpose(0, 2, 3, 1).reshape(2, -1)
+    np.testing.assert_allclose(np.asarray(got), want)
+
+
+@case("tensor")
+def _tensor():
+    a, fa = dense("a", 3)
+    b, fb = dense("b", 4)
+    check_layer_grad(layer.tensor(a, b, size=3), {"a": fa, "b": fb},
+                     check_inputs=["a", "b"])
+
+
+@case("out_prod")
+def _out_prod():
+    a, fa = dense("a", 3)
+    b, fb = dense("b", 4)
+    out = layer.out_prod(a, b)
+    check_layer_grad(out, {"a": fa, "b": fb}, check_inputs=["a", "b"])
+    got, _ = forward(out, {"a": fa, "b": fb})
+    np.testing.assert_allclose(
+        np.asarray(got),
+        np.einsum("bi,bj->bij", fa, fb).reshape(len(fa), -1), rtol=1e-5)
+
+
+@case("multiplex")
+def _multiplex():
+    idx = layer.data(name="idx", type=paddle.data_type.integer_value(2))
+    fidx = np.array([0, 1, 0, 1], np.int32)
+    a, fa = dense("a", 4)
+    b, fb = dense("b", 4)
+    out = layer.multiplex(idx, [a, b])
+    check_layer_grad(out, {"idx": fidx, "a": fa, "b": fb},
+                     check_inputs=["a", "b"])
+    got, _ = forward(out, {"idx": fidx, "a": fa, "b": fb})
+    np.testing.assert_allclose(np.asarray(got),
+                               np.where(fidx[:, None] == 0, fa, fb))
+
+
+@case("conv_shift")
+def _conv_shift():
+    a, fa = dense("a", 6)
+    b, fb = dense("b", 3)
+    check_layer_grad(layer.conv_shift(a, b), {"a": fa, "b": fb},
+                     check_inputs=["a", "b"])
+
+
+@case("linear_comb")
+def _linear_comb():
+    w, fw = dense("w", 3)
+    v, fv = dense("v", 3 * 4)
+    out = layer.linear_comb(w, v, size=4)
+    check_layer_grad(out, {"w": fw, "v": fv}, check_inputs=["w", "v"])
+    got, _ = forward(out, {"w": fw, "v": fv})
+    np.testing.assert_allclose(
+        np.asarray(got),
+        np.einsum("bm,bmd->bd", fw, fv.reshape(-1, 3, 4)), rtol=1e-5)
+
+
+@case("convex_comb")
+def _convex_comb():
+    w, fw = dense("w", 3)
+    v, fv = dense("v", 3 * 4)
+    check_layer_grad(layer.convex_comb(w, v, size=4), {"w": fw, "v": fv},
+                     check_inputs=["w", "v"])
+
+
+@case("cos_vm")
+def _cos_vm():
+    a, fa = dense("a", 4)
+    b, fb = dense("b", 3 * 4)
+    out = layer.cos_vm(a, b, size=3)
+    check_layer_grad(out, {"a": fa, "b": fb}, check_inputs=["a", "b"])
+
+
+@case("prelu")
+def _prelu():
+    x, fx = dense("x", 8)
+    check_layer_grad(layer.prelu(x, partial_sum=2), {"x": fx},
+                     check_inputs=["x"])
+
+
+@case("scale_shift")
+def _scale_shift():
+    x, fx = dense("x", 4)
+    check_layer_grad(layer.scale_shift(x), {"x": fx}, check_inputs=["x"])
+
+
+@case("get_output")
+def _get_output():
+    x, fx = dense("x", 4)
+    node = layer.fc(x, size=3, act="tanh", name="base")
+    got_direct, _ = forward(node, {"x": fx}, seed=7)
+    paddle.topology.reset_name_scope()
+    x2, _ = dense("x", 4)
+    node2 = layer.fc(x2, size=3, act="tanh", name="base")
+    got_wrapped, _ = forward(layer.get_output(node2), {"x": fx}, seed=7)
+    np.testing.assert_allclose(np.asarray(got_direct),
+                               np.asarray(got_wrapped))
+
+
+@case("print_layer")
+def _print_layer():
+    x, fx = dense("x", 4)
+    got, _ = forward(layer.print_layer(x), {"x": fx})
+    np.testing.assert_allclose(np.asarray(got), fx)
+
+
+# ---------------------------------------------------------------------------
+# image stack
+# ---------------------------------------------------------------------------
+
+
+@case("img_conv")
+def _img_conv():
+    x, fx = img_data("x", 5, 5, 2)
+    check_layer_grad(layer.img_conv(x, filter_size=3, num_filters=3,
+                                    num_channels=2, padding=1, act="relu"),
+                     {"x": fx}, delta=5e-3, rtol=6e-2)
+
+
+@case("img_pool")
+def _img_pool():
+    x, fx = img_data("x", 4, 4, 2)
+    check_layer_grad(layer.img_pool(x, pool_size=2), {"x": fx},
+                     check_inputs=["x"])
+
+
+@case("spp")
+def _spp():
+    x, fx = img_data("x", 4, 4, 2)
+    out = layer.spp(x, pyramid_height=2)
+    assert out.size == (1 + 4) * 2
+    check_layer_grad(out, {"x": fx}, check_inputs=["x"])
+
+
+@case("maxout")
+def _maxout():
+    x, fx = img_data("x", 3, 3, 4)
+    out = layer.maxout(x, groups=2)
+    check_layer_grad(out, {"x": fx}, check_inputs=["x"])
+
+
+@case("batch_norm")
+def _batch_norm():
+    x, fx = img_data("x", 4, 4, 2)
+    bn = layer.batch_norm(layer.img_conv(
+        x, filter_size=3, num_filters=2, num_channels=2, padding=1))
+    check_layer_grad(bn, {"x": fx}, delta=5e-3, rtol=8e-2)
+
+
+@case("layer_norm")
+def _layer_norm():
+    x, fx = dense("x", 6)
+    check_layer_grad(layer.layer_norm(x), {"x": fx}, check_inputs=["x"],
+                     delta=5e-3, rtol=6e-2)
+
+
+@case("img_cmrnorm")
+def _img_cmrnorm():
+    x, fx = img_data("x", 4, 4, 2)
+    check_layer_grad(layer.img_cmrnorm(x, size=3), {"x": fx},
+                     check_inputs=["x"])
+
+
+@case("bilinear_interp")
+def _bilinear():
+    x, fx = img_data("x", 3, 3, 2)
+    out = layer.bilinear_interp(x, out_size_x=5, out_size_y=5)
+    check_layer_grad(out, {"x": fx}, check_inputs=["x"])
+
+
+@case("pad")
+def _pad():
+    x, fx = img_data("x", 3, 3, 2)
+    out = layer.pad(x, pad_c=(1, 1), pad_h=(0, 1), pad_w=(1, 0))
+    check_layer_grad(out, {"x": fx}, check_inputs=["x"])
+
+
+@case("crop")
+def _crop():
+    x, fx = img_data("x", 4, 4, 2)
+    out = layer.crop(x, offset_h=1, offset_w=1, crop_h=2, crop_w=2)
+    check_layer_grad(out, {"x": fx}, check_inputs=["x"])
+
+
+@case("rotate")
+def _rotate():
+    h, w, c = 2, 3, 2
+    x, fx = img_data("x", h, w, c, n=2)
+    got, _ = forward(layer.rotate(x), {"x": fx})
+    # dense image slots are CHW-flat (reference PyDataProvider2 layout)
+    nhwc = fx.reshape(2, c, h, w).transpose(0, 2, 3, 1)
+    want = np.rot90(nhwc, k=1, axes=(1, 2))
+    np.testing.assert_allclose(np.asarray(got).reshape(want.shape), want)
+
+
+@case("block_expand")
+def _block_expand():
+    x, fx = img_data("x", 4, 4, 2)
+    out = layer.block_expand(x, block_x=2, block_y=2, stride_x=2, stride_y=2)
+    check_layer_grad(out, {"x": fx}, check_inputs=["x"])
+
+
+@case("img_conv3d")
+def _img_conv3d():
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(3 * 3 * 3 * 1))
+    fx = RNG.randn(2, 27).astype(np.float32)
+    out = layer.img_conv3d(x, filter_size=2, num_filters=2, num_channels=1,
+                           depth=3, height=3, width=3)
+    check_layer_grad(out, {"x": fx}, delta=5e-3, rtol=6e-2)
+
+
+@case("img_pool3d")
+def _img_pool3d():
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(3 * 3 * 3))
+    fx = RNG.randn(2, 27).astype(np.float32)
+    conv = layer.img_conv3d(x, filter_size=2, num_filters=2, num_channels=1,
+                            depth=3, height=3, width=3)  # sets vol_shape
+    out = layer.img_pool3d(conv, pool_size=2,
+                           pool_type=paddle.pooling.AvgPooling())
+    check_layer_grad(out, {"x": fx}, delta=5e-3, rtol=6e-2)
+
+
+@case("mdlstmemory")
+def _mdlstm():
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(2 * 2 * 2))
+    fx = RNG.randn(2, 8).astype(np.float32)
+    out = layer.mdlstmemory(x, size=2, height=2, width=2)
+    check_layer_grad(out, {"x": fx}, delta=5e-3, rtol=8e-2)
+
+
+@case("featmap_expand")
+def _featmap_expand():
+    x, fx = dense("x", 3)
+    out = layer.featmap_expand(x, num_filters=2)
+    assert out.size == 6
+    check_layer_grad(out, {"x": fx}, check_inputs=["x"])
+    got, _ = forward(out, {"x": fx})
+    np.testing.assert_allclose(np.asarray(got), np.tile(fx, (1, 2)))
+
+
+# ---------------------------------------------------------------------------
+# sequence layers
+# ---------------------------------------------------------------------------
+
+
+@case("pooling")
+def _pooling():
+    s, fs = make_seq("s", 3, [3, 2])
+    check_layer_grad(layer.pooling(s), {"s": fs})
+
+
+@case("last_seq")
+def _last_seq():
+    s, fs = make_seq("s", 3, [3, 2])
+    check_layer_grad(layer.last_seq(s), {"s": fs})
+
+
+@case("first_seq")
+def _first_seq():
+    s, fs = make_seq("s", 3, [3, 2])
+    check_layer_grad(layer.first_seq(s), {"s": fs})
+
+
+@case("expand")
+def _expand():
+    s, fs = make_seq("s", 3, [3, 2])
+    check_layer_grad(layer.expand(layer.pooling(s), s), {"s": fs})
+
+
+@case("seq_concat")
+def _seq_concat():
+    a, fa = make_seq("a", 3, [2, 2])
+    b, fb = make_seq("b", 3, [1, 2])
+    check_layer_grad(layer.seq_concat(a, b), {"a": fa, "b": fb})
+
+
+@case("seq_reshape")
+def _seq_reshape():
+    s, fs = make_seq("s", 4, [2, 2])
+    out = layer.seq_reshape(s, reshape_size=2)
+    check_layer_grad(out, {"s": fs})
+    got, _ = forward(out, {"s": fs})
+    assert got.data.shape == (8, 2)
+    np.testing.assert_allclose(np.asarray(got.lengths), [4, 4])
+
+
+@case("seq_slice")
+def _seq_slice():
+    s, fs = make_seq("s", 3, [4, 3])
+    starts = layer.data(name="st", type=paddle.data_type.integer_value(8))
+    ends = layer.data(name="en", type=paddle.data_type.integer_value(8))
+    fst = np.array([1, 0], np.int32)
+    fen = np.array([3, 2], np.int32)
+    out = layer.seq_slice(s, starts=starts, ends=ends)
+    got, _ = forward(out, {"s": fs, "st": fst, "en": fen})
+    np.testing.assert_allclose(np.asarray(got.lengths), [2, 2])
+    # kept slots hold tokens with start <= pos < end
+    pos = np.concatenate([np.arange(4), np.arange(3)])
+    seg = np.asarray(fs.segment_ids)
+    keep = (pos >= fst[seg]) & (pos < fen[seg])
+    np.testing.assert_allclose(np.asarray(got.data)[keep],
+                               np.asarray(fs.data)[keep])
+    assert (np.asarray(got.data)[~keep] == 0).all()
+
+
+@case("subseq")
+def _subseq():
+    s, fs = make_seq("s", 3, [4, 3])
+    offs = layer.data(name="of", type=paddle.data_type.integer_value(8))
+    sizes = layer.data(name="sz", type=paddle.data_type.integer_value(8))
+    out = layer.subseq(s, offs, sizes)
+    got, _ = forward(out, {"s": fs, "of": np.array([1, 0], np.int32),
+                           "sz": np.array([2, 2], np.int32)})
+    np.testing.assert_allclose(np.asarray(got.lengths), [2, 2])
+
+
+@case("kmax_seq_score")
+def _kmax():
+    s = layer.data(name="s",
+                   type=paddle.data_type.dense_vector_sequence(1))
+    scores = np.array([0.1, 0.9, 0.5, 0.3, 0.8, 0.2], np.float32)
+    seg = np.array([0, 0, 0, 1, 1, 1], np.int32)
+    sb = SequenceBatch(jnp.asarray(scores[:, None]), jnp.asarray(seg),
+                       jnp.asarray([3, 3], np.int32), max_len=3)
+    got, _ = forward(layer.kmax_seq_score(s, beam_size=2), {"s": sb})
+    np.testing.assert_array_equal(np.asarray(got), [[1, 2], [1, 0]])
+
+
+@case("sub_nested_seq")
+def _sub_nested():
+    s = layer.data(name="s", type=paddle.data_type.dense_vector_sequence(2))
+    data = RNG.randn(5, 2).astype(np.float32)
+    sb = SequenceBatch(jnp.asarray(data),
+                       jnp.asarray([0, 0, 0, 1, 1], np.int32),
+                       jnp.asarray([3, 2], np.int32),
+                       sub_segment_ids=jnp.asarray([0, 0, 1, 0, 0], np.int32),
+                       max_len=3)
+    sel = layer.data(name="sel", type=paddle.data_type.integer_value(4))
+    fsel = np.array([[0], [0]], np.int32)   # keep inner seq 0 of each
+    got, _ = forward(layer.sub_nested_seq(s, sel), {"s": sb, "sel": fsel})
+    np.testing.assert_allclose(np.asarray(got.lengths), [2, 2])
+    got_d = np.asarray(got.data)
+    np.testing.assert_allclose(got_d[[0, 1, 3, 4]], data[[0, 1, 3, 4]])
+    assert (got_d[2] == 0).all()
+
+
+@case("max_id")
+def _max_id():
+    x, fx = dense("x", 6)
+    got, _ = forward(layer.max_id(x), {"x": fx})
+    np.testing.assert_array_equal(np.asarray(got), fx.argmax(-1))
+
+
+@case("sampling_id")
+def _sampling_id():
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    peaked = np.zeros((5, 4), np.float32)
+    peaked[:, 2] = 1.0   # all mass on id 2
+    got, _ = forward(layer.sampling_id(x), {"x": peaked})
+    np.testing.assert_array_equal(np.asarray(got), np.full(5, 2))
+
+
+@case("eos")
+def _eos():
+    s = layer.data(name="s",
+                   type=paddle.data_type.integer_value_sequence(10))
+    toks = np.array([4, 7, 1, 3, 5, 5, 7, 2], np.int32)  # eos id = 7
+    seg = np.array([0, 0, 0, 0, 1, 1, 1, 1], np.int32)
+    sb = SequenceBatch(jnp.asarray(toks), jnp.asarray(seg),
+                       jnp.asarray([4, 4], np.int32), max_len=4)
+    got, _ = forward(layer.eos(s, eos_id=7), {"s": sb})
+    np.testing.assert_allclose(np.asarray(got.lengths), [1, 2])
+
+
+# ---------------------------------------------------------------------------
+# recurrent stack (memories, step cells, groups)
+# ---------------------------------------------------------------------------
+
+
+@case("lstmemory")
+def _lstmemory():
+    s, fs = make_seq("s", 4, [3, 2])
+    check_layer_grad(layer.lstmemory(layer.fc(s, size=16)), {"s": fs},
+                     delta=5e-3, rtol=8e-2)
+
+
+@case("grumemory")
+def _grumemory():
+    s, fs = make_seq("s", 4, [3, 2])
+    check_layer_grad(layer.grumemory(layer.fc(s, size=12)), {"s": fs},
+                     delta=5e-3, rtol=8e-2)
+
+
+@case("gated_recurrent")
+def _gated_recurrent():
+    assert layer.gated_recurrent is layer.grumemory
+
+
+@case("recurrent")
+def _recurrent():
+    s, fs = make_seq("s", 4, [4, 2])
+    check_layer_grad(layer.recurrent(s), {"s": fs}, delta=5e-3)
+
+
+@case("recurrent_group", "memory", "gru_step")
+def _group_gru():
+    H = 3
+    s, fs = make_seq("s", 3 * H, [3, 2])
+
+    def step(frame):
+        m = layer.memory(name="g", size=H)
+        return layer.gru_step(input=frame, output_mem=m, size=H, name="g")
+
+    grp = layer.recurrent_group(step=step, input=s, name="rg_sweep")
+    check_layer_grad(layer.pooling(grp), {"s": fs}, delta=5e-3, rtol=8e-2)
+
+
+@case("lstm_step", "lstm_step_output", "lstm_step_state", "StaticInput")
+def _group_lstm():
+    H = 3
+    s, fs = make_seq("s", 4 * H, [3, 2])
+    bias, fb = dense("bias", H, n=2)
+
+    def step(frame, static_bias):
+        c_mem = layer.memory(name="c_out", size=H)
+        h_mem = layer.memory(name="h_out", size=H)
+        st = layer.lstm_step(input=frame, state_mem=c_mem,
+                             output_mem=h_mem, size=H, name="cell")
+        h = layer.lstm_step_output(st, name="h_out")
+        c = layer.get_output(st, arg_name="state", name="c_out")
+        out = layer.addto([h, static_bias])
+        return [out, c]
+
+    outs = layer.recurrent_group(
+        step=step, input=[s, layer.StaticInput(bias)], name="rg_lstm_sweep")
+    h_seq = outs[0] if isinstance(outs, (list, tuple)) else outs
+    check_layer_grad(layer.pooling(h_seq), {"s": fs, "bias": fb},
+                     delta=5e-3, rtol=8e-2, check_inputs=["bias"])
+
+
+@case("row_conv")
+def _row_conv():
+    s, fs = make_seq("s", 3, [3, 2])
+    check_layer_grad(layer.row_conv(s, context_len=2), {"s": fs})
+
+
+@case("multi_head_attention")
+def _mha():
+    s, fs = make_seq("s", 8, [3, 2])
+    out = layer.multi_head_attention(s, num_heads=2)
+    check_layer_grad(layer.pooling(out), {"s": fs}, delta=5e-3, rtol=8e-2)
+
+
+@case("selective_fc")
+def _selective_fc():
+    x, fx = dense("x", 6)
+    check_layer_grad(layer.selective_fc(x, size=5), {"x": fx})
+
+
+# ---------------------------------------------------------------------------
+# classification-with-sampling costs + structured costs
+# ---------------------------------------------------------------------------
+
+
+@case("nce")
+def _nce():
+    x, fx = dense("x", 6)
+    lab = layer.data(name="lab", type=paddle.data_type.integer_value(8))
+    flab = RNG.randint(0, 8, (4,)).astype(np.int32)
+    check_layer_grad(layer.nce(x, lab, num_classes=8, num_neg_samples=3),
+                     {"x": fx, "lab": flab}, check_inputs=["x"])
+
+
+@case("hsigmoid")
+def _hsigmoid():
+    x, fx = dense("x", 6)
+    lab = layer.data(name="lab", type=paddle.data_type.integer_value(8))
+    flab = RNG.randint(0, 8, (4,)).astype(np.int32)
+    check_layer_grad(layer.hsigmoid(x, lab, num_classes=8),
+                     {"x": fx, "lab": flab}, check_inputs=["x"])
+
+
+@case("crf")
+def _crf():
+    s, fs = make_seq("s", 3, [3, 2])
+    lab = layer.data(name="lab",
+                     type=paddle.data_type.integer_value_sequence(3))
+    flab = SequenceBatch(
+        jnp.asarray(RNG.randint(0, 3, (5,)).astype(np.int32)),
+        fs.segment_ids, fs.lengths, max_len=fs.max_len)
+    check_layer_grad(layer.crf(input=layer.fc(s, size=3), label=lab, size=3),
+                     {"s": fs, "lab": flab}, delta=5e-3, rtol=8e-2)
+
+
+@case("crf_decoding")
+def _crf_decoding():
+    # emissions dominate the (small random-init) transitions ⇒ the decode
+    # must equal per-token argmax
+    s = layer.data(name="s", type=paddle.data_type.dense_vector_sequence(3))
+    em = np.zeros((5, 3), np.float32)
+    best = np.array([2, 0, 1, 1, 2])
+    em[np.arange(5), best] = 100.0
+    sb = SequenceBatch(jnp.asarray(em),
+                       jnp.asarray([0, 0, 0, 1, 1], np.int32),
+                       jnp.asarray([3, 2], np.int32), max_len=3)
+    got, _ = forward(layer.crf_decoding(s, size=3), {"s": sb})
+    d = np.asarray(got.data).reshape(-1)
+    np.testing.assert_array_equal(d[:5], best)
+
+
+@case("ctc")
+def _ctc():
+    s, fs = make_seq("s", 4, [4, 4])     # 3 symbols + blank
+    lab, flab = int_seq("lab", 3, [2, 1], capacity=3)
+    flab = flab.with_data(jnp.clip(flab.data, 1, 2))  # avoid blank id 0
+    check_layer_grad(layer.ctc(s, lab, blank=0), {"s": fs, "lab": flab},
+                     delta=5e-3, rtol=8e-2)
+
+
+@case("warp_ctc")
+def _warp_ctc():
+    s, fs = make_seq("s", 4, [4, 4])
+    lab, flab = int_seq("lab", 3, [2, 1], capacity=3)
+    flab = flab.with_data(jnp.clip(flab.data, 1, 2))
+    got_w, _ = forward(layer.warp_ctc(s, lab, blank=0),
+                       {"s": fs, "lab": flab}, seed=2)
+    paddle.topology.reset_name_scope()
+    s2, _ = make_seq("s", 4, [4, 4])
+    lab2 = layer.data(name="lab",
+                      type=paddle.data_type.integer_value_sequence(3))
+    got_c, _ = forward(layer.ctc(s2, lab2, blank=0),
+                       {"s": fs, "lab": flab}, seed=2)
+    np.testing.assert_allclose(np.asarray(got_w), np.asarray(got_c),
+                               rtol=1e-6)
+
+
+@case("classification_cost")
+def _classification_cost():
+    x, fx = dense("x", 5)
+    lab = layer.data(name="lab", type=paddle.data_type.integer_value(5))
+    flab = RNG.randint(0, 5, (4,)).astype(np.int32)
+    check_layer_grad(
+        layer.classification_cost(input=layer.fc(x, size=5), label=lab),
+        {"x": fx, "lab": flab}, check_inputs=["x"])
+
+
+@case("cross_entropy_cost")
+def _cross_entropy_cost():
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(5))
+    raw = RNG.rand(4, 5).astype(np.float32) + 0.2
+    probs = (raw / raw.sum(-1, keepdims=True)).astype(np.float32)
+    lab = layer.data(name="lab", type=paddle.data_type.integer_value(5))
+    flab = RNG.randint(0, 5, (4,)).astype(np.int32)
+    check_layer_grad(layer.cross_entropy_cost(x, lab),
+                     {"x": probs, "lab": flab}, check_inputs=["x"])
+
+
+@case("cross_entropy_with_selfnorm_cost")
+def _selfnorm_cost():
+    x, fx = dense("x", 5)
+    lab = layer.data(name="lab", type=paddle.data_type.integer_value(5))
+    flab = RNG.randint(0, 5, (4,)).astype(np.int32)
+    check_layer_grad(layer.cross_entropy_with_selfnorm_cost(x, lab),
+                     {"x": fx, "lab": flab}, check_inputs=["x"])
+
+
+@case("square_error_cost")
+def _square_error():
+    x, fx = dense("x", 5)
+    t, ft = dense("t", 5)
+    check_layer_grad(layer.square_error_cost(input=x, label=t),
+                     {"x": fx, "t": ft}, check_inputs=["x"])
+
+
+@case("regression_cost")
+def _regression_cost():
+    assert layer.regression_cost is layer.square_error_cost
+
+
+@case("multi_binary_label_cross_entropy_cost")
+def _multi_binary():
+    x, fx = dense("x", 5)
+    lab = layer.data(name="lab", type=paddle.data_type.dense_vector(5))
+    flab = (RNG.rand(4, 5) > 0.5).astype(np.float32)
+    check_layer_grad(
+        layer.multi_binary_label_cross_entropy_cost(x, lab),
+        {"x": fx, "lab": flab}, check_inputs=["x"])
+
+
+@case("soft_binary_class_cross_entropy_cost")
+def _soft_binary():
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(5))
+    fx = np.clip(RNG.rand(4, 5), 0.2, 0.8).astype(np.float32)
+    lab = layer.data(name="lab", type=paddle.data_type.dense_vector(5))
+    flab = RNG.rand(4, 5).astype(np.float32)
+    check_layer_grad(
+        layer.soft_binary_class_cross_entropy_cost(x, lab),
+        {"x": fx, "lab": flab}, check_inputs=["x"])
+
+
+@case("rank_cost")
+def _rank_cost():
+    left, fl = dense("left", 1)
+    right, fr = dense("right", 1)
+    lab = layer.data(name="lab", type=paddle.data_type.dense_vector(1))
+    flab = (RNG.rand(4, 1) > 0.5).astype(np.float32)
+    check_layer_grad(layer.rank_cost(left, right, lab),
+                     {"left": fl, "right": fr, "lab": flab},
+                     check_inputs=["left", "right"])
+
+
+@case("lambda_cost")
+def _lambda_cost():
+    s, fs = make_seq("s", 1, [4, 3])
+    rel = layer.data(name="rel",
+                     type=paddle.data_type.dense_vector_sequence(1))
+    frel = fs.with_data(jnp.asarray(
+        RNG.randint(0, 3, (7, 1)).astype(np.float32)))
+    check_layer_grad(layer.lambda_cost(s, rel, NDCG_num=3),
+                     {"s": fs, "rel": frel}, delta=5e-3, rtol=8e-2)
+
+
+@case("huber_regression_cost")
+def _huber_regression():
+    x, fx = dense("x", 1)
+    t, ft = dense("t", 1)
+    check_layer_grad(layer.huber_regression_cost(input=x, label=t),
+                     {"x": fx, "t": ft}, check_inputs=["x"])
+
+
+@case("huber_classification_cost")
+def _huber_classification():
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(1))
+    fx = (RNG.rand(4, 1).astype(np.float32) - 0.5)  # away from the ±1 kinks
+    lab = layer.data(name="lab", type=paddle.data_type.dense_vector(1))
+    flab = (RNG.rand(4, 1) > 0.5).astype(np.float32)
+    check_layer_grad(layer.huber_classification_cost(x, lab),
+                     {"x": fx, "lab": flab}, check_inputs=["x"])
+
+
+@case("smooth_l1_cost")
+def _smooth_l1():
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    t = layer.data(name="t", type=paddle.data_type.dense_vector(4))
+    fx = (RNG.rand(3, 4).astype(np.float32) * 0.6 - 0.3)
+    ft = (RNG.rand(3, 4).astype(np.float32) * 0.6 - 0.3)  # |diff| < 1 kink
+    check_layer_grad(layer.smooth_l1_cost(x, t), {"x": fx, "t": ft},
+                     check_inputs=["x"])
+
+
+@case("sum_cost")
+def _sum_cost():
+    x, fx = dense("x", 5)
+    check_layer_grad(layer.sum_cost(x), {"x": fx}, check_inputs=["x"])
+
+
+@case("cross_entropy_over_beam", "BeamInput")
+def _beam_cost():
+    scores = layer.data(name="scores", type=paddle.data_type.dense_vector(6))
+    fscores = RNG.randn(1, 6).astype(np.float32)
+    sel = layer.data(name="sel", type=paddle.data_type.integer_value(6))
+    fsel = np.array([[0, 2, 4]], np.int32)
+    gold = layer.data(name="gold", type=paddle.data_type.integer_value(6))
+    fgold = np.array([2], np.int32)
+    beam = layer.BeamInput(candidate_scores=scores,
+                           selected_candidates=sel, gold=gold)
+    out = layer.cross_entropy_over_beam(beam)
+    feeds = {"scores": fscores, "sel": fsel, "gold": fgold}
+    check_layer_grad(out, feeds, check_inputs=["scores"])
+    got, _ = forward(out, feeds)
+    assert float(np.asarray(got).sum()) > 0.0
+
+
+# ---------------------------------------------------------------------------
+# detection stack
+# ---------------------------------------------------------------------------
+
+
+def _ssd_graph():
+    feat, _ = img_data("feat", 2, 2, 3)
+    pb = layer.priorbox(feat, image_size=32, min_size=8, max_size=16,
+                        aspect_ratio=(2.0,))
+    num_p = pb.num_priors
+    loc = layer.data(name="loc", type=paddle.data_type.dense_vector(num_p * 4))
+    conf = layer.data(name="conf",
+                      type=paddle.data_type.dense_vector(num_p * 3))
+    return feat, pb, loc, conf, num_p
+
+
+@case("priorbox")
+def _priorbox():
+    feat, pb, *_rest, num_p = _ssd_graph()
+    got, _ = forward(pb, {"feat": np.zeros((1, 12), np.float32)})
+    a = np.asarray(got).reshape(-1)
+    assert a.shape[0] == num_p * 8
+    boxes = a[: num_p * 4].reshape(num_p, 4)
+    assert (boxes >= 0).all() and (boxes <= 1).all()
+    assert (boxes[:, 2] > boxes[:, 0]).all()  # xmax > xmin
+
+
+@case("multibox_loss")
+def _multibox_loss():
+    feat, pb, loc, conf, num_p = _ssd_graph()
+    gt = layer.data(name="gt", type=paddle.data_type.dense_vector(2 * 5))
+    cost = layer.multibox_loss(loc, conf, pb, gt, num_classes=3, max_boxes=2)
+    fgt = np.array([[1, 0.1, 0.1, 0.5, 0.5, -1, 0, 0, 0, 0]], np.float32)
+    floc = np.zeros((1, num_p * 4), np.float32)
+    fconf_good = np.zeros((1, num_p, 3), np.float32)
+    fconf_good[:, :, 1] = 4.0   # confident in the gt class everywhere
+    fconf_bad = np.zeros((1, num_p, 3), np.float32)
+    fconf_bad[:, :, 2] = 4.0    # confident in the wrong class
+    feeds = {"feat": np.zeros((1, 12), np.float32), "loc": floc, "gt": fgt}
+    good, _ = forward(cost, {**feeds, "conf": fconf_good.reshape(1, -1)})
+    paddle.topology.reset_name_scope()
+    feat, pb, loc, conf, num_p = _ssd_graph()
+    gt = layer.data(name="gt", type=paddle.data_type.dense_vector(2 * 5))
+    cost = layer.multibox_loss(loc, conf, pb, gt, num_classes=3, max_boxes=2)
+    bad, _ = forward(cost, {**feeds, "conf": fconf_bad.reshape(1, -1)})
+    assert float(np.asarray(good).sum()) < float(np.asarray(bad).sum())
+
+
+@case("detection_output")
+def _detection_output():
+    feat, pb, loc, conf, num_p = _ssd_graph()
+    det = layer.detection_output(loc, conf, pb, num_classes=3, keep_top_k=4)
+    floc = np.zeros((1, num_p * 4), np.float32)
+    fconf = np.full((1, num_p, 3), -8.0, np.float32)
+    fconf[0, 0, 1] = 8.0        # one confident detection on prior 0
+    got, _ = forward(det, {"feat": np.zeros((1, 12), np.float32),
+                           "loc": floc, "conf": fconf.reshape(1, -1)})
+    rows = np.asarray(got).reshape(4, 6)
+    kept = rows[rows[:, 0] >= 0]
+    assert len(kept) >= 1
+    assert int(kept[0, 0]) == 1 and kept[0, 1] > 0.9
+
+
+# ---------------------------------------------------------------------------
+# completeness gates
+# ---------------------------------------------------------------------------
+
+
+def test_sweep_is_complete():
+    """Every name layer.py exports has a sweep case (test_LayerGrad breadth)."""
+    missing = sorted(set(layer.__all__) - set(CASES))
+    assert not missing, f"layers with no sweep case: {missing}"
+
+
+_UNIQUE = {}
+for _n, _f in CASES.items():
+    _UNIQUE.setdefault(_f, []).append(_n)
+
+
+@pytest.mark.parametrize(
+    "fn", list(_UNIQUE),
+    ids=["+".join(sorted(ns)) for ns in _UNIQUE.values()])
+def test_layer(fn):
+    paddle.topology.reset_name_scope()
+    fn()
