@@ -1,0 +1,594 @@
+// Interpreter-free C inference runtime for AOT-exported .ptnm programs.
+//
+// Reference analog: paddle/capi (capi/gradient_machine.h:36-112) — the
+// pure-C embedded inference surface with NO Python/engine dependency in
+// the process (the property that made the reference's capi deployable on
+// Android, Dockerfile.android). The .ptnm program is the forward jaxpr
+// translated by paddle_tpu/export.py:export_aot_program into a flat
+// tensor program; this file executes it with plain C++ loops — zero
+// dependencies beyond libc/libm. The CPython-hosted StableHLO path
+// (capi.cpp) remains the full-coverage fallback; this runtime covers the
+// dense inference graphs embedders ship (MLP/CNN + softmax heads).
+//
+// Opcodes must stay in sync with export.py (OP_* constants).
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <limits>
+#include <string>
+#include <vector>
+
+namespace {
+
+enum Op : uint32_t {
+  ADD = 1, SUB = 2, MUL = 3, DIV = 4, MAX_ = 5, MIN_ = 6,
+  EXP = 7, LOG = 8, TANH = 9, LOGISTIC = 10, RSQRT = 11,
+  SQRT = 12, NEG = 13, ABS = 14,
+  DOT = 15, BCAST = 16, RESHAPE = 17, TRANSPOSE = 18,
+  RSUM = 19, RMAX = 20, CONV2D = 21, MAXPOOL = 22, SUMPOOL = 23,
+  SELECT_N = 24, CLAMP = 25, CONCAT = 26, IPOW = 27, IDENT = 28,
+};
+
+struct TensorMeta {
+  uint8_t dtype = 0;  // 0=f32 (i32 consts are widened to f32 at load)
+  std::vector<int64_t> dims;
+  int64_t size() const {
+    int64_t n = 1;
+    for (int64_t d : dims) n *= d;
+    return n;
+  }
+};
+
+struct Instr {
+  uint32_t opcode = 0;
+  std::vector<uint32_t> ins;
+  uint32_t out = 0;
+  std::vector<int64_t> attrs;
+};
+
+struct Program {
+  std::vector<TensorMeta> tensors;
+  std::vector<std::pair<uint32_t, std::string>> inputs;  // (tensor, name)
+  std::vector<uint32_t> outputs;
+  std::vector<std::pair<uint32_t, std::vector<float>>> consts;
+  std::vector<Instr> ops;
+};
+
+bool read_exact(FILE* f, void* dst, size_t n) {
+  return fread(dst, 1, n, f) == n;
+}
+
+template <typename T>
+bool rd(FILE* f, T* v) { return read_exact(f, v, sizeof(T)); }
+
+constexpr int kMaxRank = 8;
+
+bool validate_program(const Program& p);
+
+Program* load_program(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  auto fail = [&]() -> Program* { fclose(f); return nullptr; };
+
+  char magic[4];
+  if (!read_exact(f, magic, 4) || memcmp(magic, "PTNM", 4) != 0) return fail();
+  uint32_t version = 0;
+  if (!rd(f, &version) || version != 1) return fail();
+
+  auto* p = new Program();
+  auto die = [&]() -> Program* { delete p; fclose(f); return nullptr; };
+
+  uint32_t nt = 0;
+  if (!rd(f, &nt)) return die();
+  p->tensors.resize(nt);
+  for (auto& t : p->tensors) {
+    uint8_t nd = 0;
+    if (!rd(f, &t.dtype) || !rd(f, &nd)) return die();
+    t.dims.resize(nd);
+    if (nd && !read_exact(f, t.dims.data(), nd * sizeof(int64_t))) return die();
+  }
+
+  uint32_t ni = 0;
+  if (!rd(f, &ni)) return die();
+  for (uint32_t i = 0; i < ni; ++i) {
+    uint32_t tid = 0;
+    uint16_t nl = 0;
+    if (!rd(f, &tid) || !rd(f, &nl)) return die();
+    std::string name(nl, '\0');
+    if (nl && !read_exact(f, name.data(), nl)) return die();
+    p->inputs.emplace_back(tid, std::move(name));
+  }
+
+  uint32_t no = 0;
+  if (!rd(f, &no)) return die();
+  p->outputs.resize(no);
+  for (auto& o : p->outputs)
+    if (!rd(f, &o)) return die();
+
+  uint32_t nc = 0;
+  if (!rd(f, &nc)) return die();
+  for (uint32_t i = 0; i < nc; ++i) {
+    uint32_t tid = 0;
+    uint64_t nbytes = 0;
+    if (!rd(f, &tid) || !rd(f, &nbytes) || tid >= nt) return die();
+    const TensorMeta& m = p->tensors[tid];
+    std::vector<float> vals(static_cast<size_t>(m.size()));
+    if (m.dtype == 0) {
+      if (nbytes != vals.size() * 4) return die();
+      if (!read_exact(f, vals.data(), nbytes)) return die();
+    } else {  // i32 const: widen to f32 (runtime is f32-only)
+      std::vector<int32_t> raw(static_cast<size_t>(m.size()));
+      if (nbytes != raw.size() * 4) return die();
+      if (!read_exact(f, raw.data(), nbytes)) return die();
+      for (size_t k = 0; k < raw.size(); ++k)
+        vals[k] = static_cast<float>(raw[k]);
+    }
+    p->consts.emplace_back(tid, std::move(vals));
+  }
+
+  uint32_t nops = 0;
+  if (!rd(f, &nops)) return die();
+  p->ops.resize(nops);
+  for (auto& op : p->ops) {
+    uint32_t nin = 0, na = 0;
+    if (!rd(f, &op.opcode) || !rd(f, &nin)) return die();
+    op.ins.resize(nin);
+    if (nin && !read_exact(f, op.ins.data(), nin * 4)) return die();
+    if (!rd(f, &op.out) || !rd(f, &na)) return die();
+    op.attrs.resize(na);
+    if (na && !read_exact(f, op.attrs.data(), na * 8)) return die();
+  }
+  fclose(f);
+  if (!validate_program(*p)) {
+    delete p;
+    return nullptr;
+  }
+  return p;
+}
+
+// Reject malformed/corrupt programs BEFORE execution: every tensor id in
+// bounds, ranks within the executor's fixed-size index arrays, per-opcode
+// arity and attr counts — a model file from disk must never be able to
+// drive out-of-bounds indexing.
+bool validate_program(const Program& p) {
+  const size_t nt = p.tensors.size();
+  for (const auto& t : p.tensors)
+    if (t.dims.size() > kMaxRank) return false;
+  for (const auto& in : p.inputs)
+    if (in.first >= nt) return false;
+  for (uint32_t o : p.outputs)
+    if (o >= nt) return false;
+  for (const auto& op : p.ops) {
+    if (op.out >= nt) return false;
+    for (uint32_t i : op.ins)
+      if (i >= nt) return false;
+    size_t nin = op.ins.size(), na = op.attrs.size();
+    int out_rank = static_cast<int>(p.tensors[op.out].dims.size());
+    switch (op.opcode) {
+      case ADD: case SUB: case MUL: case DIV: case MAX_: case MIN_:
+      case DOT:
+        if (nin != 2) return false;
+        break;
+      case EXP: case LOG: case TANH: case LOGISTIC: case RSQRT:
+      case SQRT: case NEG: case ABS: case RESHAPE: case IDENT:
+        if (nin != 1) return false;
+        break;
+      case IPOW:
+        if (nin != 1 || na != 1) return false;
+        break;
+      case BCAST:
+        if (nin != 1 ||
+            na != p.tensors[op.ins[0]].dims.size())
+          return false;
+        for (int64_t d : op.attrs)
+          if (d < 0 || d >= out_rank) return false;
+        break;
+      case TRANSPOSE: {
+        if (nin != 1) return false;
+        int in_rank = static_cast<int>(p.tensors[op.ins[0]].dims.size());
+        if (static_cast<int>(na) != in_rank) return false;
+        for (int64_t d : op.attrs)
+          if (d < 0 || d >= in_rank) return false;
+        break;
+      }
+      case RSUM: case RMAX: {
+        if (nin != 1) return false;
+        int in_rank = static_cast<int>(p.tensors[op.ins[0]].dims.size());
+        for (int64_t ax : op.attrs)
+          if (ax < 0 || ax >= in_rank) return false;
+        break;
+      }
+      case CONV2D:
+        if (nin != 2 || na != 6) return false;
+        if (p.tensors[op.ins[0]].dims.size() != 4 ||
+            p.tensors[op.ins[1]].dims.size() != 4 || out_rank != 4)
+          return false;
+        break;
+      case MAXPOOL: case SUMPOOL:
+        if (nin != 1 || na != 8) return false;
+        if (p.tensors[op.ins[0]].dims.size() != 4 || out_rank != 4)
+          return false;
+        break;
+      case SELECT_N: case CLAMP:
+        if (nin != 3) return false;
+        break;
+      case CONCAT:
+        if (nin < 1 || na != 1 || op.attrs[0] < 0 ||
+            op.attrs[0] >= out_rank)
+          return false;
+        break;
+      default:
+        return false;
+    }
+  }
+  return true;
+}
+
+// ---- execution --------------------------------------------------------
+
+// broadcasted binary op: strides of size-1 dims are 0
+void binary_op(uint32_t opc, const TensorMeta& ma, const float* a,
+               const TensorMeta& mb, const float* b, const TensorMeta& mo,
+               float* out) {
+  int rank = static_cast<int>(mo.dims.size());
+  int64_t sa[kMaxRank] = {0}, sb[kMaxRank] = {0}, dims[kMaxRank] = {0};
+  // right-align shapes, compute strides (0 where broadcasting)
+  int64_t stride = 1;
+  std::vector<int64_t> fa(rank, 1), fb(rank, 1);
+  int off_a = rank - static_cast<int>(ma.dims.size());
+  int off_b = rank - static_cast<int>(mb.dims.size());
+  for (int i = 0; i < static_cast<int>(ma.dims.size()); ++i)
+    fa[off_a + i] = ma.dims[i];
+  for (int i = 0; i < static_cast<int>(mb.dims.size()); ++i)
+    fb[off_b + i] = mb.dims[i];
+  stride = 1;
+  for (int i = rank - 1; i >= 0; --i) {
+    dims[i] = mo.dims[i];
+    sa[i] = (fa[i] == 1) ? 0 : stride;
+    stride *= fa[i];
+  }
+  stride = 1;
+  for (int i = rank - 1; i >= 0; --i) {
+    sb[i] = (fb[i] == 1) ? 0 : stride;
+    stride *= fb[i];
+  }
+  int64_t n = mo.size();
+  int64_t idx[kMaxRank] = {0};
+  for (int64_t lin = 0; lin < n; ++lin) {
+    int64_t ia = 0, ib = 0;
+    for (int i = 0; i < rank; ++i) {
+      ia += idx[i] * sa[i];
+      ib += idx[i] * sb[i];
+    }
+    float x = a[ia], y = b[ib], r = 0;
+    switch (opc) {
+      case ADD: r = x + y; break;
+      case SUB: r = x - y; break;
+      case MUL: r = x * y; break;
+      case DIV: r = x / y; break;
+      case MAX_: r = x > y ? x : y; break;
+      case MIN_: r = x < y ? x : y; break;
+    }
+    out[lin] = r;
+    for (int i = rank - 1; i >= 0; --i) {
+      if (++idx[i] < dims[i]) break;
+      idx[i] = 0;
+    }
+  }
+}
+
+struct Executor {
+  const Program& p;
+  // storage for computed tensors + bound inputs; consts are read IN PLACE
+  // from the Program (no per-inference weight copy) via the ptr view
+  std::vector<std::vector<float>> buf;
+  std::vector<const float*> ptr;
+
+  explicit Executor(const Program& prog)
+      : p(prog), buf(prog.tensors.size()),
+        ptr(prog.tensors.size(), nullptr) {
+    for (const auto& c : p.consts) ptr[c.first] = c.second.data();
+  }
+
+  void bind(uint32_t tid, const float* data, size_t n) {
+    buf[tid].assign(data, data + n);
+    ptr[tid] = buf[tid].data();
+  }
+
+  const TensorMeta& meta(uint32_t t) const { return p.tensors[t]; }
+
+  bool run() {
+    for (const auto& op : p.ops) {
+      const TensorMeta& mo = meta(op.out);
+      std::vector<float>& out = buf[op.out];
+      out.assign(static_cast<size_t>(mo.size()), 0.0f);
+      const float* a = op.ins.empty() ? nullptr : ptr[op.ins[0]];
+      switch (op.opcode) {
+        case ADD: case SUB: case MUL: case DIV: case MAX_: case MIN_:
+          binary_op(op.opcode, meta(op.ins[0]), a, meta(op.ins[1]),
+                    ptr[op.ins[1]], mo, out.data());
+          break;
+        case EXP: for (int64_t i = 0; i < mo.size(); ++i) out[i] = std::exp(a[i]); break;
+        case LOG: for (int64_t i = 0; i < mo.size(); ++i) out[i] = std::log(a[i]); break;
+        case TANH: for (int64_t i = 0; i < mo.size(); ++i) out[i] = std::tanh(a[i]); break;
+        case LOGISTIC:
+          for (int64_t i = 0; i < mo.size(); ++i)
+            out[i] = 1.0f / (1.0f + std::exp(-a[i]));
+          break;
+        case RSQRT: for (int64_t i = 0; i < mo.size(); ++i) out[i] = 1.0f / std::sqrt(a[i]); break;
+        case SQRT: for (int64_t i = 0; i < mo.size(); ++i) out[i] = std::sqrt(a[i]); break;
+        case NEG: for (int64_t i = 0; i < mo.size(); ++i) out[i] = -a[i]; break;
+        case ABS: for (int64_t i = 0; i < mo.size(); ++i) out[i] = std::fabs(a[i]); break;
+        case IPOW: {
+          int64_t y = op.attrs[0];
+          for (int64_t i = 0; i < mo.size(); ++i)
+            out[i] = std::pow(a[i], static_cast<float>(y));
+          break;
+        }
+        case IDENT:
+          std::memcpy(out.data(), a, out.size() * 4);
+          break;
+        case DOT: {
+          const TensorMeta& m1 = meta(op.ins[0]);
+          const TensorMeta& m2 = meta(op.ins[1]);
+          if (m1.dims.size() != 2 || m2.dims.size() != 2) return false;
+          int64_t M = m1.dims[0], K = m1.dims[1], N = m2.dims[1];
+          const float* b = ptr[op.ins[1]];
+          for (int64_t i = 0; i < M; ++i)
+            for (int64_t k = 0; k < K; ++k) {
+              float av = a[i * K + k];
+              if (av == 0.0f) continue;
+              const float* brow = b + k * N;
+              float* orow = out.data() + i * N;
+              for (int64_t j = 0; j < N; ++j) orow[j] += av * brow[j];
+            }
+          break;
+        }
+        case BCAST: {
+          const TensorMeta& mi = meta(op.ins[0]);
+          int rank = static_cast<int>(mo.dims.size());
+          // input dim i maps to out dim attrs[i]
+          int64_t istrides[kMaxRank] = {0};
+          int64_t s = 1;
+          std::vector<int64_t> in_strides(mi.dims.size());
+          for (int i = static_cast<int>(mi.dims.size()) - 1; i >= 0; --i) {
+            in_strides[i] = s;
+            s *= mi.dims[i];
+          }
+          for (int i = 0; i < rank; ++i) istrides[i] = 0;
+          for (size_t i = 0; i < op.attrs.size(); ++i) {
+            int od = static_cast<int>(op.attrs[i]);
+            istrides[od] = (mi.dims[i] == 1) ? 0 : in_strides[i];
+          }
+          int64_t idx[kMaxRank] = {0};
+          for (int64_t lin = 0; lin < mo.size(); ++lin) {
+            int64_t ia = 0;
+            for (int i = 0; i < rank; ++i) ia += idx[i] * istrides[i];
+            out[lin] = a[ia];
+            for (int i = rank - 1; i >= 0; --i) {
+              if (++idx[i] < mo.dims[i]) break;
+              idx[i] = 0;
+            }
+          }
+          break;
+        }
+        case RESHAPE:
+          std::memcpy(out.data(), a, out.size() * 4);
+          break;
+        case TRANSPOSE: {
+          const TensorMeta& mi = meta(op.ins[0]);
+          int rank = static_cast<int>(mi.dims.size());
+          int64_t in_strides[kMaxRank], perm_strides[kMaxRank];
+          int64_t s = 1;
+          for (int i = rank - 1; i >= 0; --i) {
+            in_strides[i] = s;
+            s *= mi.dims[i];
+          }
+          for (int i = 0; i < rank; ++i)
+            perm_strides[i] = in_strides[op.attrs[i]];
+          int64_t idx[kMaxRank] = {0};
+          for (int64_t lin = 0; lin < mo.size(); ++lin) {
+            int64_t ia = 0;
+            for (int i = 0; i < rank; ++i) ia += idx[i] * perm_strides[i];
+            out[lin] = a[ia];
+            for (int i = rank - 1; i >= 0; --i) {
+              if (++idx[i] < mo.dims[i]) break;
+              idx[i] = 0;
+            }
+          }
+          break;
+        }
+        case RSUM: case RMAX: {
+          const TensorMeta& mi = meta(op.ins[0]);
+          int rank = static_cast<int>(mi.dims.size());
+          bool reduced[kMaxRank] = {false};
+          for (int64_t ax : op.attrs) reduced[ax] = true;
+          int64_t out_strides[kMaxRank] = {0};
+          // strides in the OUT tensor for each kept in-dim
+          int64_t s = 1;
+          for (int i = rank - 1; i >= 0; --i) {
+            if (!reduced[i]) {
+              out_strides[i] = s;
+              s *= mi.dims[i];
+            }
+          }
+          if (op.opcode == RMAX)
+            out.assign(out.size(),
+                       -std::numeric_limits<float>::infinity());
+          int64_t idx[kMaxRank] = {0};
+          for (int64_t lin = 0; lin < mi.size(); ++lin) {
+            int64_t io = 0;
+            for (int i = 0; i < rank; ++i)
+              if (!reduced[i]) io += idx[i] * out_strides[i];
+            if (op.opcode == RSUM) out[io] += a[lin];
+            else out[io] = out[io] > a[lin] ? out[io] : a[lin];
+            for (int i = rank - 1; i >= 0; --i) {
+              if (++idx[i] < mi.dims[i]) break;
+              idx[i] = 0;
+            }
+          }
+          break;
+        }
+        case CONV2D: {
+          const TensorMeta& mx = meta(op.ins[0]);
+          const TensorMeta& mw = meta(op.ins[1]);
+          const float* w = ptr[op.ins[1]];
+          int64_t sh = op.attrs[0], sw = op.attrs[1];
+          int64_t pt = op.attrs[2], pl = op.attrs[4];
+          int64_t N = mx.dims[0], H = mx.dims[1], W = mx.dims[2],
+                  C = mx.dims[3];
+          int64_t KH = mw.dims[0], KW = mw.dims[1], CO = mw.dims[3];
+          int64_t OH = mo.dims[1], OW = mo.dims[2];
+          for (int64_t n = 0; n < N; ++n)
+            for (int64_t oy = 0; oy < OH; ++oy)
+              for (int64_t ox = 0; ox < OW; ++ox) {
+                float* opix = out.data() + ((n * OH + oy) * OW + ox) * CO;
+                for (int64_t ky = 0; ky < KH; ++ky) {
+                  int64_t iy = oy * sh + ky - pt;
+                  if (iy < 0 || iy >= H) continue;
+                  for (int64_t kx = 0; kx < KW; ++kx) {
+                    int64_t ix = ox * sw + kx - pl;
+                    if (ix < 0 || ix >= W) continue;
+                    const float* ipix =
+                        a + ((n * H + iy) * W + ix) * C;
+                    const float* wrow = w + (ky * KW + kx) * C * CO;
+                    for (int64_t c = 0; c < C; ++c) {
+                      float xv = ipix[c];
+                      if (xv == 0.0f) continue;
+                      const float* wv = wrow + c * CO;
+                      for (int64_t co = 0; co < CO; ++co)
+                        opix[co] += xv * wv[co];
+                    }
+                  }
+                }
+              }
+          break;
+        }
+        case MAXPOOL: case SUMPOOL: {
+          const TensorMeta& mx = meta(op.ins[0]);
+          int64_t wh = op.attrs[0], ww = op.attrs[1];
+          int64_t sh = op.attrs[2], sw = op.attrs[3];
+          int64_t pt = op.attrs[4], pl = op.attrs[6];
+          int64_t N = mx.dims[0], H = mx.dims[1], W = mx.dims[2],
+                  C = mx.dims[3];
+          int64_t OH = mo.dims[1], OW = mo.dims[2];
+          bool is_max = op.opcode == MAXPOOL;
+          if (is_max)
+            out.assign(out.size(),
+                       -std::numeric_limits<float>::infinity());
+          for (int64_t n = 0; n < N; ++n)
+            for (int64_t oy = 0; oy < OH; ++oy)
+              for (int64_t ox = 0; ox < OW; ++ox) {
+                float* opix = out.data() + ((n * OH + oy) * OW + ox) * C;
+                for (int64_t ky = 0; ky < wh; ++ky) {
+                  int64_t iy = oy * sh + ky - pt;
+                  if (iy < 0 || iy >= H) continue;
+                  for (int64_t kx = 0; kx < ww; ++kx) {
+                    int64_t ix = ox * sw + kx - pl;
+                    if (ix < 0 || ix >= W) continue;
+                    const float* ipix = a + ((n * H + iy) * W + ix) * C;
+                    for (int64_t c = 0; c < C; ++c) {
+                      if (is_max)
+                        opix[c] = opix[c] > ipix[c] ? opix[c] : ipix[c];
+                      else
+                        opix[c] += ipix[c];
+                    }
+                  }
+                }
+              }
+          break;
+        }
+        case SELECT_N: {
+          const float* t1 = ptr[op.ins[1]];
+          const float* t2 = ptr[op.ins[2]];
+          for (int64_t i = 0; i < mo.size(); ++i)
+            out[i] = (a[i] != 0.0f) ? t2[i] : t1[i];
+          break;
+        }
+        case CLAMP: {
+          const float* lo = a;
+          const float* x = ptr[op.ins[1]];
+          const float* hi = ptr[op.ins[2]];
+          bool lo_scalar = meta(op.ins[0]).size() == 1;
+          bool hi_scalar = meta(op.ins[2]).size() == 1;
+          for (int64_t i = 0; i < mo.size(); ++i) {
+            float l = lo_scalar ? lo[0] : lo[i];
+            float h = hi_scalar ? hi[0] : hi[i];
+            float v = x[i];
+            out[i] = v < l ? l : (v > h ? h : v);
+          }
+          break;
+        }
+        case CONCAT: {
+          int axis = static_cast<int>(op.attrs[0]);
+          int rank = static_cast<int>(mo.dims.size());
+          int64_t outer = 1, inner = 1;
+          for (int i = 0; i < axis; ++i) outer *= mo.dims[i];
+          for (int i = axis + 1; i < rank; ++i) inner *= mo.dims[i];
+          int64_t out_ax = mo.dims[axis];
+          int64_t ax_off = 0;
+          for (uint32_t in_t : op.ins) {
+            const TensorMeta& mi = meta(in_t);
+            const float* src = ptr[in_t];
+            int64_t ax_n = mi.dims[axis];
+            for (int64_t o = 0; o < outer; ++o)
+              std::memcpy(
+                  out.data() + (o * out_ax + ax_off) * inner,
+                  src + o * ax_n * inner, ax_n * inner * 4);
+            ax_off += ax_n;
+          }
+          break;
+        }
+        default:
+          return false;
+      }
+      ptr[op.out] = out.data();
+    }
+    return true;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ptpu_aot_load(const char* path) { return load_program(path); }
+
+// Same calling convention as capi.cpp's ptpu_infer: single dense float
+// input by name, first output copied out. Returns 0 ok, -2 capacity (with
+// required shape in out_rows/out_cols), -3 shape mismatch, -1 failure.
+int ptpu_aot_infer(void* handle, const char* input_name, const float* data,
+                   int64_t batch, int64_t dim, float* out,
+                   int64_t out_capacity, int64_t* out_rows,
+                   int64_t* out_cols) {
+  auto* p = static_cast<Program*>(handle);
+  if (!p) return -1;
+  // v1 contract: exactly ONE input (export_aot_program enforces the same
+  // at export time) — refusing multi-input programs here means a caller
+  // can never get rc=0 with an unbound, silently-zeroed feed
+  if (p->inputs.size() != 1 || p->outputs.empty()) return -4;
+  const auto& in = p->inputs[0];
+  if (in.second != input_name) return -4;
+  const TensorMeta& m = p->tensors[in.first];
+  if (m.dims.size() != 2 || m.dims[0] != batch || m.dims[1] != dim)
+    return -3;  // program was AOT-compiled for a fixed shape
+  Executor ex(*p);
+  ex.bind(in.first, data, static_cast<size_t>(batch * dim));
+  if (!ex.run()) return -1;
+  const TensorMeta& mo = p->tensors[p->outputs[0]];
+  int64_t rows = mo.dims.empty() ? 1 : mo.dims[0];
+  int64_t cols = mo.size() / (rows ? rows : 1);
+  *out_rows = rows;
+  *out_cols = cols;
+  if (rows * cols > out_capacity) return -2;
+  std::memcpy(out, ex.ptr[p->outputs[0]], rows * cols * 4);
+  return 0;
+}
+
+void ptpu_aot_release(void* handle) {
+  delete static_cast<Program*>(handle);
+}
+
+}  // extern "C"

@@ -5,11 +5,16 @@
 // _forward; capi/gradient_machine.h:36-112) driving the C++ engine on a
 // merged single-file model.
 //
-// TPU-native design: the merged model is a serialized StableHLO program
-// (paddle_tpu/export.py); this C ABI hosts an embedded CPython running
-// the PJRT-backed loader, the same way the reference's engine embedded
-// Python for data providers (utils/PythonUtil). Embedders get plain
-// float-in / float-out calls and never see Python.
+// TPU-native design: TWO C surfaces share the merged-model story.
+//  1. This file — the FULL-COVERAGE path: the merged model is a
+//     serialized StableHLO program (paddle_tpu/export.py) and this ABI
+//     hosts an embedded CPython running the PJRT-backed loader (any
+//     graph jax can trace works, incl. symbolic batch).
+//  2. aot_runtime.cpp — the INTERPRETER-FREE path: export_aot_program
+//     translates the same traced forward into a .ptnm tensor program a
+//     dependency-free C++ executor runs with no Python in the process
+//     (the reference capi's embedded/Android deployment property).
+// Embedders get plain float-in / float-out calls either way.
 
 #include <Python.h>
 

@@ -23,7 +23,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from paddle_tpu.parameters import Parameters
-from paddle_tpu.platform.enforce import enforce_that
+from paddle_tpu.platform.enforce import EnforceError, enforce_that
 from paddle_tpu.topology import LayerOutput, Topology
 
 _FORMAT_VERSION = 1
@@ -121,3 +121,289 @@ class MergedModel:
 
 def load_merged_model(path: str) -> MergedModel:
     return MergedModel(path)
+
+
+# ---------------------------------------------------------------------------
+# AOT program export: the interpreter-free C inference artifact
+# ---------------------------------------------------------------------------
+#
+# Reference analog: paddle/capi's pure-C embedded deployment
+# (capi/gradient_machine.h:36-112, Android cross-compile) — inference with
+# NO Python interpreter in the process. The forward jaxpr (the same traced
+# computation the StableHLO export uses) is translated into a flat tensor
+# program (.ptnm) executed by the dependency-free C++ runtime in
+# native/src/aot_runtime.cpp. Restricted to dense inference graphs; the
+# translator fails loudly on unsupported primitives.
+
+_PTNM_MAGIC = b"PTNM"
+_PTNM_VERSION = 1
+
+# opcodes (keep in sync with native/src/aot_runtime.cpp)
+OP_ADD, OP_SUB, OP_MUL, OP_DIV, OP_MAX, OP_MIN = 1, 2, 3, 4, 5, 6
+OP_EXP, OP_LOG, OP_TANH, OP_LOGISTIC, OP_RSQRT = 7, 8, 9, 10, 11
+OP_SQRT, OP_NEG, OP_ABS = 12, 13, 14
+OP_DOT, OP_BCAST, OP_RESHAPE, OP_TRANSPOSE = 15, 16, 17, 18
+OP_RSUM, OP_RMAX, OP_CONV2D, OP_MAXPOOL, OP_SUMPOOL = 19, 20, 21, 22, 23
+OP_SELECT_N, OP_CLAMP, OP_CONCAT, OP_IPOW, OP_IDENT = 24, 25, 26, 27, 28
+
+_UNARY = {"exp": OP_EXP, "log": OP_LOG, "tanh": OP_TANH,
+          "logistic": OP_LOGISTIC, "rsqrt": OP_RSQRT, "sqrt": OP_SQRT,
+          "neg": OP_NEG, "abs": OP_ABS}
+_BINARY = {"add": OP_ADD, "sub": OP_SUB, "mul": OP_MUL, "div": OP_DIV,
+           "max": OP_MAX, "min": OP_MIN}
+_CALL_PRIMS = {"jit", "pjit", "custom_jvp_call", "custom_vjp_call",
+               "closed_call", "core_call", "remat", "checkpoint"}
+
+
+class _AotBuilder:
+    def __init__(self):
+        self.tensors: List[Tuple[int, Tuple[int, ...]]] = []  # (dtype, dims)
+        self.consts: List[Tuple[int, np.ndarray]] = []
+        self.ops: List[Tuple[int, List[int], int, List[int]]] = []
+
+    def tensor(self, dtype: str, shape) -> int:
+        code = {"float32": 0, "int32": 1}.get(str(dtype))
+        enforce_that(code is not None,
+                     f"AOT export supports f32/i32 tensors, got {dtype}",
+                     context="export_aot")
+        self.tensors.append((code, tuple(int(d) for d in shape)))
+        return len(self.tensors) - 1
+
+    def const(self, value: np.ndarray) -> int:
+        value = np.asarray(value)
+        if value.dtype not in (np.float32, np.int32):
+            value = value.astype(
+                np.int32 if np.issubdtype(value.dtype, np.integer)
+                else np.float32)
+        tid = self.tensor(str(value.dtype), value.shape)
+        self.consts.append((tid, np.ascontiguousarray(value)))
+        return tid
+
+    def emit(self, opcode: int, ins: List[int], out: int,
+             attrs: List[int] = ()):  # noqa: B006
+        self.ops.append((opcode, list(ins), out, [int(a) for a in attrs]))
+
+
+def _translate_jaxpr(jaxpr, consts, arg_ids, b: "_AotBuilder") -> List[int]:
+    """Walk eqns, emitting ops; call-like primitives are inlined."""
+    env: Dict = {}
+
+    def read(var):
+        from jax.extend.core import Literal
+
+        if isinstance(var, Literal):
+            return b.const(np.asarray(var.val))
+        return env[var]
+
+    def write(var, tid):
+        env[var] = tid
+
+    for v, c in zip(jaxpr.constvars, consts):
+        write(v, b.const(np.asarray(c)))
+    for v, tid in zip(jaxpr.invars, arg_ids):
+        write(v, tid)
+
+    for eq in jaxpr.eqns:
+        prim = eq.primitive.name
+        out_av = eq.outvars[0].aval
+        if prim in _CALL_PRIMS:
+            sub = eq.params.get("jaxpr") or eq.params.get("call_jaxpr")
+            closed = getattr(sub, "jaxpr", None)
+            inner = closed if closed is not None else sub
+            sub_consts = getattr(sub, "consts", [])
+            outs = _translate_jaxpr(inner, sub_consts,
+                                    [read(v) for v in eq.invars], b)
+            for ov, tid in zip(eq.outvars, outs):
+                write(ov, tid)
+            continue
+
+        def out_tid():
+            return b.tensor(str(out_av.dtype), out_av.shape)
+
+        if prim in _BINARY:
+            t = out_tid()
+            b.emit(_BINARY[prim], [read(v) for v in eq.invars], t)
+        elif prim in _UNARY:
+            t = out_tid()
+            b.emit(_UNARY[prim], [read(eq.invars[0])], t)
+        elif prim == "integer_pow":
+            t = out_tid()
+            b.emit(OP_IPOW, [read(eq.invars[0])], t, [eq.params["y"]])
+        elif prim == "dot_general":
+            dn = eq.params["dimension_numbers"]
+            enforce_that(dn == (((1,), (0,)), ((), ())),
+                         f"AOT dot_general supports plain 2D matmul, "
+                         f"got dims {dn}", context="export_aot")
+            t = out_tid()
+            b.emit(OP_DOT, [read(v) for v in eq.invars], t)
+        elif prim == "broadcast_in_dim":
+            t = out_tid()
+            b.emit(OP_BCAST, [read(eq.invars[0])], t,
+                   list(eq.params["broadcast_dimensions"]))
+        elif prim in ("reshape", "squeeze", "expand_dims"):
+            t = out_tid()
+            b.emit(OP_RESHAPE, [read(eq.invars[0])], t)
+        elif prim == "transpose":
+            t = out_tid()
+            b.emit(OP_TRANSPOSE, [read(eq.invars[0])], t,
+                   list(eq.params["permutation"]))
+        elif prim in ("reduce_sum", "reduce_max"):
+            t = out_tid()
+            b.emit(OP_RSUM if prim == "reduce_sum" else OP_RMAX,
+                   [read(eq.invars[0])], t, list(eq.params["axes"]))
+        elif prim == "conv_general_dilated":
+            p = eq.params
+            dn = p["dimension_numbers"]
+            enforce_that(
+                tuple(dn.lhs_spec) == (0, 3, 1, 2)
+                and tuple(dn.rhs_spec) == (3, 2, 0, 1)
+                and tuple(dn.out_spec) == (0, 3, 1, 2)
+                and p["feature_group_count"] == 1
+                and p["batch_group_count"] == 1
+                and tuple(p["lhs_dilation"]) == (1, 1)
+                and tuple(p["rhs_dilation"]) == (1, 1),
+                "AOT conv supports NHWC/HWIO stride+pad convs",
+                context="export_aot")
+            (pt, pb_), (pl, pr) = p["padding"]
+            sh, sw = p["window_strides"]
+            t = out_tid()
+            b.emit(OP_CONV2D, [read(v) for v in eq.invars], t,
+                   [sh, sw, pt, pb_, pl, pr])
+        elif prim in ("reduce_window_max", "reduce_window_sum"):
+            p = eq.params
+            wd, ws, pad = (p["window_dimensions"], p["window_strides"],
+                           p["padding"])
+            enforce_that(
+                len(wd) == 4 and wd[0] == wd[3] == 1
+                and ws[0] == ws[3] == 1
+                and tuple(p["base_dilation"]) == (1, 1, 1, 1)
+                and tuple(p["window_dilation"]) == (1, 1, 1, 1)
+                and pad[0] == (0, 0) and pad[3] == (0, 0),
+                "AOT pooling supports NHWC spatial windows",
+                context="export_aot")
+            t = out_tid()
+            b.emit(OP_MAXPOOL if prim.endswith("max") else OP_SUMPOOL,
+                   [read(eq.invars[0])], t,
+                   [wd[1], wd[2], ws[1], ws[2],
+                    pad[1][0], pad[1][1], pad[2][0], pad[2][1]])
+        elif prim == "select_n":
+            t = out_tid()
+            b.emit(OP_SELECT_N, [read(v) for v in eq.invars], t)
+        elif prim == "clamp":
+            t = out_tid()
+            b.emit(OP_CLAMP, [read(v) for v in eq.invars], t)
+        elif prim == "concatenate":
+            t = out_tid()
+            b.emit(OP_CONCAT, [read(v) for v in eq.invars], t,
+                   [eq.params["dimension"]])
+        elif prim in ("stop_gradient", "copy"):
+            write(eq.outvars[0], read(eq.invars[0]))
+            continue
+        elif prim == "convert_element_type":
+            src = eq.invars[0].aval.dtype
+            dst = out_av.dtype
+            if src == dst:
+                write(eq.outvars[0], read(eq.invars[0]))
+                continue
+            # the runtime is f32-only (i32 consts are widened at load), so
+            # int->float widening is a copy; float->int TRUNCATION has no
+            # runtime representation and must be rejected loudly
+            enforce_that(np.issubdtype(np.dtype(src), np.integer)
+                         and np.dtype(dst) == np.float32,
+                         f"AOT export: unsupported cast {src}->{dst} "
+                         "(f32-only runtime) — use the merged StableHLO "
+                         "path instead", context="export_aot")
+            t = out_tid()
+            b.emit(OP_IDENT, [read(eq.invars[0])], t)
+        else:
+            raise EnforceError(
+                f"AOT export: unsupported primitive {prim!r} — this graph "
+                "needs the merged StableHLO path (CPython capi) instead",
+                context="export_aot")
+        write(eq.outvars[0], t)
+
+    return [read(v) for v in jaxpr.outvars]
+
+
+def export_aot_program(output_layers, parameters: Parameters, path: str,
+                       batch_size: int) -> None:
+    """Translate the forward graph into a .ptnm tensor program the pure-C++
+    runtime (native/src/aot_runtime.cpp) executes with NO Python and no
+    jax/XLA in the process — the embedded-deployment property of the
+    reference's paddle/capi. ``batch_size`` is pinned (embedders fix their
+    batch; export several programs for several batch sizes)."""
+    import struct
+
+    import jax
+
+    from paddle_tpu.platform.flags import FLAGS
+
+    outs = output_layers if isinstance(output_layers, (list, tuple)) \
+        else [output_layers]
+    topo = Topology(list(outs))
+    state = topo.init_state()
+    params = {k: np.asarray(v, np.float32) for k, v in
+              parameters.as_dict().items()}
+
+    data_nodes = [n for n in topo.nodes if n.layer_type == "data"]
+    data_nodes.sort(key=lambda n: getattr(n, "declare_idx", 0))
+    enforce_that(len(data_nodes) == 1,
+                 "AOT export v1 is single-input (the C ABI binds one "
+                 "dense feed); concat extra features host-side or use "
+                 "the merged StableHLO path", context="export_aot")
+    for n in data_nodes:
+        enforce_that(not n.is_sequence,
+                     "AOT export supports dense-input graphs",
+                     context="export_aot")
+
+    old_bf16 = FLAGS.use_bf16
+    FLAGS.use_bf16 = False  # the C runtime is f32-only
+    try:
+        args = tuple(
+            jax.ShapeDtypeStruct((int(batch_size), n.size), np.float32)
+            for n in data_nodes)
+
+        def forward(*feed_vals):
+            feeds = {n.name: v for n, v in zip(data_nodes, feed_vals)}
+            outs_v, _ = topo.forward(params, state, feeds, train=False)
+            return tuple(o.data if hasattr(o, "segment_ids") else o
+                         for o in outs_v)
+
+        closed = jax.make_jaxpr(forward)(*args)
+    finally:
+        FLAGS.use_bf16 = old_bf16
+
+    b = _AotBuilder()
+    arg_ids = [b.tensor("float32", (int(batch_size), n.size))
+               for n in data_nodes]
+    out_ids = _translate_jaxpr(closed.jaxpr, closed.consts, arg_ids, b)
+
+    with open(path, "wb") as f:
+        w = f.write
+        w(_PTNM_MAGIC)
+        w(struct.pack("<I", _PTNM_VERSION))
+        w(struct.pack("<I", len(b.tensors)))
+        for dtype, dims in b.tensors:
+            w(struct.pack("<BB", dtype, len(dims)))
+            w(struct.pack(f"<{len(dims)}q", *dims))
+        w(struct.pack("<I", len(data_nodes)))
+        for n, tid in zip(data_nodes, arg_ids):
+            name = n.name.encode()
+            w(struct.pack("<IH", tid, len(name)))
+            w(name)
+        w(struct.pack("<I", len(out_ids)))
+        for tid in out_ids:
+            w(struct.pack("<I", tid))
+        w(struct.pack("<I", len(b.consts)))
+        for tid, arr in b.consts:
+            raw = arr.tobytes()
+            w(struct.pack("<IQ", tid, len(raw)))
+            w(raw)
+        w(struct.pack("<I", len(b.ops)))
+        for opcode, ins, out, attrs in b.ops:
+            w(struct.pack("<II", opcode, len(ins)))
+            if ins:
+                w(struct.pack(f"<{len(ins)}I", *ins))
+            w(struct.pack("<II", out, len(attrs)))
+            if attrs:
+                w(struct.pack(f"<{len(attrs)}q", *attrs))

@@ -73,6 +73,21 @@ def build_capi(force: bool = False) -> str:
     return out
 
 
+def build_aot(force: bool = False) -> str:
+    """Compile the interpreter-free AOT inference runtime →
+    libptpu_aot.so. PURE C++ — no Python, no jax, no XLA linked; this is
+    the embedded-deployment artifact (paddle/capi Android analog)."""
+    os.makedirs(_BUILD, exist_ok=True)
+    out = os.path.join(_BUILD, "libptpu_aot.so")
+    src = os.path.join(_SRC, "aot_runtime.cpp")
+    if (not force and os.path.exists(out)
+            and os.path.getmtime(out) >= os.path.getmtime(src)):
+        return out
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-o", out, src]
+    subprocess.run(cmd, check=True, capture_output=True)
+    return out
+
+
 def _load():
     global _lib, _load_error
     if _lib is not None or _load_error is not None:

@@ -190,3 +190,128 @@ def test_c_inference_abi(native, tmp_path):
     expect, _ = topo.forward(params.as_dict(), state, {"x": xb},
                              train=False)
     np.testing.assert_allclose(got, np.asarray(expect[0]), atol=1e-4)
+
+
+C_AOT_TEST = r"""
+#include <stdio.h>
+#include <stdlib.h>
+
+extern void* ptpu_aot_load(const char* path);
+extern int ptpu_aot_infer(void* h, const char* name, const float* data,
+                          long long batch, long long dim, float* out,
+                          long long cap, long long* rows, long long* cols);
+extern void ptpu_aot_release(void* h);
+
+int main(int argc, char** argv) {
+  long long batch = atoll(argv[2]);
+  long long dim = atoll(argv[3]);
+  void* m = ptpu_aot_load(argv[1]);
+  if (!m) { fprintf(stderr, "load failed\n"); return 1; }
+  float* in = (float*)malloc(sizeof(float) * batch * dim);
+  for (long long i = 0; i < batch * dim; ++i)
+    in[i] = (float)((i * 37 % 100) - 50) / 100.0f;
+  float out[4096];
+  long long rows = 0, cols = 0;
+  int rc = ptpu_aot_infer(m, argv[4], in, batch, dim, out, 4096, &rows,
+                          &cols);
+  if (rc != 0) { fprintf(stderr, "infer rc=%d\n", rc); return 2; }
+  printf("%lld %lld", rows, cols);
+  for (long long i = 0; i < rows * cols; ++i) printf(" %.6f", out[i]);
+  printf("\n");
+  ptpu_aot_release(m);
+  return 0;
+}
+"""
+
+
+def _run_aot_client(native, tmp_path, out_node, topo, params, feed_name,
+                    batch, dim):
+    from paddle_tpu import export as pexport
+
+    model_path = str(tmp_path / "model.ptnm")
+    pexport.export_aot_program(out_node, params, model_path,
+                               batch_size=batch)
+    aot_so = native.build_aot()
+
+    # the AOT runtime must be PYTHON-FREE: its shared library may not pull
+    # in libpython (the interpreter-free deployment property, paddle/capi
+    # gradient_machine.h:36-112 / Dockerfile.android analog)
+    ldd = subprocess.run(["ldd", aot_so], capture_output=True, text=True)
+    assert "libpython" not in ldd.stdout, ldd.stdout
+
+    csrc = tmp_path / "aot_client.c"
+    csrc.write_text(C_AOT_TEST)
+    exe = str(tmp_path / "aot_client")
+    subprocess.run(["gcc", "-o", exe, str(csrc), aot_so,
+                    f"-Wl,-rpath,{os.path.dirname(aot_so)}"],
+                   check=True, capture_output=True)
+    # NO PYTHONPATH / python env needed by the client process at all
+    proc = subprocess.run([exe, model_path, str(batch), str(dim), feed_name],
+                          capture_output=True, text=True, env={},
+                          timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    vals = proc.stdout.split()
+    rows, cols = int(vals[0]), int(vals[1])
+    got = np.asarray([float(v) for v in vals[2:]]).reshape(rows, cols)
+
+    xb = ((np.arange(batch * dim) * 37 % 100 - 50) / 100.0).astype(
+        np.float32).reshape(batch, dim)
+    state = topo.init_state()
+    from paddle_tpu.platform.flags import FLAGS
+    old = FLAGS.use_bf16
+    FLAGS.use_bf16 = False
+    try:
+        expect, _ = topo.forward(params.as_dict(), state, {feed_name: xb},
+                                 train=False)
+    finally:
+        FLAGS.use_bf16 = old
+    np.testing.assert_allclose(got, np.asarray(expect[0]).reshape(rows, cols),
+                               atol=1e-5)
+
+
+def test_aot_c_inference_mlp(native, tmp_path):
+    """Interpreter-free C inference: MLP+softmax via the .ptnm AOT program,
+    client process has NO python — parity vs the jax forward."""
+    from paddle_tpu import layer
+
+    paddle.topology.reset_name_scope()
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(8))
+    out = layer.fc(layer.fc(x, size=16, act="relu"), size=3, act="softmax")
+    topo = paddle.topology.Topology([out])
+    params = paddle.Parameters.from_topology(topo, seed=0)
+    _run_aot_client(native, tmp_path, out, topo, params, "x", 2, 8)
+
+
+def test_aot_c_inference_cnn(native, tmp_path):
+    """Interpreter-free C inference of a conv+bn+pool+fc graph."""
+    from paddle_tpu import layer
+
+    paddle.topology.reset_name_scope()
+    x = layer.data(name="img", type=paddle.data_type.dense_vector(2 * 6 * 6),
+                   height=6, width=6)
+    c = layer.img_conv(x, filter_size=3, num_filters=4, num_channels=2,
+                       padding=1, act="relu")
+    bn = layer.batch_norm(c, act="relu")
+    p = layer.img_pool(bn, pool_size=2)
+    out = layer.fc(p, size=3, act="softmax")
+    topo = paddle.topology.Topology([out])
+    params = paddle.Parameters.from_topology(topo, seed=1)
+    _run_aot_client(native, tmp_path, out, topo, params, "img", 3, 72)
+
+
+def test_aot_rejects_unsupported_graphs(tmp_path):
+    """Graphs beyond the AOT op set fail loudly at EXPORT time, pointing
+    at the CPython merged-model fallback."""
+    from paddle_tpu import export as pexport
+    from paddle_tpu import layer
+    from paddle_tpu.platform.enforce import EnforceError
+
+    paddle.topology.reset_name_scope()
+    s = layer.data(name="s",
+                   type=paddle.data_type.dense_vector_sequence(4))
+    out = layer.pooling(s)
+    topo = paddle.topology.Topology([out])
+    params = paddle.Parameters.from_topology(topo, seed=0)
+    with pytest.raises(EnforceError):
+        pexport.export_aot_program(out, params, str(tmp_path / "x.ptnm"),
+                                   batch_size=2)
