@@ -274,6 +274,7 @@ def worker_lstm():
     # 641 ms, h=512 bs=256 -> 414 ms on K40m), printed incrementally so a
     # relay hang loses at most the not-yet-measured rows
     for key, h, b, base in (("lstm_h1280_bs64_ms", 1280, 64, 641.0),
+                            ("lstm_h256_bs64_ms", 256, 64, 83.0),
                             ("lstm_h512_bs256_ms", 512, 256, 414.0)):
         try:
             out[key] = round(measure(True, iters=10, hidden=h, batch=b)

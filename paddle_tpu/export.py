@@ -124,6 +124,73 @@ def load_merged_model(path: str) -> MergedModel:
 
 
 # ---------------------------------------------------------------------------
+# PJRT model export: the TPU-production C inference artifact
+# ---------------------------------------------------------------------------
+
+
+def export_pjrt_model(output_layers, parameters: Parameters, path: str,
+                      batch_size: int) -> None:
+    """Write a ``.ptpj`` artifact for the PJRT C-API inference path
+    (native/src/pjrt_capi.cpp): the raw StableHLO module bytecode (weights
+    baked in as constants) plus a serialized default CompileOptionsProto,
+    in a flat binary container the C side can read without zip/json/proto
+    libraries. On a real TPU host the C client dlopens the platform's
+    PJRT plugin (libtpu.so), compiles the module, and runs inference with
+    no Python in the process — SURVEY §7 item 11's "C ABI over PJRT".
+    ``batch_size`` is pinned (the C ABI binds fixed shapes)."""
+    import struct
+
+    import jax
+    from jax import export as jexport
+
+    outs = output_layers if isinstance(output_layers, (list, tuple)) \
+        else [output_layers]
+    topo = Topology(list(outs))
+    state = topo.init_state()
+    params = {k: np.asarray(v) for k, v in parameters.as_dict().items()}
+
+    data_nodes = [n for n in topo.nodes if n.layer_type == "data"]
+    data_nodes.sort(key=lambda n: getattr(n, "declare_idx", 0))
+    for n in data_nodes:
+        enforce_that(not n.is_sequence,
+                     "export_pjrt_model supports dense-input graphs",
+                     context="export_pjrt")
+
+    args = tuple(
+        jax.ShapeDtypeStruct((int(batch_size), n.size), np.float32)
+        for n in data_nodes)
+
+    def forward(*feed_vals):
+        feeds = {n.name: v for n, v in zip(data_nodes, feed_vals)}
+        outs_v, _ = topo.forward(params, state, feeds, train=False)
+        return tuple(o.data if hasattr(o, "segment_ids") else o
+                     for o in outs_v)
+
+    exported = jexport.export(jax.jit(forward))(*args)
+    mlir = exported.mlir_module_serialized
+
+    from jax._src.lib import _jax as _xc
+    opts = _xc.CompileOptions().SerializeAsString()
+
+    with open(path, "wb") as f:
+        w = f.write
+        w(b"PTPJ")
+        w(struct.pack("<I", 1))
+        w(struct.pack("<I", len(data_nodes)))
+        for n in data_nodes:
+            name = n.name.encode()
+            w(struct.pack("<H", len(name)))
+            w(name)
+            w(struct.pack("<BB", 0, 2))  # f32, rank 2
+            w(struct.pack("<2q", int(batch_size), int(n.size)))
+        w(struct.pack("<I", len(outs)))
+        w(struct.pack("<Q", len(mlir)))
+        w(mlir)
+        w(struct.pack("<Q", len(opts)))
+        w(opts)
+
+
+# ---------------------------------------------------------------------------
 # AOT program export: the interpreter-free C inference artifact
 # ---------------------------------------------------------------------------
 #

@@ -88,6 +88,40 @@ def build_aot(force: bool = False) -> str:
     return out
 
 
+def _pjrt_include_dir():
+    """The PJRT C API header ships with several wheels; find one."""
+    import glob
+    import sysconfig
+
+    site = os.path.dirname(os.path.dirname(sysconfig.get_paths()["purelib"]))
+    cands = glob.glob(os.path.join(
+        sysconfig.get_paths()["purelib"], "tensorflow", "include"))
+    for c in cands:
+        if os.path.exists(os.path.join(c, "xla", "pjrt", "c",
+                                       "pjrt_c_api.h")):
+            return c
+    return None
+
+
+def build_pjrt(force: bool = False) -> str:
+    """Compile the PJRT C-API inference runtime → libptpu_pjrt.so.
+    Pure C++ + libdl; the PJRT plugin (libtpu.so on TPU hosts) is
+    dlopen'd at runtime, never linked."""
+    os.makedirs(_BUILD, exist_ok=True)
+    out = os.path.join(_BUILD, "libptpu_pjrt.so")
+    src = os.path.join(_SRC, "pjrt_capi.cpp")
+    if (not force and os.path.exists(out)
+            and os.path.getmtime(out) >= os.path.getmtime(src)):
+        return out
+    inc = _pjrt_include_dir()
+    if inc is None:
+        raise RuntimeError("no pjrt_c_api.h found in site-packages")
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", f"-I{inc}",
+           "-o", out, src, "-ldl"]
+    subprocess.run(cmd, check=True, capture_output=True)
+    return out
+
+
 def _load():
     global _lib, _load_error
     if _lib is not None or _load_error is not None:
