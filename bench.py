@@ -359,28 +359,37 @@ def worker_transformer():
         return out
 
     # ~400M-param config sized for one v5e chip (params+momentum+grads
-    # ~6.5GB f32, saved activations ~4GB at 4096 tokens); the fallback
-    # config halves the model if the big one OOMs on a future chip
+    # ~6.5GB f32, saved activations ~4GB at 4096 tokens). bs=8 is tried
+    # FIRST: more tokens/step amortize the fixed per-step overhead
+    # (optimizer update, dispatch) so MFU is strictly better if it fits;
+    # fall back to bs=4, then to the half-width model
     fallback_reason = None
     d_used = 2048
-    try:
-        out = measure(d=d_used, layers=8, heads=16, seq=1024, bs=4)
-    except Exception as e:
-        # record and EXIT the except first: e.__traceback__ pins the failed
-        # attempt's frame (its device buffers included); the fallback must
-        # allocate after those are droppable
-        fallback_reason = repr(e)
-        out = None
+    out = None
+    bs_used = 4
+    for d_try, bs_try in ((2048, 8), (2048, 4), (1024, 4)):
+        try:
+            out = measure(d=d_try, layers=8, heads=16, seq=1024, bs=bs_try)
+            d_used, bs_used = d_try, bs_try
+            if fallback_reason:
+                out["transformer_fallback_reason"] = fallback_reason
+            break
+        except Exception as e:
+            # record and keep going: e.__traceback__ pins the failed
+            # attempt's frame (its device buffers included); the next
+            # attempt must allocate after those are droppable
+            fallback_reason = repr(e)
+            out = None
     if out is None:
-        d_used = 1024
-        out = measure(d=d_used, layers=8, heads=16, seq=1024, bs=4)
-        out["transformer_fallback_reason"] = fallback_reason
+        raise RuntimeError(f"all transformer configs failed: "
+                           f"{fallback_reason}")
     print(json.dumps(out), flush=True)  # headline before the flag variant
     try:  # bf16 residual-stream variant (FLAGS.bf16_dense_activations)
         from paddle_tpu.platform.flags import FLAGS
 
         FLAGS.bf16_dense_activations = True
-        bf = measure(d=d_used, layers=8, heads=16, seq=1024, bs=4)
+        bf = measure(d=d_used, layers=8, heads=16, seq=1024,
+                     bs=bs_used)
         out["transformer_bf16_resid_tokens_per_sec"] = \
             bf["transformer_tokens_per_sec"]
         if "transformer_mfu" in bf:
