@@ -143,3 +143,100 @@ def test_gradient_printer_passthrough():
     w = np.asarray(params[out.name + ".w0"])
     np.testing.assert_allclose(np.asarray(g[out.name + ".w0"]),
                                np.full_like(w, 2.0), atol=1e-5)
+
+
+def test_column_sum_and_sum():
+    paddle.topology.reset_name_scope()
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(3))
+    fx = np.array([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]], np.float32)
+    (got,) = run_metric(evaluator.column_sum(x), {"x": fx})
+    np.testing.assert_allclose(got, fx.mean(-1))
+    paddle.topology.reset_name_scope()
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(3))
+    (got2,) = run_metric(evaluator.sum(x), {"x": fx})
+    np.testing.assert_allclose(got2, fx.sum(-1))
+
+
+def test_precision_recall_f1():
+    paddle.topology.reset_name_scope()
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(2))
+    y = layer.data(name="y", type=paddle.data_type.integer_value(2))
+    # preds: 1,1,0,0 ; labels: 1,0,1,0 -> tp=1 fp=1 fn=1 -> P=R=F1=0.5
+    logits = np.array([[0., 1.], [0., 1.], [1., 0.], [1., 0.]], np.float32)
+    lab = np.array([1, 0, 1, 0], np.int32)
+    (f1,) = run_metric(evaluator.precision_recall(x, y),
+                       {"x": logits, "y": lab})
+    assert abs(float(f1) - 0.5) < 1e-6
+
+
+def test_pnpair_ratio():
+    paddle.topology.reset_name_scope()
+    s = layer.data(name="s", type=paddle.data_type.dense_vector(1))
+    y = layer.data(name="y", type=paddle.data_type.integer_value(2))
+    q = layer.data(name="q", type=paddle.data_type.integer_value(10))
+    # query 0: pos scored above neg (correct); query 1: pos below neg
+    score = np.array([[0.9], [0.1], [0.2], [0.8]], np.float32)
+    lab = np.array([1, 0, 1, 0], np.int32)
+    qid = np.array([0, 0, 1, 1], np.int32)
+    (ratio,) = run_metric(evaluator.pnpair(s, y, q),
+                          {"s": score, "y": lab, "q": qid})
+    assert abs(float(ratio) - 0.5) < 1e-6
+
+
+def test_seq_classification_error():
+    paddle.topology.reset_name_scope()
+    p = layer.data(name="p", type=paddle.data_type.dense_vector_sequence(3))
+    y = layer.data(name="y", type=paddle.data_type.integer_value_sequence(3))
+    # seq0: both tokens right; seq1: one token wrong -> errors [0, 1]
+    logits = np.eye(3, dtype=np.float32)[[0, 2, 1, 1]]
+    sb = make_seq(logits, [2, 2])
+    lab = make_seq(np.array([0, 2, 1, 0], np.float32), [2, 2])
+    lab = SequenceBatch(lab.data.astype(jnp.int32), lab.segment_ids,
+                        lab.lengths, max_len=lab.max_len)
+    (err,) = run_metric(evaluator.seq_classification_error(p, y),
+                        {"p": sb, "y": lab})
+    np.testing.assert_allclose(err[:2], [0.0, 1.0])
+
+
+def test_value_and_maxid_printers_pass_through(capfd):
+    paddle.topology.reset_name_scope()
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(3))
+    fx = np.array([[0.1, 0.9, 0.0]], np.float32)
+    (v,) = run_metric(evaluator.value_printer(x), {"x": fx})
+    assert v.shape == (1,)  # printers emit via jax.debug.print
+    paddle.topology.reset_name_scope()
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(3))
+    (m,) = run_metric(evaluator.maxid_printer(x), {"x": fx})
+    assert m.shape == (1,)
+    printed = capfd.readouterr().out + capfd.readouterr().err
+    assert "0.9" in printed or "1" in printed
+
+
+def test_auc_mann_whitney():
+    paddle.topology.reset_name_scope()
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(2))
+    y = layer.data(name="y", type=paddle.data_type.integer_value(2))
+    probs = np.array([[0.1, 0.9], [0.2, 0.8], [0.8, 0.2], [0.9, 0.1]],
+                     np.float32)
+    lab = np.array([1, 1, 0, 0], np.int32)
+    (a,) = run_metric(evaluator.auc(x, y), {"x": probs, "y": lab})
+    assert abs(float(a) - 1.0) < 1e-6  # perfectly separated
+    lab2 = np.array([0, 1, 1, 0], np.int32)
+    (a2,) = run_metric(evaluator.auc(x, y), {"x": probs, "y": lab2})
+    assert abs(float(a2) - 0.5) < 1e-6  # one concordant, one discordant
+
+
+def test_every_public_evaluator_is_exercised():
+    """Breadth gate: every public evaluator fn must be named by a test
+    (reference: test_Evaluator.cpp covers the registered evaluator set)."""
+    import inspect
+    import os
+
+    from paddle_tpu import evaluator as ev
+
+    names = [n for n, o in vars(ev).items()
+             if not n.startswith("_") and inspect.isfunction(o)
+             and o.__module__ == "paddle_tpu.evaluator"]
+    corpus = open(os.path.abspath(__file__)).read()
+    missing = [n for n in names if f"evaluator.{n}" not in corpus]
+    assert not missing, f"evaluators with no test: {missing}"

@@ -1,0 +1,107 @@
+"""networks.py helper coverage: the composite-network builders the
+reference ships in trainer_config_helpers/networks.py, each built, run
+forward, and (where cheap) gradient-sanity-checked — plus a breadth gate
+so every exported helper stays exercised somewhere in tests/ or models/.
+"""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import layer, networks
+from paddle_tpu.sequence import SequenceBatch
+from paddle_tpu.topology import Topology
+
+RNG = np.random.RandomState(41)
+
+
+def forward(node, feeds, seed=0, train=False, rng=None):
+    topo = Topology([node])
+    params = paddle.Parameters.from_topology(topo, seed=seed)
+    outs, _ = topo.forward(params.as_dict(), topo.init_state(), feeds,
+                           train=train, rng=rng)
+    return outs[0], params, topo
+
+
+def _seq(dim, lens, seed=3):
+    rng = np.random.RandomState(seed)
+    return SequenceBatch.from_list(
+        [rng.randn(l, dim).astype(np.float32) * 0.5 for l in lens])
+
+
+def test_img_conv_group_shapes_and_bn():
+    paddle.topology.reset_name_scope()
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(3 * 8 * 8),
+                   height=8, width=8)
+    out = networks.img_conv_group(x, conv_num_filter=[4, 4],
+                                  conv_with_batchnorm=True, num_channels=3)
+    fx = RNG.randn(2, 3 * 8 * 8).astype(np.float32)
+    got, _, topo = forward(out, {"x": fx})
+    assert np.asarray(got).reshape(2, -1).shape == (2, 4 * 4 * 4)
+    assert np.isfinite(np.asarray(got)).all()
+    # BN state threads through the group (moving stats namespaces exist)
+    assert topo.init_state(), "batch_norm state expected"
+
+
+def test_vgg_16_network_builds_and_runs():
+    paddle.topology.reset_name_scope()
+    x = layer.data(name="img", type=paddle.data_type.dense_vector(3 * 32 * 32),
+                   height=32, width=32)
+    out = networks.vgg_16_network(x, num_channels=3, num_classes=10)
+    fx = RNG.randn(1, 3 * 32 * 32).astype(np.float32)
+    got, _, _ = forward(out, {"img": fx})
+    probs = np.asarray(got)
+    assert probs.shape == (1, 10)
+    np.testing.assert_allclose(probs.sum(-1), 1.0, rtol=1e-4)
+
+
+def test_bidirectional_gru_matches_two_directions():
+    paddle.topology.reset_name_scope()
+    H, D = 3, 4
+    s = layer.data(name="s", type=paddle.data_type.dense_vector_sequence(D))
+    bi = networks.bidirectional_gru(s, size=H, name="bg")
+    sb = _seq(D, [3, 2])
+    got, params, _ = forward(bi, {"s": sb}, seed=5)
+    # same weights, run the two directions separately and concat by hand
+    paddle.topology.reset_name_scope()
+    s = layer.data(name="s", type=paddle.data_type.dense_vector_sequence(D))
+    fwd = networks.simple_gru(s, size=H, reverse=False, name="bg_fwd")
+    bwd = networks.simple_gru(s, size=H, reverse=True, name="bg_bwd")
+    topo2 = Topology([fwd, bwd])
+    p2 = paddle.Parameters.from_topology(topo2, seed=5)
+    p2.update_from({k: np.asarray(v) for k, v in params.as_dict().items()
+                    if k in dict(p2.as_dict())})
+    outs, _ = topo2.forward(p2.as_dict(), topo2.init_state(), {"s": sb})
+    want = np.concatenate([np.asarray(outs[0].data),
+                           np.asarray(outs[1].data)], axis=-1)
+    np.testing.assert_allclose(np.asarray(got.data), want, rtol=1e-5,
+                               atol=1e-6)
+    # return_seq=False variant: last fwd + first bwd states
+    paddle.topology.reset_name_scope()
+    s = layer.data(name="s", type=paddle.data_type.dense_vector_sequence(D))
+    pooled = networks.bidirectional_gru(s, size=H, return_seq=False,
+                                        name="bg2")
+    got2, _, _ = forward(pooled, {"s": sb}, seed=5)
+    assert np.asarray(got2).shape == (2, 2 * H)
+
+
+def test_every_network_helper_is_exercised():
+    """Breadth gate over networks.py public helpers (reference:
+    trainer_config_helpers/networks.py surface)."""
+    import inspect
+
+    names = [n for n, o in vars(networks).items()
+             if not n.startswith("_") and inspect.isfunction(o)
+             and o.__module__ == "paddle_tpu.networks"]
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(here)
+    corpus = ""
+    for p in (glob.glob(os.path.join(here, "*.py"))
+              + glob.glob(os.path.join(repo, "paddle_tpu", "models", "*.py"))):
+        corpus += open(p).read()
+    missing = [n for n in names if n not in corpus]
+    assert not missing, f"network helpers with no usage: {missing}"
