@@ -328,11 +328,12 @@ def worker_transformer():
     kind = jax.devices()[0].device_kind
     peak = _peak_for(kind)
 
-    def measure(d, layers, heads, seq, bs, vocab=32768, iters=6):
+    def measure(d, layers, heads, seq, bs, vocab=32768, iters=6,
+                fused_head=False):
         paddle.topology.reset_name_scope()
         tokens, pos, target, logits, cost = transformer.build(
             vocab_size=vocab, d_model=d, n_layers=layers, n_heads=heads,
-            max_len=seq)
+            max_len=seq, fused_head=fused_head)
         topo = paddle.topology.Topology([cost])
         params = paddle.Parameters.from_topology(topo, seed=0)
         sgd = _make_sgd(cost, params)
@@ -383,7 +384,18 @@ def worker_transformer():
     if out is None:
         raise RuntimeError(f"all transformer configs failed: "
                            f"{fallback_reason}")
-    print(json.dumps(out), flush=True)  # headline before the flag variant
+    print(json.dumps(out), flush=True)  # headline before the variants
+    try:  # fused blockwise LM-head xent (layer.lm_head_cost): logits
+        # never reach HBM; candidate replacement headline if faster
+        fh = measure(d=d_used, layers=8, heads=16, seq=1024, bs=bs_used,
+                     fused_head=True)
+        out["transformer_fused_head_tokens_per_sec"] = \
+            fh["transformer_tokens_per_sec"]
+        if "transformer_mfu" in fh:
+            out["transformer_fused_head_mfu"] = fh["transformer_mfu"]
+    except Exception as e:
+        out["transformer_fused_head_error"] = repr(e)
+    print(json.dumps(out), flush=True)
     try:  # bf16 residual-stream variant (FLAGS.bf16_dense_activations)
         from paddle_tpu.platform.flags import FLAGS
 

@@ -59,12 +59,19 @@ def block(x, *, n_heads: int, ffn_mult: int = 4, name: str,
 
 def build(vocab_size: int = 32768, d_model: int = 512, n_layers: int = 6,
           n_heads: int = 8, max_len: int = 1024, ffn_mult: int = 4,
-          dropout: float = 0.0):
+          dropout: float = 0.0, fused_head: bool = False):
     """Returns (tokens, positions, target, logits, cost).
 
     Feeds: ``tokens`` / ``target`` are integer sequences (next-token
     targets), ``pos`` is the 0-based position within each sequence
     (fed as data so packed buffers need no in-graph segment arithmetic).
+
+    ``fused_head=True`` swaps the fc(vocab) -> classification_cost pair
+    for layer.lm_head_cost (blockwise online-logsumexp; the [tokens,
+    vocab] logits never reach HBM — ~0.5-1 GB/step at bench shapes).
+    Training-equivalent to f32 rounding (test_network_compare pins it);
+    the returned ``logits`` node still exists for decoding and shares
+    the head weight by name.
     """
     tokens = layer.data(name="tokens",
                         type=paddle.data_type.integer_value_sequence(vocab_size))
@@ -81,7 +88,16 @@ def build(vocab_size: int = 32768, d_model: int = 512, n_layers: int = 6,
                   dropout=dropout)
     x = layer.layer_norm(x, name="final_ln")
     logits = layer.fc(input=x, size=vocab_size, name="lm_head")
-    cost = layer.classification_cost(input=logits, label=target)
+    if fused_head:
+        # share the fc's default-named weights so decoding (which reads
+        # lm_head.w0/b) and checkpoints are identical either way
+        from paddle_tpu.attr import ParamAttr
+        cost = layer.lm_head_cost(x, target, vocab_size=vocab_size,
+                                  param_attr=ParamAttr(name="lm_head.w0"),
+                                  bias_attr=ParamAttr(name="lm_head.b"),
+                                  name="lm_head_fused")
+    else:
+        cost = layer.classification_cost(input=logits, label=target)
     return tokens, pos, target, logits, cost
 
 

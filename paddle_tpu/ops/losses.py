@@ -13,6 +13,7 @@ variable-length batches weight correctly.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -218,3 +219,123 @@ def cross_entropy_over_beam(beams) -> jax.Array:
     # gold path is always the LAST logit
     return softmax_cross_entropy(
         picked, jnp.full(picked.shape[:1], picked.shape[1] - 1, jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# blockwise LM-head cross entropy — flash-style: the [N, V] logits matrix
+# never exists in HBM
+# ---------------------------------------------------------------------------
+
+
+def _lm_blocks(w, block_v):
+    v = w.shape[1]
+    if block_v <= 0 or block_v > v:
+        block_v = v
+    while v % block_v != 0:  # shrink to a divisor; correctness first
+        block_v //= 2
+    return max(1, block_v), v
+
+
+def lm_head_xent(x, w, b, labels, block_v: int = 4096):
+    """loss[i] = logsumexp(x_i @ W + b) - (x_i @ W + b)[labels_i].
+
+    The LM-head fc + softmax_cross_entropy fusion, computed in vocab
+    blocks with an online logsumexp (the flash-attention trick applied to
+    the classifier): per block only [N, block_v] activations exist, so
+    the [N, V] logits (0.5-1 GB at bench shapes) never hit HBM in either
+    pass — the backward recomputes each block's softmax from the saved
+    logz. Matmuls ride the bf16/f32-accum policy (ops/math.py).
+
+    x: [N, D] tokens; w: [D, V]; b: [V] or None; labels: [N] int.
+    Returns per-token loss [N] in f32.
+    """
+    return _lm_head_xent(x, w, b if b is not None else jnp.zeros(
+        (w.shape[1],), jnp.float32), labels.astype(jnp.int32), int(block_v))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _lm_head_xent(x, w, b, labels, block_v):
+    loss, _ = _lm_head_fwd_impl(x, w, b, labels, block_v)
+    return loss
+
+
+def _block_logits(x, w, b, j, bv):
+    from paddle_tpu.ops.math import compute_dtype
+
+    d = w.shape[0]
+    wj = jax.lax.dynamic_slice(w, (0, j * bv), (d, bv))
+    bj = jax.lax.dynamic_slice(b, (j * bv,), (bv,))
+    ct = compute_dtype(x)
+    lg = jnp.matmul(x.astype(ct), wj.astype(ct),
+                    preferred_element_type=jnp.float32)
+    return lg + bj.astype(jnp.float32)
+
+
+def _lm_head_fwd_impl(x, w, b, labels, block_v):
+    bv, v = _lm_blocks(w, block_v)
+    n = x.shape[0]
+    nb = v // bv
+    neg = jnp.float32(-jnp.inf)
+
+    def body(carry, j):
+        m, s, picked = carry
+        lg = _block_logits(x, w, b, j, bv)               # [N, bv] f32
+        bm = jnp.max(lg, axis=-1)
+        new_m = jnp.maximum(m, bm)
+        s = s * jnp.exp(m - new_m) + jnp.sum(
+            jnp.exp(lg - new_m[:, None]), axis=-1)
+        in_blk = (labels >= j * bv) & (labels < (j + 1) * bv)
+        idx = jnp.clip(labels - j * bv, 0, bv - 1)
+        pick_j = jnp.take_along_axis(lg, idx[:, None], axis=-1)[:, 0]
+        picked = jnp.where(in_blk, pick_j, picked)
+        return (new_m, s, picked), None
+
+    init = (jnp.full((n,), neg), jnp.zeros((n,), jnp.float32),
+            jnp.zeros((n,), jnp.float32))
+    (m, s, picked), _ = jax.lax.scan(body, init,
+                                     jnp.arange(nb, dtype=jnp.int32))
+    logz = m + jnp.log(s)
+    return logz - picked, logz
+
+
+def _lm_head_xent_fwd(x, w, b, labels, block_v):
+    loss, logz = _lm_head_fwd_impl(x, w, b, labels, block_v)
+    return loss, (x, w, b, labels, logz)
+
+
+def _lm_head_xent_bwd(block_v, res, g):
+    x, w, b, labels, logz = res
+    bv, v = _lm_blocks(w, block_v)
+    d = w.shape[0]
+    nb = v // bv
+    gf = g.astype(jnp.float32)
+
+    def body(carry, j):
+        dx, dw, db = carry
+        lg = _block_logits(x, w, b, j, bv)
+        p = jnp.exp(lg - logz[:, None])                  # softmax block
+        in_blk = (labels >= j * bv) & (labels < (j + 1) * bv)
+        idx = jnp.clip(labels - j * bv, 0, bv - 1)
+        onehot = (jnp.arange(bv)[None, :] == idx[:, None]) & in_blk[:, None]
+        dlg = (p - onehot.astype(jnp.float32)) * gf[:, None]  # [N, bv]
+        wj = jax.lax.dynamic_slice(w, (0, j * bv), (d, bv))
+        from paddle_tpu.ops.math import compute_dtype
+        ct = compute_dtype(x)
+        dx = dx + jnp.matmul(dlg.astype(ct), wj.astype(ct).T,
+                             preferred_element_type=jnp.float32)
+        dwj = jnp.matmul(x.astype(ct).T, dlg.astype(ct),
+                         preferred_element_type=jnp.float32)
+        dw = jax.lax.dynamic_update_slice(
+            dw, dwj.astype(dw.dtype), (0, j * bv))
+        db = jax.lax.dynamic_update_slice(
+            db, jnp.sum(dlg, axis=0).astype(db.dtype), (j * bv,))
+        return (dx, dw, db), None
+
+    init = (jnp.zeros(x.shape, jnp.float32), jnp.zeros_like(w),
+            jnp.zeros_like(b))
+    (dx, dw, db), _ = jax.lax.scan(body, init,
+                                   jnp.arange(nb, dtype=jnp.int32))
+    return dx.astype(x.dtype), dw, db, None
+
+
+_lm_head_xent.defvjp(_lm_head_xent_fwd, _lm_head_xent_bwd)

@@ -1013,6 +1013,15 @@ def _smooth_l1():
                      check_inputs=["x"])
 
 
+@case("lm_head_cost")
+def _lm_head_cost():
+    x, fx = dense("x", 6)
+    lab = layer.data(name="lab", type=paddle.data_type.integer_value(11))
+    flab = RNG.randint(0, 11, (4,)).astype(np.int32)
+    check_layer_grad(layer.lm_head_cost(x, lab, vocab_size=11, block_size=4),
+                     {"x": fx, "lab": flab}, check_inputs=["x"])
+
+
 @case("sum_cost")
 def _sum_cost():
     x, fx = dense("x", 5)

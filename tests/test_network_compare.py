@@ -175,3 +175,21 @@ def test_compare_catches_inequivalent_networks():
     fx = RNG.randn(4, 6).astype(np.float32)
     with pytest.raises(AssertionError):
         compare_topologies(a, b, {"x": fx})
+
+
+def test_lm_head_cost_vs_unfused_pair():
+    """Fused blockwise LM-head xent == fc(vocab) -> classification_cost
+    with the same weights, outputs AND grads (incl. through the input)."""
+    paddle.topology.reset_name_scope()
+    V, D = 37, 6   # non-power-of-two vocab exercises the divisor fallback
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(D))
+    lab = layer.data(name="lab", type=paddle.data_type.integer_value(V))
+    a = layer.classification_cost(
+        input=layer.fc(x, size=V, param_attr=ParamAttr(name="head_w"),
+                       bias_attr=ParamAttr(name="head_b")), label=lab)
+    b = layer.lm_head_cost(x, lab, vocab_size=V,
+                           param_attr=ParamAttr(name="head_w"),
+                           bias_attr=ParamAttr(name="head_b"), block_size=8)
+    fx = RNG.randn(5, D).astype(np.float32)
+    flab = RNG.randint(0, V, (5,)).astype(np.int32)
+    compare_topologies(a, b, {"x": fx, "lab": flab}, check_inputs=("x",))

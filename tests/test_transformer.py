@@ -335,3 +335,44 @@ def test_seq2seq_transformer_learns_copy_task(rng):
 
     a, b = run(good), run(bad)
     assert np.abs(a - b).max() > 1e-3
+
+
+def test_fused_head_training_parity(rng):
+    """fused_head=True (blockwise lm_head_cost, logits never materialized)
+    must follow the SAME training trajectory as the unfused
+    fc -> classification_cost head: identical init (shared param names),
+    per-step losses equal to f32 tolerance."""
+    import jax
+
+    vocab, d = 97, 16
+
+    def run(fused):
+        paddle.topology.reset_name_scope()
+        tokens, pos, target, logits, cost = transformer.build(
+            vocab_size=vocab, d_model=d, n_layers=1, n_heads=2,
+            max_len=32, fused_head=fused)
+        topo = paddle.topology.Topology([cost])
+        params = paddle.Parameters.from_topology(topo, seed=3)
+        sgd = trainer.SGD(cost=cost, parameters=params,
+                          update_equation=optimizer.Sgd(learning_rate=0.1))
+        step = sgd._build_step()
+        feeds = _feeds(sgd, np.random.RandomState(5), vocab, lens=(9, 6))
+        p, o, m = sgd.parameters.as_dict(), sgd.opt_state, sgd.model_state
+        key = jax.random.PRNGKey(0)
+        losses = []
+        for _ in range(5):
+            loss, p, o, m, _ = step(p, o, m, key, feeds)
+            losses.append(float(loss))
+        return losses, {k: np.asarray(v) for k, v in p.items()}
+
+    from paddle_tpu.platform.flags import FLAGS
+    old = FLAGS.use_bf16
+    FLAGS.use_bf16 = False
+    try:
+        l_plain, p_plain = run(False)
+        l_fused, p_fused = run(True)
+    finally:
+        FLAGS.use_bf16 = old
+    np.testing.assert_allclose(l_fused, l_plain, rtol=1e-4)
+    np.testing.assert_allclose(p_fused["lm_head.w0"], p_plain["lm_head.w0"],
+                               rtol=1e-3, atol=1e-6)
