@@ -291,27 +291,40 @@ def stage_params(params, n_layers: int):
 
 def beam_generate(params, prompt_ids, max_new_tokens: int, *, n_layers: int,
                   n_heads: int, beam_size: int = 4, max_len: int = 1024,
-                  eos_id: int = -1, length_penalty: float = 0.0):
+                  eos_id: int = -1, length_penalty: float = 0.0,
+                  candidate_adjust=None, path_filter=None,
+                  stop_condition=None):
     """Beam-search decode (the transformer analog of generation.py's in-jit
     RNN beam loop / RecurrentGradientMachine::beamSearch).
 
     Returns (tokens [max_new_tokens] int32, score float) of the best beam.
     Scores are sum of token log-probs, normalized by length**length_penalty
     at the final selection (0 = pure sum, 1 = mean log-prob).
+
+    The user control hooks mirror generation.beam_search (the
+    RecurrentGradientMachine.h:73-148 callbacks), traced into the scan:
+    ``candidate_adjust(logp [k,V], beam)`` transforms live-beam
+    continuation log-probs (beam is a generation.BeamState with leading
+    beam axis, batch==1 semantics); ``path_filter(beam) -> keep [k]``
+    drops selected beams (score -1e30); ``stop_condition(beam) -> bool``
+    marks every beam done — remaining steps extend with EOS at zero cost,
+    which is exactly an early stop under the length-normalized selection.
     """
     p, prompt, n_prompt, total = _prep_decode(
         params, prompt_ids, max_new_tokens, max_len, "beam_generate")
     if max_new_tokens == 0:
         return np.zeros((0,), np.int32), 0.0
     run = _beam_fn(n_layers, n_heads, max_len, n_prompt, total,
-                   int(beam_size), int(eos_id), float(length_penalty))
+                   int(beam_size), int(eos_id), float(length_penalty),
+                   candidate_adjust, path_filter, stop_condition)
     toks, score = run(p, prompt)
     return np.asarray(toks), float(score)
 
 
 @functools.lru_cache(maxsize=32)
 def _beam_fn(n_layers, n_heads, max_len, n_prompt, total, beam_size, eos_id,
-             length_penalty):
+             length_penalty, candidate_adjust=None, path_filter=None,
+             stop_condition=None):
     """Jitted beam-search scan for one static config (weights are args)."""
     import jax
     import jax.numpy as jnp
@@ -356,8 +369,14 @@ def _beam_fn(n_layers, n_heads, max_len, n_prompt, total, beam_size, eos_id,
             toks, flat, scores, done, hist = carry
             logp, cs = batched(toks, _unflatten_caches(flat), t)  # [k,V]
             vocab = logp.shape[-1]
+            t_rel = t - (n_prompt - 1)
+            if candidate_adjust is not None:
+                from paddle_tpu.generation import BeamState
+                lengths = jnp.sum(hist != eos_id, axis=1).astype(jnp.int32)
+                logp = candidate_adjust(
+                    logp, BeamState(t_rel, toks, scores, done, lengths))
             # done beams may only extend with eos at no cost; live beams
-            # add token log-probs
+            # add token log-probs (AFTER the adjust: hooks cannot unfreeze)
             eos_row = jnp.full((vocab,), NEG).at[eos_id].set(0.0)
             logp = jnp.where(done[:, None], eos_row[None, :], logp)
             cand = scores[:, None] + logp                      # [k,V]
@@ -372,6 +391,17 @@ def _beam_fn(n_layers, n_heads, max_len, n_prompt, total, beam_size, eos_id,
             hist = hist[parent]
             hist = jax.lax.dynamic_update_index_in_dim(
                 hist, tok_next, t - (n_prompt - 1), 1)
+            if path_filter is not None or stop_condition is not None:
+                from paddle_tpu.generation import BeamState
+                lengths = jnp.sum(hist != eos_id, axis=1).astype(jnp.int32)
+                beam_now = BeamState(t_rel, tok_next, top_scores, new_done,
+                                     lengths)
+                if path_filter is not None:
+                    top_scores = jnp.where(path_filter(beam_now), top_scores,
+                                           NEG)
+                if stop_condition is not None:
+                    new_done = new_done | jnp.broadcast_to(
+                        jnp.asarray(stop_condition(beam_now)), (k,))
             return ((tok_next, cs_sel, top_scores, new_done, hist),
                     None)
 

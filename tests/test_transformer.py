@@ -376,3 +376,42 @@ def test_fused_head_training_parity(rng):
     np.testing.assert_allclose(l_fused, l_plain, rtol=1e-4)
     np.testing.assert_allclose(p_fused["lm_head.w0"], p_plain["lm_head.w0"],
                                rtol=1e-3, atol=1e-6)
+
+
+def test_beam_generate_control_hooks(rng):
+    """The transformer beam decode honors the same user hooks as the RNN
+    beam path: identity hooks change nothing; a token ban is respected;
+    stop_condition EOS-freezes all beams from that step on."""
+    import jax.numpy as jnp
+
+    vocab, d = 37, 16
+    paddle.topology.reset_name_scope()
+    tokens, pos, target, logits, cost = transformer.build(
+        vocab_size=vocab, d_model=d, n_layers=1, n_heads=2, max_len=32)
+    params = {k: np.asarray(v) for k, v in paddle.Parameters.from_topology(
+        paddle.topology.Topology([cost]), seed=9).as_dict().items()}
+    prompt = [3, 5, 7]
+    kw = dict(n_layers=1, n_heads=2, max_len=32, beam_size=3, eos_id=0)
+
+    base_toks, base_score = transformer.beam_generate(
+        params, prompt, 6, **kw)
+    ident_toks, ident_score = transformer.beam_generate(
+        params, prompt, 6, candidate_adjust=lambda lp, beam: lp,
+        path_filter=lambda beam: jnp.ones_like(beam.finished),
+        **kw)
+    np.testing.assert_array_equal(ident_toks, base_toks)
+    assert abs(ident_score - base_score) < 1e-5
+
+    banned = int(base_toks[0])
+    ban_toks, _ = transformer.beam_generate(
+        params, prompt, 6,
+        candidate_adjust=lambda lp, beam: lp.at[:, banned].set(-1e30),
+        **kw)
+    assert banned not in ban_toks
+
+    stop_toks, _ = transformer.beam_generate(
+        params, prompt, 6,
+        stop_condition=lambda beam: beam.t >= 1, **kw)
+    # steps 0 and 1 produced real tokens; everything after is eos padding
+    assert (stop_toks[2:] == 0).all()
+    np.testing.assert_array_equal(stop_toks[:2], base_toks[:2])
