@@ -123,6 +123,38 @@ def load_merged_model(path: str) -> MergedModel:
     return MergedModel(path)
 
 
+def _dense_forward_spec(output_layers, parameters, batch_size, *, context):
+    """Shared export preamble: topology, sorted dense data nodes, the
+    weights-closed forward fn, and fixed-batch arg specs (merge_model /
+    export_pjrt_model / export_aot_program all trace the same way)."""
+    import jax
+
+    outs = output_layers if isinstance(output_layers, (list, tuple)) \
+        else [output_layers]
+    topo = Topology(list(outs))
+    state = topo.init_state()
+    params = {k: np.asarray(v) for k, v in parameters.as_dict().items()}
+
+    data_nodes = [n for n in topo.nodes if n.layer_type == "data"]
+    data_nodes.sort(key=lambda n: getattr(n, "declare_idx", 0))
+    for n in data_nodes:
+        enforce_that(not n.is_sequence,
+                     f"{context} supports dense-input graphs",
+                     context=context)
+
+    args = tuple(
+        jax.ShapeDtypeStruct((int(batch_size), n.size), np.float32)
+        for n in data_nodes)
+
+    def forward(*feed_vals):
+        feeds = {n.name: v for n, v in zip(data_nodes, feed_vals)}
+        outs_v, _ = topo.forward(params, state, feeds, train=False)
+        return tuple(o.data if hasattr(o, "segment_ids") else o
+                     for o in outs_v)
+
+    return outs, topo, data_nodes, forward, args
+
+
 # ---------------------------------------------------------------------------
 # PJRT model export: the TPU-production C inference artifact
 # ---------------------------------------------------------------------------
@@ -143,29 +175,8 @@ def export_pjrt_model(output_layers, parameters: Parameters, path: str,
     import jax
     from jax import export as jexport
 
-    outs = output_layers if isinstance(output_layers, (list, tuple)) \
-        else [output_layers]
-    topo = Topology(list(outs))
-    state = topo.init_state()
-    params = {k: np.asarray(v) for k, v in parameters.as_dict().items()}
-
-    data_nodes = [n for n in topo.nodes if n.layer_type == "data"]
-    data_nodes.sort(key=lambda n: getattr(n, "declare_idx", 0))
-    for n in data_nodes:
-        enforce_that(not n.is_sequence,
-                     "export_pjrt_model supports dense-input graphs",
-                     context="export_pjrt")
-
-    args = tuple(
-        jax.ShapeDtypeStruct((int(batch_size), n.size), np.float32)
-        for n in data_nodes)
-
-    def forward(*feed_vals):
-        feeds = {n.name: v for n, v in zip(data_nodes, feed_vals)}
-        outs_v, _ = topo.forward(params, state, feeds, train=False)
-        return tuple(o.data if hasattr(o, "segment_ids") else o
-                     for o in outs_v)
-
+    outs, _topo, data_nodes, forward, args = _dense_forward_spec(
+        output_layers, parameters, batch_size, context="export_pjrt")
     exported = jexport.export(jax.jit(forward))(*args)
     mlir = exported.mlir_module_serialized
 
@@ -405,37 +416,15 @@ def export_aot_program(output_layers, parameters: Parameters, path: str,
 
     from paddle_tpu.platform.flags import FLAGS
 
-    outs = output_layers if isinstance(output_layers, (list, tuple)) \
-        else [output_layers]
-    topo = Topology(list(outs))
-    state = topo.init_state()
-    params = {k: np.asarray(v, np.float32) for k, v in
-              parameters.as_dict().items()}
-
-    data_nodes = [n for n in topo.nodes if n.layer_type == "data"]
-    data_nodes.sort(key=lambda n: getattr(n, "declare_idx", 0))
-    enforce_that(len(data_nodes) == 1,
-                 "AOT export v1 is single-input (the C ABI binds one "
-                 "dense feed); concat extra features host-side or use "
-                 "the merged StableHLO path", context="export_aot")
-    for n in data_nodes:
-        enforce_that(not n.is_sequence,
-                     "AOT export supports dense-input graphs",
-                     context="export_aot")
-
     old_bf16 = FLAGS.use_bf16
     FLAGS.use_bf16 = False  # the C runtime is f32-only
     try:
-        args = tuple(
-            jax.ShapeDtypeStruct((int(batch_size), n.size), np.float32)
-            for n in data_nodes)
-
-        def forward(*feed_vals):
-            feeds = {n.name: v for n, v in zip(data_nodes, feed_vals)}
-            outs_v, _ = topo.forward(params, state, feeds, train=False)
-            return tuple(o.data if hasattr(o, "segment_ids") else o
-                         for o in outs_v)
-
+        outs, _topo, data_nodes, forward, args = _dense_forward_spec(
+            output_layers, parameters, batch_size, context="export_aot")
+        enforce_that(len(data_nodes) == 1,
+                     "AOT export v1 is single-input (the C ABI binds one "
+                     "dense feed); concat extra features host-side or use "
+                     "the merged StableHLO path", context="export_aot")
         closed = jax.make_jaxpr(forward)(*args)
     finally:
         FLAGS.use_bf16 = old_bf16

@@ -365,16 +365,20 @@ def _beam_fn(n_layers, n_heads, max_len, n_prompt, total, beam_size, eos_id,
                            in_axes=(0, [(0, 0)] * n_layers, None),
                            out_axes=(0, [(0, 0)] * n_layers))
 
+        from paddle_tpu.generation import BeamState
+
+        def _beam_state(t_rel, toks_, scores_, done_, hist_):
+            lengths = jnp.sum(hist_ != eos_id, axis=1).astype(jnp.int32)
+            return BeamState(t_rel, toks_, scores_, done_, lengths)
+
         def scan_fn(carry, t):
             toks, flat, scores, done, hist = carry
             logp, cs = batched(toks, _unflatten_caches(flat), t)  # [k,V]
             vocab = logp.shape[-1]
             t_rel = t - (n_prompt - 1)
             if candidate_adjust is not None:
-                from paddle_tpu.generation import BeamState
-                lengths = jnp.sum(hist != eos_id, axis=1).astype(jnp.int32)
                 logp = candidate_adjust(
-                    logp, BeamState(t_rel, toks, scores, done, lengths))
+                    logp, _beam_state(t_rel, toks, scores, done, hist))
             # done beams may only extend with eos at no cost; live beams
             # add token log-probs (AFTER the adjust: hooks cannot unfreeze)
             eos_row = jnp.full((vocab,), NEG).at[eos_id].set(0.0)
@@ -392,10 +396,8 @@ def _beam_fn(n_layers, n_heads, max_len, n_prompt, total, beam_size, eos_id,
             hist = jax.lax.dynamic_update_index_in_dim(
                 hist, tok_next, t - (n_prompt - 1), 1)
             if path_filter is not None or stop_condition is not None:
-                from paddle_tpu.generation import BeamState
-                lengths = jnp.sum(hist != eos_id, axis=1).astype(jnp.int32)
-                beam_now = BeamState(t_rel, tok_next, top_scores, new_done,
-                                     lengths)
+                beam_now = _beam_state(t_rel, tok_next, top_scores, new_done,
+                                       hist)
                 if path_filter is not None:
                     top_scores = jnp.where(path_filter(beam_now), top_scores,
                                            NEG)

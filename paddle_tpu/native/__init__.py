@@ -89,17 +89,13 @@ def build_aot(force: bool = False) -> str:
 
 
 def _pjrt_include_dir():
-    """The PJRT C API header ships with several wheels; find one."""
-    import glob
+    """The PJRT C API header ships with the tensorflow wheel."""
     import sysconfig
 
-    site = os.path.dirname(os.path.dirname(sysconfig.get_paths()["purelib"]))
-    cands = glob.glob(os.path.join(
-        sysconfig.get_paths()["purelib"], "tensorflow", "include"))
-    for c in cands:
-        if os.path.exists(os.path.join(c, "xla", "pjrt", "c",
-                                       "pjrt_c_api.h")):
-            return c
+    inc = os.path.join(sysconfig.get_paths()["purelib"], "tensorflow",
+                       "include")
+    if os.path.exists(os.path.join(inc, "xla", "pjrt", "c", "pjrt_c_api.h")):
+        return inc
     return None
 
 

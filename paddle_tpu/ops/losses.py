@@ -227,6 +227,11 @@ def cross_entropy_over_beam(beams) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
+def _compute_dtype(x):
+    from paddle_tpu.ops.math import compute_dtype  # deferred: avoids a cycle
+    return compute_dtype(x)
+
+
 def _lm_blocks(w, block_v):
     v = w.shape[1]
     if block_v <= 0 or block_v > v:
@@ -260,12 +265,10 @@ def _lm_head_xent(x, w, b, labels, block_v):
 
 
 def _block_logits(x, w, b, j, bv):
-    from paddle_tpu.ops.math import compute_dtype
-
     d = w.shape[0]
     wj = jax.lax.dynamic_slice(w, (0, j * bv), (d, bv))
     bj = jax.lax.dynamic_slice(b, (j * bv,), (bv,))
-    ct = compute_dtype(x)
+    ct = _compute_dtype(x)
     lg = jnp.matmul(x.astype(ct), wj.astype(ct),
                     preferred_element_type=jnp.float32)
     return lg + bj.astype(jnp.float32)
@@ -319,8 +322,7 @@ def _lm_head_xent_bwd(block_v, res, g):
         onehot = (jnp.arange(bv)[None, :] == idx[:, None]) & in_blk[:, None]
         dlg = (p - onehot.astype(jnp.float32)) * gf[:, None]  # [N, bv]
         wj = jax.lax.dynamic_slice(w, (0, j * bv), (d, bv))
-        from paddle_tpu.ops.math import compute_dtype
-        ct = compute_dtype(x)
+        ct = _compute_dtype(x)
         dx = dx + jnp.matmul(dlg.astype(ct), wj.astype(ct).T,
                              preferred_element_type=jnp.float32)
         dwj = jnp.matmul(x.astype(ct).T, dlg.astype(ct),

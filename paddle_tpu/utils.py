@@ -223,32 +223,25 @@ def compare_topologies(node_a, node_b, feeds_a, feeds_b=None, *,
             olds = {k: getattr(FLAGS, k) for k in (overrides or {})}
             for k, v in (overrides or {}).items():
                 setattr(FLAGS, k, v)
+            in_names = list(check_inputs)
 
-            def loss_fn(p, f):
+            # one forward+backward: params and checked inputs differentiate
+            # together (argnums pair), instead of a second full pass
+            def loss_fn(p, fvals):
+                f = {**feeds, **dict(zip(in_names, fvals))}
                 outs, _ = topo.forward(p, topo.init_state(), f, train=False)
                 o = outs[0]
                 return _reduce_cost(o), (o.data if isinstance(o, SequenceBatch)
                                          else o)
 
-            in_names = [n for n in check_inputs]
-            def wrt_inputs(p, f):
-                return loss_fn(p, f)[0]
-
+            fvals = [jnp.asarray(feeds[n], jnp.float32) for n in in_names]
             try:
-                (loss, out), gp = jax.value_and_grad(loss_fn, has_aux=True)(
-                    params, feeds)
-                gi = {}
-                if in_names:
-                    gfull = jax.grad(
-                        lambda fv: wrt_inputs(
-                            params, {**feeds, **dict(zip(in_names, fv))}))(
-                        [jnp.asarray(feeds[n], jnp.float32)
-                         for n in in_names])
-                    gi = dict(zip(in_names, gfull))
+                (loss, out), (gp, gf) = jax.value_and_grad(
+                    loss_fn, argnums=(0, 1), has_aux=True)(params, fvals)
             finally:
                 for k, v in olds.items():
                     setattr(FLAGS, k, v)
-            return out, gp, gi
+            return out, gp, dict(zip(in_names, gf))
 
         out_a, gpa, gia = run(topo_a, pa, feeds_a, flags_a)
         out_b, gpb, gib = run(topo_b, pb, feeds_b, flags_b)
