@@ -666,7 +666,7 @@ def main():
 
     # cheap + hardware-independent first: never starved by a dead tunnel
     out, err = _run_worker("scaling", deadline, cpu=True,
-                           attempt_timeout=280, max_attempts=1)
+                           attempt_timeout=380, max_attempts=1)
     if out:
         record.update(out)
     else:
