@@ -678,10 +678,11 @@ def main():
                               max_attempts=3)
     if probe:
         record.update(probe)
-        # pre-existing metrics first; the new workers (transformer,
-        # convnets) must not starve them of deadline budget
-        for name in ("resnet50", "alexnet", "lstm", "attention",
-                     "transformer", "convnets"):
+        # the transformer MFU is THE round-4 headline (VERDICT r3 item 1)
+        # and the relay can flap: measure it first, then the other
+        # headline families, diagnostics last
+        for name in ("transformer", "resnet50", "lstm", "convnets",
+                     "alexnet", "attention"):
             out, err = _run_worker(name, deadline)
             if out:
                 record.update(out)

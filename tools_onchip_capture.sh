@@ -1,0 +1,19 @@
+#!/bin/bash
+# One-command on-chip capture for the moment the axon relay returns.
+# Runs bench workers in headline-priority order, each in a subprocess
+# with a hard timeout (the relay's failure mode is a HANG), appending
+# every JSON line to /tmp/onchip_results.jsonl. Then update
+# LAST_ONCHIP.json + BENCH_NOTES from those lines.
+set -u
+cd "$(dirname "$0")"
+OUT=/tmp/onchip_results.jsonl
+date >> "$OUT"
+if ! timeout 120 python bench.py --worker probe >> "$OUT" 2>/tmp/onchip_err.txt; then
+  echo "probe failed -- relay still down" | tee -a "$OUT"; exit 1
+fi
+for w in transformer resnet50 lstm convnets alexnet attention; do
+  echo "== $w ==" >> "$OUT"
+  timeout 600 python bench.py --worker "$w" >> "$OUT" 2>>/tmp/onchip_err.txt
+  echo "rc=$? for $w" >> "$OUT"
+done
+echo "done; results in $OUT"
