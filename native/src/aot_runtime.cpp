@@ -8,7 +8,8 @@
 // tensor program; this file executes it with plain C++ loops — zero
 // dependencies beyond libc/libm. The CPython-hosted StableHLO path
 // (capi.cpp) remains the full-coverage fallback; this runtime covers the
-// dense inference graphs embedders ship (MLP/CNN + softmax heads).
+// dense inference graphs embedders ship (MLP/CNN/embedding + softmax
+// heads; integer-id feeds ride as floats, exact below 2^24).
 //
 // Opcodes must stay in sync with export.py (OP_* constants).
 
@@ -29,6 +30,8 @@ enum Op : uint32_t {
   DOT = 15, BCAST = 16, RESHAPE = 17, TRANSPOSE = 18,
   RSUM = 19, RMAX = 20, CONV2D = 21, MAXPOOL = 22, SUMPOOL = 23,
   SELECT_N = 24, CLAMP = 25, CONCAT = 26, IPOW = 27, IDENT = 28,
+  LT = 29, LE = 30, GT = 31, GE = 32, EQ = 33, NE = 34,
+  GATHER_ROWS = 35, TRUNC = 36,
 };
 
 struct TensorMeta {
@@ -168,12 +171,19 @@ bool validate_program(const Program& p) {
     int out_rank = static_cast<int>(p.tensors[op.out].dims.size());
     switch (op.opcode) {
       case ADD: case SUB: case MUL: case DIV: case MAX_: case MIN_:
+      case LT: case LE: case GT: case GE: case EQ: case NE:
       case DOT:
         if (nin != 2) return false;
         break;
       case EXP: case LOG: case TANH: case LOGISTIC: case RSQRT:
       case SQRT: case NEG: case ABS: case RESHAPE: case IDENT:
+      case TRUNC:
         if (nin != 1) return false;
+        break;
+      case GATHER_ROWS:
+        if (nin != 2) return false;
+        if (p.tensors[op.ins[0]].dims.size() != 2 || out_rank != 2)
+          return false;
         break;
       case IPOW:
         if (nin != 1 || na != 1) return false;
@@ -270,6 +280,12 @@ void binary_op(uint32_t opc, const TensorMeta& ma, const float* a,
       case DIV: r = x / y; break;
       case MAX_: r = x > y ? x : y; break;
       case MIN_: r = x < y ? x : y; break;
+      case LT: r = x < y ? 1.0f : 0.0f; break;
+      case LE: r = x <= y ? 1.0f : 0.0f; break;
+      case GT: r = x > y ? 1.0f : 0.0f; break;
+      case GE: r = x >= y ? 1.0f : 0.0f; break;
+      case EQ: r = x == y ? 1.0f : 0.0f; break;
+      case NE: r = x != y ? 1.0f : 0.0f; break;
     }
     out[lin] = r;
     for (int i = rank - 1; i >= 0; --i) {
@@ -307,9 +323,28 @@ struct Executor {
       const float* a = op.ins.empty() ? nullptr : ptr[op.ins[0]];
       switch (op.opcode) {
         case ADD: case SUB: case MUL: case DIV: case MAX_: case MIN_:
+        case LT: case LE: case GT: case GE: case EQ: case NE:
           binary_op(op.opcode, meta(op.ins[0]), a, meta(op.ins[1]),
                     ptr[op.ins[1]], mo, out.data());
           break;
+        case TRUNC:
+          for (int64_t i = 0; i < mo.size(); ++i) out[i] = truncf(a[i]);
+          break;
+        case GATHER_ROWS: {
+          // embedding lookup: [V, D] table, [N, 1] indices (f32-held
+          // ints) -> [N, D]; out-of-range rows fill 0 (FILL_OR_DROP)
+          const TensorMeta& mt = meta(op.ins[0]);
+          int64_t v = mt.dims[0], dcols = mt.dims[1];
+          int64_t n = mo.dims[0];
+          const float* idx = ptr[op.ins[1]];
+          for (int64_t i = 0; i < n; ++i) {
+            int64_t row = static_cast<int64_t>(idx[i]);
+            if (row < 0 || row >= v) continue;  // already zero-filled
+            std::memcpy(out.data() + i * dcols, a + row * dcols,
+                        dcols * 4);
+          }
+          break;
+        }
         case EXP: for (int64_t i = 0; i < mo.size(); ++i) out[i] = std::exp(a[i]); break;
         case LOG: for (int64_t i = 0; i < mo.size(); ++i) out[i] = std::log(a[i]); break;
         case TANH: for (int64_t i = 0; i < mo.size(); ++i) out[i] = std::tanh(a[i]); break;
@@ -572,7 +607,12 @@ int ptpu_aot_infer(void* handle, const char* input_name, const float* data,
   const auto& in = p->inputs[0];
   if (in.second != input_name) return -4;
   const TensorMeta& m = p->tensors[in.first];
-  if (m.dims.size() != 2 || m.dims[0] != batch || m.dims[1] != dim)
+  // rank-2 [batch, dim] dense feed, or rank-1 [batch] integer-id feed
+  // (ids passed as floats, dim == 1)
+  bool shape_ok =
+      (m.dims.size() == 2 && m.dims[0] == batch && m.dims[1] == dim) ||
+      (m.dims.size() == 1 && m.dims[0] == batch && dim == 1);
+  if (!shape_ok)
     return -3;  // program was AOT-compiled for a fixed shape
   Executor ex(*p);
   ex.bind(in.first, data, static_cast<size_t>(batch * dim));

@@ -52,8 +52,7 @@ def merge_model(output_layers, parameters: Parameters, path: str,
                      "merge_model currently exports dense-input graphs "
                      "(sequence feeds carry host-side ragged metadata)",
                      context="export")
-        if "INTEGER" in str(getattr(n.input_type, "kind", "")).upper() \
-                or getattr(n.input_type, "dtype", None) == "int32":
+        if _is_int_feed(n):
             dtype = "int32"
             shape: Tuple = ()
         else:
@@ -123,6 +122,12 @@ def load_merged_model(path: str) -> MergedModel:
     return MergedModel(path)
 
 
+def _is_int_feed(n) -> bool:
+    """Integer-id data node (embedding tables): fed as [B] int32.
+    data_type.integer_value marks the slot kind INDEX (SlotKind.INDEX)."""
+    return "INDEX" in str(getattr(n.input_type, "slot", "")).upper()
+
+
 def _dense_forward_spec(output_layers, parameters, batch_size, *, context):
     """Shared export preamble: topology, sorted dense data nodes, the
     weights-closed forward fn, and fixed-batch arg specs (merge_model /
@@ -137,14 +142,17 @@ def _dense_forward_spec(output_layers, parameters, batch_size, *, context):
 
     data_nodes = [n for n in topo.nodes if n.layer_type == "data"]
     data_nodes.sort(key=lambda n: getattr(n, "declare_idx", 0))
+    args = []
     for n in data_nodes:
         enforce_that(not n.is_sequence,
                      f"{context} supports dense-input graphs",
                      context=context)
-
-    args = tuple(
-        jax.ShapeDtypeStruct((int(batch_size), n.size), np.float32)
-        for n in data_nodes)
+        if _is_int_feed(n):
+            args.append(jax.ShapeDtypeStruct((int(batch_size),), np.int32))
+        else:
+            args.append(jax.ShapeDtypeStruct((int(batch_size), n.size),
+                                             np.float32))
+    args = tuple(args)
 
     def forward(*feed_vals):
         feeds = {n.name: v for n, v in zip(data_nodes, feed_vals)}
@@ -223,12 +231,15 @@ OP_SQRT, OP_NEG, OP_ABS = 12, 13, 14
 OP_DOT, OP_BCAST, OP_RESHAPE, OP_TRANSPOSE = 15, 16, 17, 18
 OP_RSUM, OP_RMAX, OP_CONV2D, OP_MAXPOOL, OP_SUMPOOL = 19, 20, 21, 22, 23
 OP_SELECT_N, OP_CLAMP, OP_CONCAT, OP_IPOW, OP_IDENT = 24, 25, 26, 27, 28
+OP_LT, OP_LE, OP_GT, OP_GE, OP_EQ, OP_NE = 29, 30, 31, 32, 33, 34
+OP_GATHER_ROWS, OP_TRUNC = 35, 36
 
 _UNARY = {"exp": OP_EXP, "log": OP_LOG, "tanh": OP_TANH,
           "logistic": OP_LOGISTIC, "rsqrt": OP_RSQRT, "sqrt": OP_SQRT,
           "neg": OP_NEG, "abs": OP_ABS}
 _BINARY = {"add": OP_ADD, "sub": OP_SUB, "mul": OP_MUL, "div": OP_DIV,
-           "max": OP_MAX, "min": OP_MIN}
+           "max": OP_MAX, "min": OP_MIN, "lt": OP_LT, "le": OP_LE,
+           "gt": OP_GT, "ge": OP_GE, "eq": OP_EQ, "ne": OP_NE}
 _CALL_PRIMS = {"jit", "pjit", "custom_jvp_call", "custom_vjp_call",
                "closed_call", "core_call", "remat", "checkpoint"}
 
@@ -240,9 +251,11 @@ class _AotBuilder:
         self.ops: List[Tuple[int, List[int], int, List[int]]] = []
 
     def tensor(self, dtype: str, shape) -> int:
-        code = {"float32": 0, "int32": 1}.get(str(dtype))
+        # bools ride as f32 0/1 in the f32-only runtime
+        code = {"float32": 0, "int32": 1, "bool": 0,
+                "int64": 1}.get(str(dtype))
         enforce_that(code is not None,
-                     f"AOT export supports f32/i32 tensors, got {dtype}",
+                     f"AOT export supports f32/i32/bool tensors, got {dtype}",
                      context="export_aot")
         self.tensors.append((code, tuple(int(d) for d in shape)))
         return len(self.tensors) - 1
@@ -252,7 +265,7 @@ class _AotBuilder:
         if value.dtype not in (np.float32, np.int32):
             value = value.astype(
                 np.int32 if np.issubdtype(value.dtype, np.integer)
-                else np.float32)
+                else np.float32)  # bools become f32 0/1
         tid = self.tensor(str(value.dtype), value.shape)
         self.consts.append((tid, np.ascontiguousarray(value)))
         return tid
@@ -364,6 +377,22 @@ def _translate_jaxpr(jaxpr, consts, arg_ids, b: "_AotBuilder") -> List[int]:
                    [read(eq.invars[0])], t,
                    [wd[1], wd[2], ws[1], ws[2],
                     pad[1][0], pad[1][1], pad[2][0], pad[2][1]])
+        elif prim == "gather":
+            dn = eq.params["dimension_numbers"]
+            op_av = eq.invars[0].aval
+            idx_av = eq.invars[1].aval
+            ss = tuple(eq.params["slice_sizes"])
+            enforce_that(
+                tuple(dn.offset_dims) == (1,)
+                and tuple(dn.collapsed_slice_dims) == (0,)
+                and tuple(dn.start_index_map) == (0,)
+                and len(op_av.shape) == 2 and len(idx_av.shape) == 2
+                and idx_av.shape[1] == 1
+                and ss == (1, op_av.shape[1]),
+                "AOT gather supports row lookup (embedding tables): "
+                "[V,D] table, [N,1] indices", context="export_aot")
+            t = out_tid()
+            b.emit(OP_GATHER_ROWS, [read(v) for v in eq.invars], t)
         elif prim == "select_n":
             t = out_tid()
             b.emit(OP_SELECT_N, [read(v) for v in eq.invars], t)
@@ -383,16 +412,13 @@ def _translate_jaxpr(jaxpr, consts, arg_ids, b: "_AotBuilder") -> List[int]:
             if src == dst:
                 write(eq.outvars[0], read(eq.invars[0]))
                 continue
-            # the runtime is f32-only (i32 consts are widened at load), so
-            # int->float widening is a copy; float->int TRUNCATION has no
-            # runtime representation and must be rejected loudly
-            enforce_that(np.issubdtype(np.dtype(src), np.integer)
-                         and np.dtype(dst) == np.float32,
-                         f"AOT export: unsupported cast {src}->{dst} "
-                         "(f32-only runtime) — use the merged StableHLO "
-                         "path instead", context="export_aot")
+            # the runtime stores everything as f32 (i32 consts widened at
+            # load): widening casts are copies; casts TO integer truncate
+            # toward zero (exact for |x| < 2^24, jax's f32->i32 semantics)
+            to_int = np.issubdtype(np.dtype(dst), np.integer)
             t = out_tid()
-            b.emit(OP_IDENT, [read(eq.invars[0])], t)
+            b.emit(OP_TRUNC if to_int else OP_IDENT,
+                   [read(eq.invars[0])], t)
         else:
             raise EnforceError(
                 f"AOT export: unsupported primitive {prim!r} — this graph "
@@ -430,7 +456,8 @@ def export_aot_program(output_layers, parameters: Parameters, path: str,
         FLAGS.use_bf16 = old_bf16
 
     b = _AotBuilder()
-    arg_ids = [b.tensor("float32", (int(batch_size), n.size))
+    arg_ids = [b.tensor("int32", (int(batch_size),)) if _is_int_feed(n)
+               else b.tensor("float32", (int(batch_size), n.size))
                for n in data_nodes]
     out_ids = _translate_jaxpr(closed.jaxpr, closed.consts, arg_ids, b)
 
