@@ -247,8 +247,18 @@ class Topology:
             ctx._current = node.name
             # named_scope: layer names show up in xplane/profiler traces
             # (the REGISTER_TIMER-per-layer analog, NeuralNetwork.cpp:259)
-            with jax.named_scope(node.name):
-                values[node.name] = node.fn(ctx, node_params, ins)
+            try:
+                with jax.named_scope(node.name):
+                    values[node.name] = node.fn(ctx, node_params, ins)
+            except Exception as e:
+                # the CustomStackTrace analog (utils/CustomStackTrace.h,
+                # pushed per layer NeuralNetwork.cpp:260-262): name the
+                # failing layer so shape/dtype errors point at the config
+                e.add_note(
+                    f"[paddle_tpu] while computing layer {node.name!r} "
+                    f"(type={node.layer_type}, "
+                    f"inputs={[i.name for i in node.inputs]})")
+                raise
         new_state = dict(state)
         for ns, slots in ctx.state_out.items():
             # per-slot merge: a node updating one slot must not drop the

@@ -288,3 +288,22 @@ def test_attr_aliases():
     assert attr.ParameterAttribute is attr.ParamAttr
     assert attr.ExtraLayerAttribute is attr.ExtraAttr
     assert attr.HookAttribute is attr.HookAttr
+
+
+def test_forward_errors_name_the_failing_layer():
+    """The CustomStackTrace analog: a crash inside a layer's compute names
+    the layer (utils/CustomStackTrace.h printed the layer stack)."""
+    import traceback
+
+    paddle.topology.reset_name_scope()
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    node = layer.fc(x, size=3, name="culprit")
+    topo = paddle.topology.Topology([node])
+    params = paddle.Parameters.from_topology(topo)
+    bad = np.zeros((2, 7), np.float32)  # wrong feature dim -> matmul error
+    try:
+        topo.forward(params.as_dict(), topo.init_state(), {"x": bad})
+        assert False, "expected a shape error"
+    except Exception as e:
+        text = "".join(traceback.format_exception(e))
+        assert "culprit" in text and "type=fc" in text
