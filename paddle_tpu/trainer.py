@@ -166,7 +166,7 @@ class SGD:
                                    specs=self.topology.param_specs(),
                                    zero_axis=self._zero_axis)
         self.parameters.update_from(
-            {k: jax.device_put(v, shardings[k])
+            {k: _put_global(v, shardings[k])
              for k, v in self.parameters.as_dict().items()})
         if not slots_too or not isinstance(self.opt_state, dict):
             return
@@ -174,7 +174,7 @@ class SGD:
         for key in ("slots",):
             if key in new_state:
                 new_state[key] = {
-                    s: {k: (jax.device_put(v, shardings[k])
+                    s: {k: (_put_global(v, shardings[k])
                             if k in shardings else v)
                         for k, v in d.items()}
                     for s, d in new_state[key].items()}
@@ -194,12 +194,22 @@ class SGD:
         # break on non-divisible trailing batches and force a per-step
         # all-gather against the stage constraints)
         axis = "data" if "data" in self.mesh.axis_names else None
+        nproc = jax.process_count()
         out = {}
         for k, v in feeds.items():
             if isinstance(v, SequenceBatch):
                 out[k] = v  # ragged feeds stay replicated (see parallel/)
             elif axis is None:
-                out[k] = jax.device_put(v, NamedSharding(self.mesh, P()))
+                out[k] = _put_global(v, NamedSharding(self.mesh, P()))
+            elif nproc > 1:
+                # multi-host DP: each process feeds its LOCAL rows; the
+                # global batch is the concatenation over processes (every
+                # process must feed the same local batch size — the
+                # reference's fixed num_gradient_servers contract)
+                sh = NamedSharding(self.mesh,
+                                   P(axis, *([None] * (v.ndim - 1))))
+                out[k] = jax.make_array_from_process_local_data(
+                    sh, np.asarray(v))
             else:
                 out[k] = jax.device_put(
                     v, NamedSharding(self.mesh, P(axis, *([None] * (v.ndim - 1)))))
@@ -582,6 +592,21 @@ class SGD:
         if model_state is not None:
             self.model_state = model_state
         self._place_on_mesh()
+
+
+def _put_global(v, sharding) -> jax.Array:
+    """Place a host array onto a (possibly multi-process) sharding.
+
+    Single-process: plain device_put. Multi-process: device_put cannot
+    address other hosts' devices, so build the global array from a
+    callback over the full host copy every process holds (params and
+    replicated feeds are host-identical across processes — the pserver
+    sendBackParameter invariant)."""
+    if jax.process_count() <= 1:
+        return jax.device_put(v, sharding)
+    host = np.asarray(v)
+    return jax.make_array_from_callback(host.shape, sharding,
+                                        lambda idx: host[idx])
 
 
 def _default_event_handler(ev) -> None:

@@ -78,6 +78,34 @@ g0 = np.asarray(grads["fc_0.w0"])
 print(f"pid{pid} loss={float(loss):.6f} gsum={float(np.abs(g0).sum()):.6f}",
       flush=True)
 print(f"pid{pid} TRAIN OK", flush=True)
+
+# --- the v2 API end-to-end across processes: SGD.train on a global mesh ---
+from paddle_tpu import optimizer, trainer
+paddle.topology.reset_name_scope()
+x2 = layer.data(name="x", type=paddle.data_type.dense_vector(6))
+lab2 = layer.data(name="label", type=paddle.data_type.integer_value(2))
+cost2 = layer.classification_cost(input=layer.fc(x2, size=2), label=lab2)
+params2 = paddle.Parameters.from_topology(Topology([cost2]), seed=1)
+sgd = trainer.SGD(cost=cost2, parameters=params2,
+                  update_equation=optimizer.Sgd(learning_rate=0.2),
+                  mesh=mesh)
+
+def local_reader():
+    # each process reads ITS half of a deterministic global stream
+    r = np.random.RandomState(11)
+    for i in range(32):
+        v = r.randn(6).astype(np.float32)
+        y = int(v[:3].sum() > v[3:].sum())
+        if i % 2 == pid:   # disjoint halves
+            yield v, y
+
+costs = []
+sgd.train(paddle.batch(local_reader, 4), num_passes=3,
+          event_handler=lambda ev: costs.append(float(ev.cost))
+          if isinstance(ev, paddle.event.EndIteration) else None)
+assert costs[-1] < costs[0], (costs[0], costs[-1])
+w = np.asarray(sgd.parameters["fc_0.w0"])
+print(f"pid{pid} SGD OK wsum={float(np.abs(w).sum()):.6f}", flush=True)
 """
 
 
@@ -120,8 +148,13 @@ def test_two_process_mesh_and_train_step(tmp_path):
         assert p.returncode == 0, f"pid{i} failed:\n{out[-2500:]}"
         assert f"pid{i} psum OK" in out
         assert f"pid{i} TRAIN OK" in out
+        assert f"pid{i} SGD OK" in out
     # both processes computed the IDENTICAL loss and global gradient —
     # the sync-SGD invariant (pserver addGradient analog)
     line0 = [l for l in outs[0].splitlines() if "loss=" in l][0]
     line1 = [l for l in outs[1].splitlines() if "loss=" in l][0]
     assert line0.split("loss=")[1] == line1.split("loss=")[1], (line0, line1)
+    # after SGD.train, both ranks hold the identical synced weights
+    w0 = [l for l in outs[0].splitlines() if "wsum=" in l][0]
+    w1 = [l for l in outs[1].splitlines() if "wsum=" in l][0]
+    assert w0.split("wsum=")[1] == w1.split("wsum=")[1], (w0, w1)
