@@ -415,3 +415,41 @@ def test_beam_generate_control_hooks(rng):
     # steps 0 and 1 produced real tokens; everything after is eos padding
     assert (stop_toks[2:] == 0).all()
     np.testing.assert_array_equal(stop_toks[:2], base_toks[:2])
+
+
+def test_fused_head_trains_on_mesh8_zero(rng):
+    """The blockwise lm_head_cost (custom_vjp + scan + dynamic slices)
+    must partition under pjit: train on the 8-device mesh with ZeRO
+    sharding, finite decreasing loss, head weight still sharded."""
+    import jax
+
+    from paddle_tpu.parallel import make_mesh
+
+    vocab, d = 128, 64
+    paddle.topology.reset_name_scope()
+    tokens, pos, target, logits, cost = transformer.build(
+        vocab_size=vocab, d_model=d, n_layers=2, n_heads=4, max_len=32,
+        fused_head=True)
+    topo = paddle.topology.Topology([cost])
+    params = paddle.Parameters.from_topology(topo, seed=0)
+    mesh = make_mesh((8,), ("data",))
+    sgd = trainer.SGD(cost=cost, parameters=params,
+                      update_equation=optimizer.Adam(learning_rate=1e-2),
+                      mesh=mesh, zero_axis="data")
+    step = sgd._build_step()
+    samples = []
+    for _ in range(8):
+        t = rng.randint(0, vocab, size=16)
+        samples.append((t.tolist(), list(range(16)), np.roll(t, -1).tolist()))
+    feeds = sgd._shard_feeds(
+        sgd._make_feeder({"tokens": 0, "pos": 1, "target": 2}).feed(samples))
+    p, o, m = sgd.parameters.as_dict(), sgd.opt_state, sgd.model_state
+    key = jax.random.PRNGKey(0)
+    losses = []
+    for _ in range(6):
+        loss, p, o, m, _ = step(p, o, m, key, feeds)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    w = p["lm_head.w0"]
+    assert w.addressable_shards[0].data.size < w.size
