@@ -2160,9 +2160,11 @@ def dotmul_bcast(a, b, name: Optional[str] = None) -> LayerOutput:
 # recurrent group surface (paddle_tpu/recurrent.py) + step cells
 # ---------------------------------------------------------------------------
 
-from paddle_tpu.recurrent import StaticInput, memory, recurrent_group  # noqa: E402
+from paddle_tpu.recurrent import (StaticInput, SubsequenceInput,  # noqa: E402
+                                  memory, recurrent_group)
 
-__all__ += ["StaticInput", "memory", "recurrent_group", "gru_step", "lstm_step"]
+__all__ += ["StaticInput", "SubsequenceInput", "memory",
+            "recurrent_group", "gru_step", "lstm_step"]
 
 
 def gru_step(input, output_mem, size: int = None, act=None, gate_act=None,

@@ -28,7 +28,7 @@ from paddle_tpu.sequence import SequenceBatch
 from paddle_tpu.topology import (Context, LayerOutput, ParamSpec, Topology,
                                  unique_name)
 
-__all__ = ["memory", "StaticInput", "recurrent_group"]
+__all__ = ["memory", "StaticInput", "SubsequenceInput", "recurrent_group"]
 
 
 # stack of per-group memory collections; populated while a step fn is traced
@@ -74,6 +74,26 @@ class StaticInput:
     def __init__(self, input: LayerOutput, is_seq: bool = None):
         self.input = input
         self.is_seq = input.is_sequence if is_seq is None else is_seq
+
+
+class SubsequenceInput:
+    """Marks a NESTED sequence in-link of a hierarchical recurrent_group
+    (reference: SubsequenceInput, trainer_config_helpers layers.py — the
+    sequence_nest_rnn configs): the group's outer loop steps over INNER
+    SEQUENCES, so each frame the step receives a SequenceBatch (one inner
+    sequence per outer sequence) and can run pooling / an inner
+    recurrent_group over it.
+
+    ``max_inner`` (most inner sequences per outer sequence) and
+    ``max_inner_len`` (longest inner sequence) are STATIC shape bounds for
+    the compiled scan — pass the feeder's bucket bounds; they default to
+    the input's max_len (safe but O(max_len^2) padding)."""
+
+    def __init__(self, input: LayerOutput, max_inner: int = None,
+                 max_inner_len: int = None):
+        self.input = input
+        self.max_inner = max_inner
+        self.max_inner_len = max_inner_len
 
 
 # ---------------------------------------------------------------------------
@@ -167,20 +187,37 @@ def recurrent_group(step, input, reverse: bool = False,
 
     seq_inputs: List[LayerOutput] = []
     static_inputs: List[StaticInput] = []
+    nested_specs: List[SubsequenceInput] = []
     frame_args: List[LayerOutput] = []
     frame_nodes: List[LayerOutput] = []    # placeholders for per-frame slices
     static_nodes: List[LayerOutput] = []   # placeholders for statics
 
+    nested = any(isinstance(it, SubsequenceInput) for it in inputs)
     for item in inputs:
         if isinstance(item, StaticInput):
             node = make_static_node(name, item)
             static_inputs.append(item)
             static_nodes.append(node)
             frame_args.append(node)
+        elif isinstance(item, SubsequenceInput):
+            # hierarchical group: the frame IS an inner sequence
+            node = LayerOutput(name=unique_name(f"{name}_subseq_frame"),
+                               layer_type="frame", inputs=[], fn=None,
+                               size=item.input.size, is_sequence=True)
+            seq_inputs.append(item.input)
+            nested_specs.append(item)
+            frame_nodes.append(node)
+            frame_args.append(node)
         else:
             enforce_that(item.is_sequence,
                          f"recurrent_group input {item.name} must be a sequence "
                          "(wrap non-sequences in StaticInput)", context="recurrent")
+            enforce_that(not nested,
+                         "a hierarchical recurrent_group steps over inner "
+                         "sequences: wrap EVERY sequence in-link in "
+                         "SubsequenceInput (mixed nest levels don't align, "
+                         "the reference's equal-nest-level rule)",
+                         context="recurrent")
             node = LayerOutput(name=unique_name(f"{name}_frame"),
                                layer_type="frame", inputs=[], fn=None,
                                size=item.size, is_sequence=False)
@@ -190,11 +227,20 @@ def recurrent_group(step, input, reverse: bool = False,
 
     enforce_that(len(seq_inputs) > 0, "recurrent_group needs >=1 sequence input",
                  context="recurrent")
+    enforce_that(not nested or len(nested_specs) == len(seq_inputs),
+                 "mixed nested and flat sequence in-links", context="recurrent")
 
     # ---- trace the step graph once --------------------------------------
     step_outs, memories = trace_step(step, frame_args)
     multi_out = isinstance(step_outs, (list, tuple))
     out_list: List[LayerOutput] = list(step_outs) if multi_out else [step_outs]
+    if nested:
+        for o in out_list:
+            enforce_that(not o.is_sequence,
+                         "hierarchical recurrent_group steps must return "
+                         "per-inner-sequence VECTORS (pool/last_seq the "
+                         "inner sequence inside the step); nested sequence "
+                         "outputs are not supported yet", context="recurrent")
 
     sub_outputs = list(out_list)
     link_nodes = resolve_memory_links(Topology(sub_outputs), memories,
@@ -309,8 +355,114 @@ def recurrent_group(step, input, reverse: bool = False,
                                                      capacity=first.capacity))
         return tuple(results) if multi_out else results[0]
 
+    def compute_nested(ctx: Context, p, ins):
+        """Hierarchical scan: one outer step per INNER sequence. Frames are
+        SequenceBatches rebuilt inside the scan from the [B, S, W, ...]
+        nested view (reference: RecurrentGradientMachine's nested-level
+        forward, test_RecurrentGradientMachine.cpp sequence_nest configs)."""
+        from paddle_tpu.sequence import nested_to_padded
+
+        seq_vals: List[SequenceBatch] = ins[:len(seq_inputs)]
+        static_vals = ins[len(seq_inputs):len(seq_inputs) + len(static_inputs)]
+        boot_vals = ins[len(seq_inputs) + len(static_inputs):]
+        boot_map = {}
+        bi = 0
+        for m in memories:
+            if m.boot_layer is not None:
+                boot_map[m.node.name] = boot_vals[bi]
+                bi += 1
+
+        first = seq_vals[0]
+        B = first.num_seqs
+        views = []
+        counts = None
+        S = W = None
+        for spec, sv in zip(nested_specs, seq_vals):
+            enforce_that(sv.sub_segment_ids is not None,
+                         "SubsequenceInput needs a nested SequenceBatch "
+                         "feed (sub_segment_ids)", context="recurrent")
+            s_b = int(spec.max_inner or sv.max_len or sv.capacity)
+            w_b = int(spec.max_inner_len or sv.max_len or sv.capacity)
+            enforce_that(S is None or (S == s_b and W == w_b),
+                         "nested in-links disagree on max_inner/"
+                         "max_inner_len bounds", context="recurrent")
+            S, W = s_b, w_b
+            data, inner_lens, cnt = nested_to_padded(sv, s_b, w_b)
+            views.append((data, inner_lens))
+            # outer frames advance in lockstep: inner-seq counts must agree
+            counts = cnt if counts is None else jnp.minimum(counts, cnt)
+
+        outer_mask = jnp.arange(S)[None, :] < counts[:, None]   # [B, S]
+
+        group_name = ctx._current or name
+        sub_state0 = read_group_state(ctx, sub_topo)
+        base_key = ctx.rng_for(group_name)
+
+        def frame(carry, xs):
+            mems, sstate = carry
+            t_views, m_t, t_idx = xs
+            feeds: Dict[str, Any] = {}
+            for node, (x_t, lens_t) in zip(frame_nodes, t_views):
+                # dead outer frames (this row has no s-th inner sequence)
+                # get a 1-token zero dummy: empty sequences make max-pool
+                # emit -inf whose masked-out gradient is still NaN
+                # (0 * inf); the frame's output is discarded by the
+                # memory/output masks either way
+                safe_lens = jnp.where(m_t, lens_t,
+                                      jnp.ones_like(lens_t))
+                feeds[node.name] = SequenceBatch.from_padded(
+                    x_t, safe_lens, capacity=B * W)
+            for node, sv in zip(static_nodes, static_vals):
+                feeds[node.name] = sv
+            for m in memories:
+                feeds[m.node.name] = mems[m.node.name]
+            key = jax.random.fold_in(base_key, t_idx)
+            outs, new_sstate = sub_topo.forward(p, sstate, feeds,
+                                                train=ctx.train, rng=key)
+            frame_outs = outs[: len(out_list)]
+            link_outs = outs[len(out_list):]
+            new_mems = {}
+            mm = m_t[:, None]
+            for m, lo in zip(memories, link_outs):
+                prev = mems[m.node.name]
+                val = lo.data if isinstance(lo, SequenceBatch) else lo
+                new_mems[m.node.name] = jnp.where(mm, val, prev)
+            any_live = jnp.any(m_t)
+            kept_state = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(any_live, new, old),
+                new_sstate, sstate) if sstate else sstate
+            ys = tuple(o.data if isinstance(o, SequenceBatch) else o
+                       for o in frame_outs)
+            return (new_mems, kept_state), ys
+
+        init_mems = {}
+        for m in memories:
+            if m.node.name in boot_map:
+                init_mems[m.node.name] = boot_map[m.node.name].astype(
+                    jnp.float32)
+            else:
+                init_mems[m.node.name] = jnp.zeros((B, m.size), jnp.float32)
+
+        xs = (tuple((jnp.swapaxes(d, 0, 1), jnp.swapaxes(l, 0, 1))
+                    for d, l in views),
+              jnp.swapaxes(outer_mask, 0, 1),
+              jnp.arange(S, dtype=jnp.int32))
+        (_, final_sstate), ys = jax.lax.scan(frame, (init_mems, sub_state0),
+                                             xs, reverse=reverse)
+        write_group_state(ctx, final_sstate)
+        # output: one row per INNER sequence -> a flat sequence whose
+        # lengths are the inner-sequence counts (the outer structure)
+        results = []
+        for y in ys:
+            y = jnp.swapaxes(y, 0, 1)                 # [B, S, D]
+            y = jnp.where(outer_mask[:, :, None], y, 0)
+            results.append(SequenceBatch.from_padded(y, counts,
+                                                     capacity=B * S))
+        return tuple(results) if multi_out else results[0]
+
     group_node = LayerOutput(name=name, layer_type="recurrent_group",
-                             inputs=outer_inputs, fn=compute,
+                             inputs=outer_inputs,
+                             fn=compute_nested if nested else compute,
                              params=group_params,
                              foreign_state=group_state_slots(sub_topo),
                              size=out_list[0].size,

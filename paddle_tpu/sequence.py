@@ -163,3 +163,42 @@ def lengths_to_segment_ids(lengths: jax.Array, capacity: int) -> jax.Array:
     slots = jnp.arange(capacity, dtype=jnp.int32)
     seg = jnp.searchsorted(ends, slots, side="right").astype(jnp.int32)
     return jnp.where(slots < ends[-1], seg, B)
+
+
+def nested_to_padded(sb: "SequenceBatch", max_inner: int,
+                     max_inner_len: int):
+    """Dense view of a NESTED sequence batch (subSequenceStartPositions
+    analog): [B, S, W, ...feature] data plus inner lengths [B, S] and
+    inner-sequence counts [B].
+
+    ``max_inner`` (S: most inner sequences per outer sequence) and
+    ``max_inner_len`` (W: longest inner sequence) are STATIC bounds —
+    hierarchical recurrent groups scan over S with W-wide frames, so
+    compiled shapes need them up front (pass tight bounds from the
+    feeder's bucketing; tokens beyond the bounds are dropped like
+    to_padded's max_len).
+    """
+    from paddle_tpu.platform.enforce import enforce_that
+    enforce_that(sb.sub_segment_ids is not None,
+                 "nested_to_padded needs a nested SequenceBatch "
+                 "(sub_segment_ids)", context="sequence")
+    B, S, W = sb.num_seqs, int(max_inner), int(max_inner_len)
+    seg = sb.segment_ids
+    sub = sb.sub_segment_ids
+    valid = sb.valid_mask & (sub < S)
+    # contiguous (outer, inner) runs -> position within the inner sequence
+    combined = jnp.where(valid, seg * S + sub, B * S)
+    pos = position_in_sequence(combined)
+    valid = valid & (pos < W)
+    s_seg = jnp.where(valid, seg, B)
+    s_sub = jnp.where(valid, sub, 0)
+    s_pos = jnp.where(valid, pos, 0)
+    feat = sb.data.shape[1:]
+    out = jnp.zeros((B + 1, S, W) + feat, dtype=sb.data.dtype)
+    out = out.at[s_seg, s_sub, s_pos].set(jnp.where(
+        valid.reshape((-1,) + (1,) * len(feat)), sb.data, 0))
+    ones = valid.astype(jnp.int32)
+    inner_lens = jnp.zeros((B + 1, S), jnp.int32).at[s_seg, s_sub].add(ones)
+    counts = jnp.zeros((B + 1,), jnp.int32).at[
+        jnp.where(valid, seg, B)].max(jnp.where(valid, sub + 1, 0))
+    return out[:B], inner_lens[:B], counts[:B]

@@ -767,6 +767,33 @@ def _recurrent():
     check_layer_grad(layer.recurrent(s), {"s": fs}, delta=5e-3)
 
 
+@case("SubsequenceInput")
+def _subsequence_input():
+    # hierarchical group: outer loop over inner sequences (oracle-matched
+    # in test_recurrent_group; here the grad path is swept)
+    D, H = 3, 3
+    x = layer.data(name="x",
+                   type=paddle.data_type.dense_vector_sub_sequence(D))
+
+    def step(sentence):
+        pooled = layer.pooling(input=sentence,
+                               pooling_type=paddle.pooling.AvgPooling())
+        m = layer.memory(name="hs", size=H)
+        return layer.fc(input=[pooled, m], size=H, act="tanh", name="hs")
+
+    grp = layer.recurrent_group(
+        step=step, input=layer.SubsequenceInput(x, max_inner=3,
+                                                max_inner_len=4),
+        name="rg_sweep_nest")
+    toks = RNG.randn(7, D).astype(np.float32) * 0.5
+    sb = SequenceBatch(
+        jnp.asarray(toks), jnp.asarray([0, 0, 0, 0, 0, 1, 1], np.int32),
+        jnp.asarray([5, 2], np.int32),
+        sub_segment_ids=jnp.asarray([0, 0, 1, 1, 1, 0, 0], np.int32),
+        max_len=5)
+    check_layer_grad(layer.pooling(grp), {"x": sb}, delta=5e-3, rtol=8e-2)
+
+
 @case("recurrent_group", "memory", "gru_step")
 def _group_gru():
     H = 3
