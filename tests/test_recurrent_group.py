@@ -325,3 +325,50 @@ def test_hierarchical_group_trains_with_grad():
     assert rec, list(grads)
     for k in rec:
         assert float(jnp.linalg.norm(grads[k])) > 0
+
+
+def test_hierarchical_group_trains_end_to_end():
+    """Full v2 path for a hierarchical model: reader yields nested lists
+    (document = list of sentences), the feeder builds the nested
+    SequenceBatch, SGD.train converges on a separable document task."""
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer, trainer
+
+    paddle.topology.reset_name_scope()
+    D, H = 4, 6
+    x = layer.data(name="x",
+                   type=paddle.data_type.dense_vector_sub_sequence(D))
+    lab = layer.data(name="label", type=paddle.data_type.integer_value(2))
+
+    def step(sentence):
+        pooled = layer.pooling(input=sentence,
+                               pooling_type=paddle.pooling.AvgPooling())
+        m = layer.memory(name="hdoc", size=H)
+        return layer.fc(input=[pooled, m], size=H, act="tanh", name="hdoc")
+
+    grp = layer.recurrent_group(
+        step=step, input=layer.SubsequenceInput(x, max_inner=4,
+                                                max_inner_len=6),
+        name="rg_doc")
+    logits = layer.fc(input=layer.last_seq(grp), size=2)
+    cost = layer.classification_cost(input=logits, label=lab)
+    params = paddle.Parameters.from_topology(
+        paddle.topology.Topology([cost]), seed=0)
+    sgd = trainer.SGD(cost=cost, parameters=params,
+                      update_equation=optimizer.Adam(learning_rate=3e-2))
+
+    def reader():
+        rng = np.random.RandomState(0)
+        for _ in range(96):
+            label = int(rng.randint(2))
+            mean = 0.8 if label else -0.8
+            n_sent = rng.randint(1, 4)
+            doc = [(rng.randn(rng.randint(2, 6), D) * 0.3 + mean).tolist()
+                   for _ in range(n_sent)]
+            yield doc, label
+
+    costs = []
+    sgd.train(paddle.batch(reader, 8), num_passes=4,
+              event_handler=lambda ev: costs.append(float(ev.cost))
+              if isinstance(ev, paddle.event.EndIteration) else None)
+    assert costs[-1] < 0.35 * costs[0], (costs[0], costs[-1])
