@@ -331,7 +331,6 @@ def test_hierarchical_group_trains_end_to_end():
     """Full v2 path for a hierarchical model: reader yields nested lists
     (document = list of sentences), the feeder builds the nested
     SequenceBatch, SGD.train converges on a separable document task."""
-    import paddle_tpu as paddle
     from paddle_tpu import optimizer, trainer
 
     paddle.topology.reset_name_scope()
