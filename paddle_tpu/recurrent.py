@@ -234,13 +234,9 @@ def recurrent_group(step, input, reverse: bool = False,
     step_outs, memories = trace_step(step, frame_args)
     multi_out = isinstance(step_outs, (list, tuple))
     out_list: List[LayerOutput] = list(step_outs) if multi_out else [step_outs]
-    if nested:
-        for o in out_list:
-            enforce_that(not o.is_sequence,
-                         "hierarchical recurrent_group steps must return "
-                         "per-inner-sequence VECTORS (pool/last_seq the "
-                         "inner sequence inside the step); nested sequence "
-                         "outputs are not supported yet", context="recurrent")
+    # nested groups may emit per-inner-sequence VECTORS (a flat sequence
+    # over the outer structure) or transformed INNER SEQUENCES (a nested
+    # sequence out, the reference's NEST_SEQUENCE output mode)
 
     sub_outputs = list(out_list)
     link_nodes = resolve_memory_links(Topology(sub_outputs), memories,
@@ -431,8 +427,12 @@ def recurrent_group(step, input, reverse: bool = False,
             kept_state = jax.tree_util.tree_map(
                 lambda new, old: jnp.where(any_live, new, old),
                 new_sstate, sstate) if sstate else sstate
-            ys = tuple(o.data if isinstance(o, SequenceBatch) else o
-                       for o in frame_outs)
+            ys = tuple(
+                # sequence outputs ride the scan as (padded [B, Wo, ...],
+                # inner lens [B]); dense outputs as plain arrays
+                (o.to_padded()[0], o.lengths)
+                if isinstance(o, SequenceBatch) else o
+                for o in frame_outs)
             return (new_mems, kept_state), ys
 
         init_mems = {}
@@ -450,14 +450,25 @@ def recurrent_group(step, input, reverse: bool = False,
         (_, final_sstate), ys = jax.lax.scan(frame, (init_mems, sub_state0),
                                              xs, reverse=reverse)
         write_group_state(ctx, final_sstate)
-        # output: one row per INNER sequence -> a flat sequence whose
-        # lengths are the inner-sequence counts (the outer structure)
+        from paddle_tpu.sequence import nested_from_padded
         results = []
-        for y in ys:
-            y = jnp.swapaxes(y, 0, 1)                 # [B, S, D]
-            y = jnp.where(outer_mask[:, :, None], y, 0)
-            results.append(SequenceBatch.from_padded(y, counts,
-                                                     capacity=B * S))
+        for o, y in zip(out_list, ys):
+            if o.is_sequence:
+                # NESTED output: per-frame inner sequences reassemble into
+                # a nested SequenceBatch over the outer structure
+                yp, ylens = y                        # [S,B,Wo,...], [S,B]
+                yp = jnp.moveaxis(yp, 0, 1)          # [B, S, Wo, ...]
+                ylens = jnp.where(outer_mask,
+                                  jnp.swapaxes(ylens, 0, 1), 0)  # [B, S]
+                results.append(nested_from_padded(
+                    yp, ylens, counts, capacity=first.capacity))
+            else:
+                # one row per INNER sequence -> flat sequence whose
+                # lengths are the inner-sequence counts
+                yd = jnp.swapaxes(y, 0, 1)           # [B, S, D]
+                yd = jnp.where(outer_mask[:, :, None], yd, 0)
+                results.append(SequenceBatch.from_padded(
+                    yd, counts, capacity=B * S))
         return tuple(results) if multi_out else results[0]
 
     group_node = LayerOutput(name=name, layer_type="recurrent_group",

@@ -202,3 +202,27 @@ def nested_to_padded(sb: "SequenceBatch", max_inner: int,
     counts = jnp.zeros((B + 1,), jnp.int32).at[
         jnp.where(valid, seg, B)].max(jnp.where(valid, sub + 1, 0))
     return out[:B], inner_lens[:B], counts[:B]
+
+
+def nested_from_padded(data: jax.Array, inner_lens: jax.Array,
+                       counts: jax.Array, capacity: int) -> "SequenceBatch":
+    """Inverse of nested_to_padded: [B, S, W, ...feature] + inner lengths
+    [B, S] + inner-sequence counts [B] -> a nested SequenceBatch with
+    tokens packed compactly in (outer, inner, position) order."""
+    B, S, W = data.shape[0], data.shape[1], data.shape[2]
+    cap = int(capacity)
+    feat = data.shape[3:]
+    b_ix = jnp.repeat(jnp.arange(B, dtype=jnp.int32), S * W)
+    s_ix = jnp.tile(jnp.repeat(jnp.arange(S, dtype=jnp.int32), W), B)
+    w_ix = jnp.tile(jnp.arange(W, dtype=jnp.int32), B * S)
+    valid = (s_ix < counts[b_ix]) & (w_ix < inner_lens[b_ix, s_ix])
+    order = jnp.argsort(~valid, stable=True)[:cap]
+    flat = data.reshape((B * S * W,) + feat)[order]
+    seg = jnp.where(valid[order], b_ix[order], B).astype(jnp.int32)
+    sub = jnp.where(valid[order], s_ix[order], 0).astype(jnp.int32)
+    lengths = jnp.sum(jnp.where(jnp.arange(S)[None, :] < counts[:, None],
+                                inner_lens, 0), axis=1).astype(jnp.int32)
+    mask = (seg < B).reshape((-1,) + (1,) * len(feat))
+    return SequenceBatch(data=jnp.where(mask, flat, 0), segment_ids=seg,
+                         lengths=lengths, sub_segment_ids=sub,
+                         max_len=min(cap, S * W))

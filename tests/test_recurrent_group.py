@@ -371,3 +371,64 @@ def test_hierarchical_group_trains_end_to_end():
               event_handler=lambda ev: costs.append(float(ev.cost))
               if isinstance(ev, paddle.event.EndIteration) else None)
     assert costs[-1] < 0.35 * costs[0], (costs[0], costs[-1])
+
+
+def test_hierarchical_group_nested_sequence_output():
+    """NEST_SEQUENCE output mode: the step returns the TRANSFORMED inner
+    sequence (tokenwise fc conditioned on the previous sentence's pooled
+    memory); the group's output is a nested SequenceBatch mirroring the
+    input structure. Oracle-matched."""
+    import jax.numpy as jnp
+
+    paddle.topology.reset_name_scope()
+    D = 3
+    x = layer.data(name="x",
+                   type=paddle.data_type.dense_vector_sub_sequence(D))
+
+    def step(sentence):
+        m = layer.memory(name="sent_pool", size=D)
+        # tokenwise: every word of this sentence + previous sentence's mean
+        shifted = layer.addto(
+            input=[sentence, layer.expand(m, sentence)], name="tok_out")
+        pooled = layer.pooling(input=sentence,
+                               pooling_type=paddle.pooling.AvgPooling(),
+                               name="sent_pool")
+        return [shifted, pooled]
+
+    outs = layer.recurrent_group(
+        step=step, input=layer.SubsequenceInput(x, max_inner=3,
+                                                max_inner_len=4),
+        name="rg_nest_seq")
+    tok_out = outs[0]
+    topo = paddle.topology.Topology([tok_out])
+    params = paddle.Parameters.from_topology(topo, seed=0)
+
+    rng = np.random.RandomState(5)
+    toks = rng.randn(7, D).astype(np.float32)
+    sb = SequenceBatch(
+        jnp.asarray(toks), jnp.asarray([0, 0, 0, 0, 0, 1, 1], np.int32),
+        jnp.asarray([5, 2], np.int32),
+        sub_segment_ids=jnp.asarray([0, 0, 1, 1, 1, 0, 0], np.int32),
+        max_len=5)
+    got, _ = topo.forward(params.as_dict(), topo.init_state(), {"x": sb})
+    got = got[0]
+    assert got.sub_segment_ids is not None
+    np.testing.assert_array_equal(np.asarray(got.lengths), [5, 2])
+    np.testing.assert_array_equal(np.asarray(got.segment_ids)[:7],
+                                  [0, 0, 0, 0, 0, 1, 1])
+    np.testing.assert_array_equal(np.asarray(got.sub_segment_ids)[:7],
+                                  [0, 0, 1, 1, 1, 0, 0])
+
+    # oracle: sentence s tokens + mean of sentence s-1 (zero for s=0)
+    def oracle(sentences):
+        prev = np.zeros(D, np.float32)
+        rows = []
+        for s in sentences:
+            rows.append(s + prev)
+            prev = s.mean(0)
+        return np.concatenate(rows)
+
+    want = np.concatenate([oracle([toks[0:2], toks[2:5]]),
+                           oracle([toks[5:7]])])
+    np.testing.assert_allclose(np.asarray(got.data)[:7], want, rtol=1e-5,
+                               atol=1e-6)
