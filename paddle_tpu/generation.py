@@ -143,6 +143,11 @@ def beam_search(step, input, bos_id: int, eos_id: int, beam_size: int = 5,
                  "beam_search step must return a single probability layer",
                  context="beam_search")
 
+    for m in memories:
+        enforce_that(not getattr(m, "is_seq", False),
+                     "beam_search steps use dense memories (sequence "
+                     "memories belong to hierarchical recurrent_groups)",
+                     context="beam_search")
     link_nodes = resolve_memory_links(Topology([prob_layer]), memories,
                                       "beam_search")
     sub_topo = Topology([prob_layer] + link_nodes)

@@ -37,12 +37,14 @@ _MEMORY_STACK: List[List["_Memory"]] = []
 
 class _Memory:
     def __init__(self, node: LayerOutput, link_name: str, size: int,
-                 boot_layer: Optional[LayerOutput], boot_with_const_id=None):
+                 boot_layer: Optional[LayerOutput], boot_with_const_id=None,
+                 is_seq: bool = False):
         self.node = node            # placeholder node used inside the step
         self.link_name = link_name  # step layer whose output feeds t+1
         self.size = size
         self.boot_layer = boot_layer
         self.boot_with_const_id = boot_with_const_id
+        self.is_seq = is_seq
 
 
 def memory(name: str, size: int, boot_layer: Optional[LayerOutput] = None,
@@ -53,17 +55,19 @@ def memory(name: str, size: int, boot_layer: Optional[LayerOutput] = None,
     enforce_that(len(_MEMORY_STACK) > 0,
                  "memory() must be called inside a recurrent_group step",
                  context="recurrent")
-    enforce_that(not is_seq, "sequence memories (is_seq=True) are not "
-                 "supported yet — restructure as a nested recurrent_group",
-                 context="recurrent")
     enforce_that(not _kw, f"unsupported memory() options: {sorted(_kw)}",
                  context="recurrent")
-    enforce_that(boot_layer is None or not boot_layer.is_sequence,
+    enforce_that(is_seq or boot_layer is None
+                 or not boot_layer.is_sequence,
                  "memory boot_layer must be a non-sequence layer "
                  "(pool/last_seq it first)", context="recurrent")
+    enforce_that(not (is_seq and boot_layer is not None),
+                 "sequence memories boot empty (is_seq=True + boot_layer "
+                 "is not supported)", context="recurrent")
     node = LayerOutput(name=unique_name(f"mem_{name}"), layer_type="memory",
-                       inputs=[], fn=None, size=size, is_sequence=False)
-    _MEMORY_STACK[-1].append(_Memory(node, name, size, boot_layer))
+                       inputs=[], fn=None, size=size, is_sequence=is_seq)
+    _MEMORY_STACK[-1].append(_Memory(node, name, size, boot_layer,
+                                     is_seq=is_seq))
     return node
 
 
@@ -253,6 +257,14 @@ def recurrent_group(step, input, reverse: bool = False,
     n_seq = len(seq_inputs)
     n_static = len(static_inputs)
 
+    if not nested:
+        for m in memories:
+            enforce_that(not m.is_seq,
+                         "memory(is_seq=True) carries a whole inner "
+                         "sequence across OUTER steps — it needs a "
+                         "hierarchical group (SubsequenceInput in-links)",
+                         context="recurrent")
+
     def compute(ctx: Context, p, ins):
         seq_vals: List[SequenceBatch] = ins[:n_seq]
         static_vals = ins[n_seq:n_seq + n_static]
@@ -411,7 +423,12 @@ def recurrent_group(step, input, reverse: bool = False,
             for node, sv in zip(static_nodes, static_vals):
                 feeds[node.name] = sv
             for m in memories:
-                feeds[m.node.name] = mems[m.node.name]
+                if m.is_seq:
+                    mp, ml = mems[m.node.name]
+                    feeds[m.node.name] = SequenceBatch.from_padded(
+                        mp, ml, capacity=B * W)
+                else:
+                    feeds[m.node.name] = mems[m.node.name]
             key = jax.random.fold_in(base_key, t_idx)
             outs, new_sstate = sub_topo.forward(p, sstate, feeds,
                                                 train=ctx.train, rng=key)
@@ -421,8 +438,20 @@ def recurrent_group(step, input, reverse: bool = False,
             mm = m_t[:, None]
             for m, lo in zip(memories, link_outs):
                 prev = mems[m.node.name]
-                val = lo.data if isinstance(lo, SequenceBatch) else lo
-                new_mems[m.node.name] = jnp.where(mm, val, prev)
+                if m.is_seq:
+                    enforce_that(isinstance(lo, SequenceBatch),
+                                 f"memory(is_seq=True) links to "
+                                 f"{m.link_name!r} which is not a sequence "
+                                 "layer", context="recurrent")
+                    lp, _lm = lo.to_padded(max_len=W)
+                    ll = lo.lengths
+                    pp, pl = prev
+                    new_mems[m.node.name] = (
+                        jnp.where(m_t[:, None, None], lp, pp),
+                        jnp.where(m_t, jnp.maximum(ll, 1), pl))
+                else:
+                    val = lo.data if isinstance(lo, SequenceBatch) else lo
+                    new_mems[m.node.name] = jnp.where(mm, val, prev)
             any_live = jnp.any(m_t)
             kept_state = jax.tree_util.tree_map(
                 lambda new, old: jnp.where(any_live, new, old),
@@ -437,7 +466,13 @@ def recurrent_group(step, input, reverse: bool = False,
 
         init_mems = {}
         for m in memories:
-            if m.node.name in boot_map:
+            if m.is_seq:
+                # boot: a 1-token zero sequence (an EMPTY sequence would
+                # make max-pool emit -inf with NaN masked gradients)
+                init_mems[m.node.name] = (
+                    jnp.zeros((B, W, m.size), jnp.float32),
+                    jnp.ones((B,), jnp.int32))
+            elif m.node.name in boot_map:
                 init_mems[m.node.name] = boot_map[m.node.name].astype(
                     jnp.float32)
             else:

@@ -432,3 +432,62 @@ def test_hierarchical_group_nested_sequence_output():
                            oracle([toks[5:7]])])
     np.testing.assert_allclose(np.asarray(got.data)[:7], want, rtol=1e-5,
                                atol=1e-6)
+
+
+def test_sequence_memory_carries_previous_sentence():
+    """memory(is_seq=True): the step sees the PREVIOUS inner sequence as a
+    sequence (reference: seq-level memory in nested configs) — here each
+    sentence output is its own mean plus max-pool of the previous raw
+    sentence."""
+    import jax.numpy as jnp
+
+    paddle.topology.reset_name_scope()
+    D = 3
+    x = layer.data(name="x",
+                   type=paddle.data_type.dense_vector_sub_sequence(D))
+
+    def step(sentence):
+        prev_seq = layer.memory(name="raw_out", size=D, is_seq=True)
+        prev_max = layer.pooling(input=prev_seq,
+                                 pooling_type=paddle.pooling.MaxPooling())
+        cur_mean = layer.pooling(input=sentence,
+                                 pooling_type=paddle.pooling.AvgPooling())
+        out = layer.addto(input=[cur_mean, prev_max], name="vec_out")
+        # expose the raw sentence as the memory's link target
+        raw = layer.get_output(sentence, name="raw_out")
+        return [out, raw]
+
+    outs = layer.recurrent_group(
+        step=step, input=layer.SubsequenceInput(x, max_inner=3,
+                                                max_inner_len=4),
+        name="rg_seqmem")
+    vec = outs[0]
+    topo = paddle.topology.Topology([vec])
+    params = paddle.Parameters.from_topology(topo, seed=0)
+
+    rng = np.random.RandomState(8)
+    toks = rng.randn(7, D).astype(np.float32)
+    sb = SequenceBatch(
+        jnp.asarray(toks), jnp.asarray([0, 0, 0, 0, 0, 1, 1], np.int32),
+        jnp.asarray([5, 2], np.int32),
+        sub_segment_ids=jnp.asarray([0, 0, 1, 1, 1, 0, 0], np.int32),
+        max_len=5)
+    got, _ = topo.forward(params.as_dict(), topo.init_state(), {"x": sb})
+    got = got[0]
+    np.testing.assert_array_equal(np.asarray(got.lengths), [2, 1])
+
+    def oracle(sentences):
+        prev = np.zeros((1, D), np.float32)
+        res = []
+        for s in sentences:
+            res.append(s.mean(0) + prev.max(0))
+            prev = s
+        return np.stack(res)
+
+    d = np.asarray(got.data)
+    seg = np.asarray(got.segment_ids)
+    np.testing.assert_allclose(d[seg == 0],
+                               oracle([toks[0:2], toks[2:5]]),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(d[seg == 1], oracle([toks[5:7]]),
+                               rtol=1e-5, atol=1e-6)
