@@ -13,3 +13,5 @@ from paddle_tpu.parallel.api import (shard_batch, replicate, param_sharding,
 from paddle_tpu.parallel.placement import (stage_attrs, model_parallel_fc,
                                            model_parallel_mlp)
 from paddle_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+from paddle_tpu.parallel.moe import (MoEParams, init_moe_params, moe_ffn,
+                                     moe_ffn_reference)

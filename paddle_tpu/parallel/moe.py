@@ -1,0 +1,176 @@
+"""Expert-parallel Mixture-of-Experts FFN — the EP compute path.
+
+New-build extension (the reference predates MoE; its expert-parallel
+machinery is the sparse/pserver row distribution this module's dispatch
+generalizes — SURVEY §2.3 "large model dist train"): a Switch-style
+top-1 MoE FFN whose experts are sharded over a mesh axis, with the
+classic dispatch/combine all_to_all pattern from the scaling-book recipe:
+
+  tokens (sharded over the axis) --router--> per-expert capacity buffers
+  --all_to_all--> each shard runs ITS experts' FFN on tokens from every
+  shard --all_to_all--> gated combine back to token order.
+
+``moe_ffn_reference`` is the collectives-free dense formulation used for
+single-device runs and as the parity oracle; ``moe_ffn`` is the
+shard_map/all_to_all version. Tokens over capacity are DROPPED (pass
+through as zeros — callers add the residual), the Switch convention.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.platform.enforce import enforce_that
+
+try:
+    from jax import shard_map                      # jax >= 0.8
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+class MoEParams(NamedTuple):
+    """Weights for a top-1 MoE FFN: router [D, E]; experts stacked on the
+    leading axis — w1 [E, D, H], b1 [E, H], w2 [E, H, D], b2 [E, D]."""
+
+    router: jax.Array
+    w1: jax.Array
+    b1: jax.Array
+    w2: jax.Array
+    b2: jax.Array
+
+
+def init_moe_params(key, d_model: int, hidden: int, num_experts: int,
+                    scale: float = 0.02) -> MoEParams:
+    ks = jax.random.split(key, 3)
+    return MoEParams(
+        router=jax.random.normal(ks[0], (d_model, num_experts)) * scale,
+        w1=jax.random.normal(ks[1], (num_experts, d_model, hidden)) * scale,
+        b1=jnp.zeros((num_experts, hidden)),
+        w2=jax.random.normal(ks[2], (num_experts, hidden, d_model)) * scale,
+        b2=jnp.zeros((num_experts, d_model)))
+
+
+def _route(x, router_w):
+    """Top-1 routing: (expert [T], gate [T], probs [T, E])."""
+    logits = (x.astype(jnp.float32) @ router_w.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1).astype(jnp.int32)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+    return expert, gate, probs
+
+
+def _aux_stats(probs: jax.Array, expert: jax.Array):
+    """Per-batch routing statistics: (fraction routed to e, mean prob e)."""
+    e = probs.shape[-1]
+    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)
+    return jnp.mean(onehot, axis=0), jnp.mean(probs, axis=0)
+
+
+def aux_load_balance_loss(probs: jax.Array, expert: jax.Array) -> jax.Array:
+    """Switch aux loss: E * sum_e fraction_e * mean_prob_e (pushes routing
+    toward uniform expert utilisation)."""
+    fraction, mean_prob = _aux_stats(probs, expert)
+    return probs.shape[-1] * jnp.sum(fraction * mean_prob)
+
+
+def _dispatch_mask(expert, num_experts: int, capacity: int):
+    """[T, E, C] one-hot dispatch tensor: token t occupies slot
+    rank-of-t-within-its-expert of expert e; tokens past capacity drop."""
+    onehot = jax.nn.one_hot(expert, num_experts, dtype=jnp.int32)  # [T, E]
+    pos = jnp.cumsum(onehot, axis=0) - 1                           # [T, E]
+    keep = (pos < capacity) & (onehot > 0)
+    slot = jnp.clip(pos, 0, capacity - 1)
+    disp = jax.nn.one_hot(slot, capacity, dtype=jnp.float32)       # [T,E,C]
+    return disp * keep[..., None].astype(jnp.float32)
+
+
+def _expert_ffn(buf, w1, b1, w2, b2, act):
+    """buf [E_loc, N, D] through each local expert's two-layer FFN."""
+    h = act(jnp.einsum("end,edh->enh", buf, w1) + b1[:, None, :])
+    return jnp.einsum("enh,ehd->end", h, w2) + b2[:, None, :]
+
+
+def moe_ffn_reference(x: jax.Array, params: MoEParams,
+                      capacity_factor: float = 1.25,
+                      act=jax.nn.gelu):
+    """Single-device dense formulation (and the parity oracle).
+
+    x: [T, D] tokens. Returns (y [T, D], aux_loss scalar). Tokens past an
+    expert's capacity pass through as ZEROS (add the residual outside).
+    """
+    t, d = x.shape
+    e = params.router.shape[1]
+    cap = max(1, int(t / e * capacity_factor))
+    expert, gate, probs = _route(x, params.router)
+    disp = _dispatch_mask(expert, e, cap)                  # [T, E, C]
+    buf = jnp.einsum("tec,td->ecd", disp,
+                     x.astype(jnp.float32))                # [E, C, D]
+    out = _expert_ffn(buf, params.w1, params.b1, params.w2, params.b2,
+                      act)                                  # [E, C, D]
+    y = jnp.einsum("tec,ecd->td", disp, out)               # undispatch
+    y = y * gate[:, None]
+    return y.astype(x.dtype), aux_load_balance_loss(probs, expert)
+
+
+def moe_ffn(mesh, x: jax.Array, params: MoEParams, axis: str = "expert",
+            capacity_factor: float = 1.25, act=jax.nn.gelu):
+    """Expert-parallel MoE FFN: tokens AND experts sharded over ``axis``.
+
+    x: [T, D] global tokens (T divisible by the axis size); expert weights
+    shard on their leading E axis. Dispatch/combine ride two all_to_alls
+    over ICI. Per-(shard, expert) capacity is
+    ceil(T_local / E * capacity_factor) so capacity is enforced per
+    SOURCE shard — the standard Switch sharded formulation (a globally
+    unlucky routing can drop more tokens than the dense oracle; parity
+    tests use uniform-ish routing or generous capacity).
+
+    Returns (y [T, D] in token order, aux_loss scalar).
+    """
+    n = mesh.shape[axis]
+    t, d = x.shape
+    e = params.router.shape[1]
+    enforce_that(t % n == 0, f"tokens {t} not divisible by {axis}={n}",
+                 context="moe")
+    enforce_that(e % n == 0, f"experts {e} not divisible by {axis}={n}",
+                 context="moe")
+    t_loc = t // n
+    cap = max(1, int(t_loc / e * capacity_factor))
+
+    def local(xl, router_w, w1, b1, w2, b2):
+        # xl [T_loc, D]; w1 [E_loc, D, H] (this shard's experts)
+        expert, gate, probs = _route(xl, router_w)
+        disp = _dispatch_mask(expert, e, cap)              # [T_loc, E, C]
+        buf = jnp.einsum("tec,td->ecd", disp,
+                         xl.astype(jnp.float32))           # [E, C, D]
+        # exchange: shard s sends buf rows of shard r's experts to r
+        buf = buf.reshape(n, e // n, cap, d)
+        buf = jax.lax.all_to_all(buf, axis, split_axis=0, concat_axis=0,
+                                 tiled=False)              # [n, E_loc, C, D]
+        # this shard now holds every source shard's buffers for ITS
+        # experts: fold sources into the capacity dimension
+        buf = jnp.swapaxes(buf, 0, 1).reshape(e // n, n * cap, d)
+        out = _expert_ffn(buf, w1, b1, w2, b2, act)        # [E_loc, n*C, D]
+        out = jnp.swapaxes(out.reshape(e // n, n, cap, d), 0, 1)
+        out = jax.lax.all_to_all(out, axis, split_axis=0, concat_axis=0,
+                                 tiled=False)              # [n, E_loc, C, D]
+        out = jnp.swapaxes(out, 0, 1).reshape(e, cap, d)   # [E, C, D]
+        y = jnp.einsum("tec,ecd->td", disp, out) * gate[:, None]
+        # GLOBAL routing statistics (pmean the components, THEN combine —
+        # a mean of per-shard products is not the global aux loss)
+        fraction, mean_prob = _aux_stats(probs, expert)
+        fraction = jax.lax.pmean(fraction, axis)
+        mean_prob = jax.lax.pmean(mean_prob, axis)
+        aux = e * jnp.sum(fraction * mean_prob)
+        return y.astype(xl.dtype), aux
+
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis, None), P(None, None), P(axis, None, None),
+                  P(axis, None), P(axis, None, None), P(axis, None)),
+        out_specs=(P(axis, None), P()),
+        check_vma=False)
+    return fn(x, params.router, params.w1, params.b1, params.w2, params.b2)
