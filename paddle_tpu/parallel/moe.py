@@ -102,9 +102,11 @@ def moe_ffn_reference(x: jax.Array, params: MoEParams,
     x: [T, D] tokens. Returns (y [T, D], aux_loss scalar). Tokens past an
     expert's capacity pass through as ZEROS (add the residual outside).
     """
+    import math
+
     t, d = x.shape
     e = params.router.shape[1]
-    cap = max(1, int(t / e * capacity_factor))
+    cap = max(1, math.ceil(t / e * capacity_factor))
     expert, gate, probs = _route(x, params.router)
     disp = _dispatch_mask(expert, e, cap)                  # [T, E, C]
     buf = jnp.einsum("tec,td->ecd", disp,
@@ -137,8 +139,10 @@ def moe_ffn(mesh, x: jax.Array, params: MoEParams, axis: str = "expert",
                  context="moe")
     enforce_that(e % n == 0, f"experts {e} not divisible by {axis}={n}",
                  context="moe")
+    import math
+
     t_loc = t // n
-    cap = max(1, int(t_loc / e * capacity_factor))
+    cap = max(1, math.ceil(t_loc / e * capacity_factor))
 
     def local(xl, router_w, w1, b1, w2, b2):
         # xl [T_loc, D]; w1 [E_loc, D, H] (this shard's experts)
@@ -156,8 +160,9 @@ def moe_ffn(mesh, x: jax.Array, params: MoEParams, axis: str = "expert",
         out = _expert_ffn(buf, w1, b1, w2, b2, act)        # [E_loc, n*C, D]
         out = jnp.swapaxes(out.reshape(e // n, n, cap, d), 0, 1)
         out = jax.lax.all_to_all(out, axis, split_axis=0, concat_axis=0,
-                                 tiled=False)              # [n, E_loc, C, D]
-        out = jnp.swapaxes(out, 0, 1).reshape(e, cap, d)   # [E, C, D]
+                                 tiled=False)   # [owner_shard, E_loc, C, D]
+        # flat [owner, local] order IS global expert id owner*(E/n)+local
+        out = out.reshape(e, cap, d)                       # [E, C, D]
         y = jnp.einsum("tec,ecd->td", disp, out) * gate[:, None]
         # GLOBAL routing statistics (pmean the components, THEN combine —
         # a mean of per-shard products is not the global aux loss)

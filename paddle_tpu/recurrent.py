@@ -24,7 +24,8 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.platform.enforce import EnforceError, enforce_that
-from paddle_tpu.sequence import SequenceBatch
+from paddle_tpu.sequence import (SequenceBatch, nested_from_padded,
+                                 nested_to_padded)
 from paddle_tpu.topology import (Context, LayerOutput, ParamSpec, Topology,
                                  unique_name)
 
@@ -37,13 +38,11 @@ _MEMORY_STACK: List[List["_Memory"]] = []
 
 class _Memory:
     def __init__(self, node: LayerOutput, link_name: str, size: int,
-                 boot_layer: Optional[LayerOutput], boot_with_const_id=None,
-                 is_seq: bool = False):
+                 boot_layer: Optional[LayerOutput], is_seq: bool = False):
         self.node = node            # placeholder node used inside the step
         self.link_name = link_name  # step layer whose output feeds t+1
         self.size = size
         self.boot_layer = boot_layer
-        self.boot_with_const_id = boot_with_const_id
         self.is_seq = is_seq
 
 
@@ -368,8 +367,6 @@ def recurrent_group(step, input, reverse: bool = False,
         SequenceBatches rebuilt inside the scan from the [B, S, W, ...]
         nested view (reference: RecurrentGradientMachine's nested-level
         forward, test_RecurrentGradientMachine.cpp sequence_nest configs)."""
-        from paddle_tpu.sequence import nested_to_padded
-
         seq_vals: List[SequenceBatch] = ins[:len(seq_inputs)]
         static_vals = ins[len(seq_inputs):len(seq_inputs) + len(static_inputs)]
         boot_vals = ins[len(seq_inputs) + len(static_inputs):]
@@ -448,7 +445,7 @@ def recurrent_group(step, input, reverse: bool = False,
                     pp, pl = prev
                     new_mems[m.node.name] = (
                         jnp.where(m_t[:, None, None], lp, pp),
-                        jnp.where(m_t, jnp.maximum(ll, 1), pl))
+                        jnp.where(m_t, jnp.clip(ll, 1, W), pl))
                 else:
                     val = lo.data if isinstance(lo, SequenceBatch) else lo
                     new_mems[m.node.name] = jnp.where(mm, val, prev)
@@ -485,7 +482,6 @@ def recurrent_group(step, input, reverse: bool = False,
         (_, final_sstate), ys = jax.lax.scan(frame, (init_mems, sub_state0),
                                              xs, reverse=reverse)
         write_group_state(ctx, final_sstate)
-        from paddle_tpu.sequence import nested_from_padded
         results = []
         for o, y in zip(out_list, ys):
             if o.is_sequence:
@@ -495,8 +491,12 @@ def recurrent_group(step, input, reverse: bool = False,
                 yp = jnp.moveaxis(yp, 0, 1)          # [B, S, Wo, ...]
                 ylens = jnp.where(outer_mask,
                                   jnp.swapaxes(ylens, 0, 1), 0)  # [B, S]
+                # capacity must hold the OUTPUT token bound (a step may
+                # emit more tokens than the in-link held)
+                wo = yp.shape[2]
                 results.append(nested_from_padded(
-                    yp, ylens, counts, capacity=first.capacity))
+                    yp, jnp.clip(ylens, 0, wo), counts,
+                    capacity=max(first.capacity, B * S * wo)))
             else:
                 # one row per INNER sequence -> flat sequence whose
                 # lengths are the inner-sequence counts

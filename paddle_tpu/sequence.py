@@ -113,6 +113,10 @@ class SequenceBatch:
         lengths = np.asarray([a.shape[0] for a in arrs], dtype=np.int32)
         total = int(lengths.sum())
         cap = capacity if capacity is not None else total
+        from paddle_tpu.platform.enforce import enforce_that
+        enforce_that(cap >= total,
+                     f"from_list capacity {cap} < total tokens {total}",
+                     context="sequence")
         feat = arrs[0].shape[1:] if arrs else ()
         data = np.zeros((cap,) + feat, dtype=np.dtype(jnp.dtype(dtype)))
         seg = np.full((cap,), len(arrs), dtype=np.int32)

@@ -49,6 +49,32 @@ def test_capacity_drops_pass_through_as_zero(params, tokens):
                                np.asarray(y_full)[kept], rtol=1e-5)
 
 
+def test_sharded_matches_oracle_multiple_experts_per_shard(tokens):
+    """E=16 on 8 shards (two experts per shard): the combine path must
+    keep the [owner, local] -> global expert order straight."""
+    p16 = init_moe_params(jax.random.PRNGKey(4), D, H, 16, scale=0.5)
+    mesh = make_mesh((8,), ("expert",))
+    y_ref, _ = moe_ffn_reference(tokens, p16, capacity_factor=16.0)
+    y_ep, _ = jax.jit(lambda x, p: moe_ffn(mesh, x, p,
+                                           capacity_factor=16.0))(
+        tokens, p16)
+    np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_ref),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_capacity_is_ceil():
+    """docstring promise: ceil(T/E * factor), not floor: 10 tokens over 8
+    experts at factor 1.25 -> cap ceil(1.5625)=2; deterministic routing
+    puts 2 tokens on experts 0/1, so NOTHING drops (floor cap 1 would
+    drop two tokens)."""
+    p = init_moe_params(jax.random.PRNGKey(0), D, H, 8, scale=0.5)
+    p = p._replace(router=jnp.eye(D, 8) * 10.0)
+    x = jnp.eye(8, D)[jnp.arange(10) % 8] * 5.0   # token i -> expert i%8
+    y, _ = moe_ffn_reference(x, p, capacity_factor=1.25)
+    dropped = int((np.abs(np.asarray(y)).sum(-1) == 0).sum())
+    assert dropped == 0
+
+
 def test_gradients_flow_through_all_to_all(params, tokens):
     mesh = make_mesh((8,), ("expert",))
 
