@@ -2066,6 +2066,72 @@ def smooth_l1_cost(input, label, name: Optional[str] = None) -> LayerOutput:
 
 
 @_export
+def moe_ffn(input, num_experts: int, expert_hidden: int,
+            capacity_factor: float = 1.25, aux_weight: float = 0.01,
+            name: Optional[str] = None, param_attr=None):
+    """Mixture-of-Experts FFN layer (new-build extension; parallel/moe.py
+    holds the kernels): Switch-style top-1 routing into per-expert
+    two-layer FFNs. Returns ``(out, aux_cost)`` — add ``aux_cost`` to the
+    SGD cost list (multi-cost training, the MultiNetwork path) so routing
+    stays load-balanced; its value is ``aux_weight *`` the Switch
+    balance loss.
+
+    Under a mesh with an ``'expert'`` axis the experts shard and dispatch
+    rides two all_to_alls (parallel.moe.moe_ffn); otherwise the dense
+    single-device formulation runs. Over-capacity tokens pass through as
+    zeros (callers add the residual). On packed SequenceBatch inputs the
+    padding slots also route (they waste a little capacity; their outputs
+    are zeroed)."""
+    from paddle_tpu.parallel import moe as pmoe
+
+    inp = input
+    name = name or unique_name("moe_ffn")
+    attr = ParamAttr.to_attr(param_attr)
+    d = inp.size
+    params = {
+        "router": ParamSpec((d, num_experts), attr),
+        "w1": ParamSpec((num_experts, d, expert_hidden), attr),
+        "b1": ParamSpec((num_experts, expert_hidden), ParamAttr.to_attr(None)),
+        "w2": ParamSpec((num_experts, expert_hidden, d), attr),
+        "b2": ParamSpec((num_experts, d), ParamAttr.to_attr(None)),
+    }
+
+    def compute(ctx, p, ins):
+        v = ins[0]
+        x = _data_of(v)
+        mp = pmoe.MoEParams(p["router"], p["w1"], p["b1"], p["w2"], p["b2"])
+        mesh = ctx.mesh
+        if mesh is not None and "expert" in tuple(
+                getattr(mesh, "axis_names", ())):
+            y, aux = pmoe.moe_ffn(mesh, x, mp,
+                                  capacity_factor=capacity_factor)
+        else:
+            y, aux = pmoe.moe_ffn_reference(
+                x, mp, capacity_factor=capacity_factor)
+        if isinstance(v, SequenceBatch):
+            y = jnp.where(v.valid_mask[:, None], y, 0)
+        out = _like(v, y.astype(pmath.dense_activation_dtype()))
+        return (out, aux * aux_weight)
+
+    core = LayerOutput(name=name, layer_type="moe_ffn", inputs=[inp],
+                       fn=compute, params=params, size=d,
+                       is_sequence=inp.is_sequence)
+
+    def pick_out(ctx, p, ins):
+        return ins[0][0]
+
+    def pick_aux(ctx, p, ins):
+        return jnp.reshape(ins[0][1], (1,))
+
+    out_node = LayerOutput(name=f"{name}_out", layer_type="moe_out",
+                           inputs=[core], fn=pick_out, size=d,
+                           is_sequence=inp.is_sequence)
+    aux_node = LayerOutput(name=f"{name}_aux", layer_type="moe_aux",
+                           inputs=[core], fn=pick_aux, size=1, is_cost=True)
+    return out_node, aux_node
+
+
+@_export
 def lm_head_cost(input, label, vocab_size: int, name: Optional[str] = None,
                  param_attr=None, bias_attr=True,
                  block_size: int = 4096) -> LayerOutput:

@@ -25,7 +25,8 @@ from paddle_tpu import layer
 
 
 def block(x, *, n_heads: int, ffn_mult: int = 4, name: str,
-          dropout: float = 0.0, causal: bool = True, memory=None):
+          dropout: float = 0.0, causal: bool = True, memory=None,
+          moe_experts: int = 0):
     """One pre-LN transformer block: x + drop(MHA(LN(x))) [+ x +
     cross-MHA(LN(x), memory) when ``memory`` is given]; x + drop(FFN(LN(x))).
 
@@ -49,17 +50,25 @@ def block(x, *, n_heads: int, ffn_mult: int = 4, name: str,
         x = layer.addto(input=[x, c], name=f"{name}_res{idx}")
     idx += 1
     f = layer.layer_norm(x, name=f"{name}_ln{idx}")
-    f = layer.fc(input=f, size=x.size * ffn_mult, act="gelu",
-                 name=f"{name}_ffn_up")
-    f = layer.fc(input=f, size=x.size, name=f"{name}_ffn_down")
+    aux = None
+    if moe_experts > 0:
+        f, aux = layer.moe_ffn(f, num_experts=moe_experts,
+                               expert_hidden=x.size * ffn_mult,
+                               name=f"{name}_moe")
+    else:
+        f = layer.fc(input=f, size=x.size * ffn_mult, act="gelu",
+                     name=f"{name}_ffn_up")
+        f = layer.fc(input=f, size=x.size, name=f"{name}_ffn_down")
     if dropout > 0.0:
         f = layer.dropout(f, dropout, name=f"{name}_ffn_drop")
-    return layer.addto(input=[x, f], name=f"{name}_res{idx}")
+    out = layer.addto(input=[x, f], name=f"{name}_res{idx}")
+    return (out, aux) if moe_experts > 0 else out
 
 
 def build(vocab_size: int = 32768, d_model: int = 512, n_layers: int = 6,
           n_heads: int = 8, max_len: int = 1024, ffn_mult: int = 4,
-          dropout: float = 0.0, fused_head: bool = False):
+          dropout: float = 0.0, fused_head: bool = False,
+          moe_experts: int = 0):
     """Returns (tokens, positions, target, logits, cost).
 
     Feeds: ``tokens`` / ``target`` are integer sequences (next-token
@@ -83,9 +92,16 @@ def build(vocab_size: int = 32768, d_model: int = 512, n_layers: int = 6,
     tok_emb = layer.embedding(input=tokens, size=d_model, name="tok_embed")
     pos_emb = layer.embedding(input=pos, size=d_model, name="pos_embed")
     x = layer.addto(input=[tok_emb, pos_emb], name="embed_sum")
+    aux_nodes = []
     for i in range(n_layers):
-        x = block(x, n_heads=n_heads, ffn_mult=ffn_mult, name=f"blk{i}",
-                  dropout=dropout)
+        if moe_experts > 0:
+            x, aux = block(x, n_heads=n_heads, ffn_mult=ffn_mult,
+                           name=f"blk{i}", dropout=dropout,
+                           moe_experts=moe_experts)
+            aux_nodes.append(aux)
+        else:
+            x = block(x, n_heads=n_heads, ffn_mult=ffn_mult,
+                      name=f"blk{i}", dropout=dropout)
     x = layer.layer_norm(x, name="final_ln")
     logits = layer.fc(input=x, size=vocab_size, name="lm_head")
     if fused_head:
@@ -98,6 +114,10 @@ def build(vocab_size: int = 32768, d_model: int = 512, n_layers: int = 6,
                                   name="lm_head_fused")
     else:
         cost = layer.classification_cost(input=logits, label=target)
+    if moe_experts > 0:
+        # multi-cost training: xent + per-block load-balance aux losses
+        # (pass the LIST to SGD(cost=...), the MultiNetwork path)
+        cost = [cost] + aux_nodes
     return tokens, pos, target, logits, cost
 
 

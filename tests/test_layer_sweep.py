@@ -1040,6 +1040,17 @@ def _smooth_l1():
                      check_inputs=["x"])
 
 
+@case("moe_ffn")
+def _moe_ffn_layer():
+    x, fx = dense("x", 6)
+    out, aux = layer.moe_ffn(x, num_experts=4, expert_hidden=8,
+                             capacity_factor=8.0)
+    check_layer_grad(out, {"x": fx}, delta=5e-3, rtol=8e-2)
+    got_aux, _ = forward(aux, {"x": fx})
+    assert np.asarray(got_aux).shape == (1,)
+    assert np.isfinite(np.asarray(got_aux)).all()
+
+
 @case("lm_head_cost")
 def _lm_head_cost():
     x, fx = dense("x", 6)

@@ -118,3 +118,39 @@ def test_moe_trains_toward_balanced_experts(params):
         g = jax.jit(jax.grad(loss))(p2)
         p2 = jax.tree.map(lambda a, b: a - 0.5 * b, p2, g)
     assert float(loss(p2)) <= float(loss(p)) + 1e-6
+
+
+def test_moe_transformer_trains():
+    """transformer.build(moe_experts=4): multi-cost training (xent + aux)
+    converges on tiny shapes; aux stays finite and bounded."""
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer, trainer
+    from paddle_tpu.models import transformer
+
+    vocab, d = 61, 16
+    paddle.topology.reset_name_scope()
+    tokens, pos, target, logits, costs = transformer.build(
+        vocab_size=vocab, d_model=d, n_layers=2, n_heads=2, max_len=32,
+        moe_experts=4)
+    assert isinstance(costs, list) and len(costs) == 3  # xent + 2 aux
+    params = paddle.Parameters.from_topology(
+        paddle.topology.Topology(costs), seed=0)
+    sgd = trainer.SGD(cost=costs, parameters=params,
+                      update_equation=optimizer.Adam(learning_rate=1e-2))
+    step = sgd._build_step()
+    rng = np.random.RandomState(0)
+    samples = []
+    for _ in range(4):
+        t = rng.randint(0, vocab, size=12)
+        samples.append((t.tolist(), list(range(12)),
+                        np.roll(t, -1).tolist()))
+    feeds = sgd._make_feeder(
+        {"tokens": 0, "pos": 1, "target": 2}).feed(samples)
+    p, o, m = sgd.parameters.as_dict(), sgd.opt_state, sgd.model_state
+    key = jax.random.PRNGKey(0)
+    losses = []
+    for _ in range(25):
+        loss, p, o, m, _ = step(p, o, m, key, feeds)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
