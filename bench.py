@@ -562,6 +562,49 @@ def worker_scaling():
         }}))
 
 
+def worker_moe():
+    """MoE transformer LM (manual/capture-only worker — NOT in the main
+    bench loop): single-chip Switch-style MoE with the dense dispatch
+    formulation; tokens/sec + step time. EP across chips needs the mesh
+    the driver doesn't have."""
+    import jax
+    import numpy as np
+
+    paddle = _init_paddle()
+    from paddle_tpu.models import transformer
+
+    rng = np.random.RandomState(0)
+    d, layers, heads, seq, bs, vocab, experts = 1024, 8, 16, 1024, 4,         32768, 8
+    paddle.topology.reset_name_scope()
+    tokens, pos, target, logits, costs = transformer.build(
+        vocab_size=vocab, d_model=d, n_layers=layers, n_heads=heads,
+        max_len=seq, moe_experts=experts)
+    topo = paddle.topology.Topology(costs)
+    params = paddle.Parameters.from_topology(topo, seed=0)
+    sgd = _make_sgd(costs, params)
+    samples = []
+    for _ in range(bs):
+        t = rng.randint(0, vocab, size=seq)
+        samples.append((t.tolist(), list(range(seq)),
+                        np.roll(t, -1).tolist()))
+    feeds = sgd._make_feeder({"tokens": 0, "pos": 1, "target": 2}).feed(
+        samples)
+    step = sgd._build_step()
+    args = _step_args(sgd, feeds)
+    step, flops = _aot_compile(step, args)
+    sec = _time_steps(step, args, iters=6)
+    out = {
+        "moe_tokens_per_sec": round(bs * seq / sec, 1),
+        "moe_ms_per_batch": round(sec * 1000, 2),
+        "moe_config": f"d{d} L{layers} E{experts} seq{seq} bs{bs}",
+    }
+    if flops:
+        kind = jax.devices()[0].device_kind
+        out["moe_achieved_tflops"] = round(flops / sec / 1e12, 2)
+        out["moe_mfu"] = round(flops / sec / _peak_for(kind), 4)
+    print(json.dumps(out))
+
+
 def worker_probe():
     """Fast TPU liveness check: init + one tiny matmul."""
     import jax
@@ -582,6 +625,7 @@ WORKERS = {
     "transformer": worker_transformer,
     "attention": worker_attention,
     "scaling": worker_scaling,
+    "moe": worker_moe,     # manual/capture-only (not in the main loop)
 }
 
 

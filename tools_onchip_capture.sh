@@ -11,7 +11,7 @@ date >> "$OUT"
 if ! timeout 120 python bench.py --worker probe >> "$OUT" 2>/tmp/onchip_err.txt; then
   echo "probe failed -- relay still down" | tee -a "$OUT"; exit 1
 fi
-for w in transformer resnet50 lstm convnets alexnet attention; do
+for w in transformer resnet50 lstm convnets alexnet attention moe; do
   echo "== $w ==" >> "$OUT"
   timeout 600 python bench.py --worker "$w" >> "$OUT" 2>>/tmp/onchip_err.txt
   echo "rc=$? for $w" >> "$OUT"
