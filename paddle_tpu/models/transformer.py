@@ -341,6 +341,39 @@ def beam_generate(params, prompt_ids, max_new_tokens: int, *, n_layers: int,
     return np.asarray(toks), float(score)
 
 
+def beam_generate_batch(params, prompts, max_new_tokens: int, *,
+                        n_layers: int, n_heads: int, beam_size: int = 4,
+                        max_len: int = 1024, eos_id: int = -1,
+                        length_penalty: float = 0.0,
+                        candidate_adjust=None, path_filter=None,
+                        stop_condition=None):
+    """Beam-decode a BATCH of equal-length prompts in one compiled call
+    (vmap over the single-prompt beam scan — weights broadcast, caches and
+    beams batch). Returns (tokens [N, max_new] int32, scores [N]).
+
+    Prompts must share a length (bucket them host-side; the compiled
+    program is shaped by (n_prompt, max_new))."""
+    import jax
+
+    prompts = [list(pr) for pr in prompts]
+    n_prompt = len(prompts[0])
+    if not all(len(pr) == n_prompt for pr in prompts):
+        raise ValueError("beam_generate_batch needs equal-length prompts "
+                         "(bucket them host-side)")
+    p, _, n_prompt, total = _prep_decode(
+        params, prompts[0], max_new_tokens, max_len, "beam_generate")
+    if max_new_tokens == 0:
+        return (np.zeros((len(prompts), 0), np.int32),
+                np.zeros((len(prompts),), np.float32))
+    run = _beam_fn(n_layers, n_heads, max_len, n_prompt, total,
+                   int(beam_size), int(eos_id), float(length_penalty),
+                   candidate_adjust, path_filter, stop_condition)
+    import jax.numpy as jnp
+    batch = jnp.asarray(np.asarray(prompts, np.int32))
+    toks, scores = jax.jit(jax.vmap(run, in_axes=(None, 0)))(p, batch)
+    return np.asarray(toks), np.asarray(scores)
+
+
 @functools.lru_cache(maxsize=32)
 def _beam_fn(n_layers, n_heads, max_len, n_prompt, total, beam_size, eos_id,
              length_penalty, candidate_adjust=None, path_filter=None,

@@ -453,3 +453,25 @@ def test_fused_head_trains_on_mesh8_zero(rng):
     assert losses[-1] < losses[0]
     w = p["lm_head.w0"]
     assert w.addressable_shards[0].data.size < w.size
+
+
+def test_beam_generate_batch_matches_individual(rng):
+    """Batched beam decode (one compiled vmap) equals per-prompt runs."""
+    vocab, d = 43, 16
+    paddle.topology.reset_name_scope()
+    tokens, pos, target, logits, cost = transformer.build(
+        vocab_size=vocab, d_model=d, n_layers=1, n_heads=2, max_len=32)
+    params = {k: np.asarray(v) for k, v in paddle.Parameters.from_topology(
+        paddle.topology.Topology([cost]), seed=11).as_dict().items()}
+    prompts = [[3, 5, 7], [9, 2, 4], [1, 1, 8]]
+    kw = dict(n_layers=1, n_heads=2, max_len=32, beam_size=3, eos_id=0)
+    bt, bs = transformer.beam_generate_batch(params, prompts, 5, **kw)
+    assert bt.shape == (3, 5)
+    for i, p in enumerate(prompts):
+        ti, si = transformer.beam_generate(params, p, 5, **kw)
+        np.testing.assert_array_equal(bt[i], ti)
+        assert abs(float(bs[i]) - si) < 1e-5
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        transformer.beam_generate_batch(params, [[1, 2], [1, 2, 3]], 4,
+                                        **kw)
