@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Fold /tmp/onchip_results.jsonl (tools_onchip_capture.sh output) into
+LAST_ONCHIP.json with provenance. Run after a successful capture:
+
+    python tools_update_onchip.py [results_path]
+
+Keeps only recognized measurement fields (the bench workers' headline
+keys), stamps the capture date and git commit, and overwrites
+LAST_ONCHIP.json — the provenance-marked fallback bench.py surfaces when
+the relay is down at bench time.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+KEEP_PREFIXES = (
+    "transformer_", "resnet50_", "lstm_", "googlenet_", "smallnet_",
+    "alexnet_", "attention_", "moe_", "batch", "device_kind",
+    "peak_tflops_assumed", "flops_source",
+)
+
+
+def main() -> int:
+    path = sys.argv[1] if len(sys.argv) > 1 else "/tmp/onchip_results.jsonl"
+    if not os.path.exists(path):
+        print(f"no capture file at {path}", file=sys.stderr)
+        return 1
+    merged = {}
+    for line in open(path):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        for k, v in rec.items():
+            if any(k.startswith(p) for p in KEEP_PREFIXES):
+                merged[k] = v
+    if not merged:
+        print("no measurement fields found — not touching LAST_ONCHIP.json",
+              file=sys.stderr)
+        return 1
+    commit = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                            capture_output=True, text=True,
+                            cwd=os.path.dirname(os.path.abspath(__file__))
+                            ).stdout.strip()
+    out = {
+        "note": "Numbers measured on the real TPU chip; surfaced by "
+                "bench.py ONLY when the relay is unreachable at bench "
+                "time, and NOT from that run. Update or delete when "
+                "re-measured.",
+        "measured_on": time.strftime("%Y-%m-%d"),
+        "code_state": f"commit {commit}",
+        **merged,
+    }
+    dst = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "LAST_ONCHIP.json")
+    with open(dst, "w") as f:
+        json.dump(out, f, indent=2)
+    print(f"wrote {dst} with {len(merged)} fields from {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
