@@ -329,11 +329,11 @@ def worker_transformer():
     peak = _peak_for(kind)
 
     def measure(d, layers, heads, seq, bs, vocab=32768, iters=6,
-                fused_head=False):
+                fused_head=False, remat=False):
         paddle.topology.reset_name_scope()
         tokens, pos, target, logits, cost = transformer.build(
             vocab_size=vocab, d_model=d, n_layers=layers, n_heads=heads,
-            max_len=seq, fused_head=fused_head)
+            max_len=seq, fused_head=fused_head, remat=remat)
         topo = paddle.topology.Topology([cost])
         params = paddle.Parameters.from_topology(topo, seed=0)
         sgd = _make_sgd(cost, params)
@@ -352,7 +352,8 @@ def worker_transformer():
             "transformer_tokens_per_sec": round(bs * seq / sec, 1),
             "transformer_ms_per_batch": round(sec * 1000, 2),
             "transformer_config": f"d{d} L{layers} h{heads} seq{seq} "
-                                  f"bs{bs} vocab{vocab}",
+                                  f"bs{bs} vocab{vocab}"
+                                  + (" remat" if remat else ""),
         }
         if flops:
             out["transformer_mfu"] = round(flops / sec / peak, 4)
@@ -368,10 +369,16 @@ def worker_transformer():
     d_used = 2048
     out = None
     bs_used = 4
-    for d_try, bs_try in ((2048, 8), (2048, 4), (1024, 4)):
+    remat_used = False
+    # bs=8 plain first (highest MFU if it fits), then bs=8 with per-block
+    # remat (trades ~1 extra forward of FLOPs for the ~4GB of saved
+    # activations — the tier that used to OOM into bs=4), then smaller
+    for d_try, bs_try, remat_try in ((2048, 8, False), (2048, 8, True),
+                                     (2048, 4, False), (1024, 4, False)):
         try:
-            out = measure(d=d_try, layers=8, heads=16, seq=1024, bs=bs_try)
-            d_used, bs_used = d_try, bs_try
+            out = measure(d=d_try, layers=8, heads=16, seq=1024, bs=bs_try,
+                          remat=remat_try)
+            d_used, bs_used, remat_used = d_try, bs_try, remat_try
             if fallback_reason:
                 out["transformer_fallback_reason"] = fallback_reason
             break
@@ -388,7 +395,7 @@ def worker_transformer():
     try:  # fused blockwise LM-head xent (layer.lm_head_cost): logits
         # never reach HBM; candidate replacement headline if faster
         fh = measure(d=d_used, layers=8, heads=16, seq=1024, bs=bs_used,
-                     fused_head=True)
+                     fused_head=True, remat=remat_used)
         out["transformer_fused_head_tokens_per_sec"] = \
             fh["transformer_tokens_per_sec"]
         if "transformer_mfu" in fh:
@@ -400,14 +407,29 @@ def worker_transformer():
         from paddle_tpu.platform.flags import FLAGS
 
         FLAGS.bf16_dense_activations = True
-        bf = measure(d=d_used, layers=8, heads=16, seq=1024,
-                     bs=bs_used)
+        try:
+            bf = measure(d=d_used, layers=8, heads=16, seq=1024,
+                         bs=bs_used, remat=remat_used)
+        finally:
+            FLAGS.bf16_dense_activations = False
         out["transformer_bf16_resid_tokens_per_sec"] = \
             bf["transformer_tokens_per_sec"]
         if "transformer_mfu" in bf:
             out["transformer_bf16_resid_mfu"] = bf["transformer_mfu"]
     except Exception as e:
         out["transformer_bf16_resid_error"] = repr(e)
+    print(json.dumps(out), flush=True)
+    try:  # long-context tier: seq=2048 only fits with per-block remat
+        # (saved activations scale with tokens; checkpoint caps them at
+        # one block's boundary per layer)
+        lc = measure(d=d_used, layers=8, heads=16, seq=2048,
+                     bs=max(bs_used // 2, 2), remat=True, iters=4)
+        out["transformer_seq2048_remat_tokens_per_sec"] = \
+            lc["transformer_tokens_per_sec"]
+        if "transformer_mfu" in lc:
+            out["transformer_seq2048_remat_mfu"] = lc["transformer_mfu"]
+    except Exception as e:
+        out["transformer_seq2048_remat_error"] = repr(e)
     print(json.dumps(out))
 
 
@@ -563,10 +585,13 @@ def worker_scaling():
 
 
 def worker_moe():
-    """MoE transformer LM (manual/capture-only worker — NOT in the main
-    bench loop): single-chip Switch-style MoE with the dense dispatch
-    formulation; tokens/sec + step time. EP across chips needs the mesh
-    the driver doesn't have."""
+    """MoE transformer LM vs its dense twin on one chip: single-chip
+    Switch-style MoE (top-1 routing, dense dispatch formulation) at the
+    same d_model/L/seq as a dense FFN model — the active FLOPs per token
+    match, so moe_vs_dense_tokens_ratio isolates the routing +
+    dispatch/combine overhead (the single-chip analog of the EP
+    all_to_all cost; cross-chip EP needs the mesh the driver doesn't
+    have)."""
     import jax
     import numpy as np
 
@@ -574,25 +599,32 @@ def worker_moe():
     from paddle_tpu.models import transformer
 
     rng = np.random.RandomState(0)
-    d, layers, heads, seq, bs, vocab, experts = 1024, 8, 16, 1024, 4,         32768, 8
-    paddle.topology.reset_name_scope()
-    tokens, pos, target, logits, costs = transformer.build(
-        vocab_size=vocab, d_model=d, n_layers=layers, n_heads=heads,
-        max_len=seq, moe_experts=experts)
-    topo = paddle.topology.Topology(costs)
-    params = paddle.Parameters.from_topology(topo, seed=0)
-    sgd = _make_sgd(costs, params)
+    d, layers, heads, seq, bs, vocab, experts = (1024, 8, 16, 1024, 4,
+                                                 32768, 8)
     samples = []
     for _ in range(bs):
         t = rng.randint(0, vocab, size=seq)
         samples.append((t.tolist(), list(range(seq)),
                         np.roll(t, -1).tolist()))
-    feeds = sgd._make_feeder({"tokens": 0, "pos": 1, "target": 2}).feed(
-        samples)
-    step = sgd._build_step()
-    args = _step_args(sgd, feeds)
-    step, flops = _aot_compile(step, args)
-    sec = _time_steps(step, args, iters=6)
+
+    def measure(n_experts):
+        paddle.topology.reset_name_scope()
+        tokens, pos, target, logits, costs = transformer.build(
+            vocab_size=vocab, d_model=d, n_layers=layers, n_heads=heads,
+            max_len=seq, moe_experts=n_experts)
+        topo = paddle.topology.Topology(
+            costs if isinstance(costs, list) else [costs])
+        params = paddle.Parameters.from_topology(topo, seed=0)
+        sgd = _make_sgd(costs, params)
+        feeds = sgd._make_feeder({"tokens": 0, "pos": 1, "target": 2}).feed(
+            samples)
+        step = sgd._build_step()
+        args = _step_args(sgd, feeds)
+        step, flops = _aot_compile(step, args)
+        sec = _time_steps(step, args, iters=6)
+        return sec, flops
+
+    sec, flops = measure(experts)
     out = {
         "moe_tokens_per_sec": round(bs * seq / sec, 1),
         "moe_ms_per_batch": round(sec * 1000, 2),
@@ -602,6 +634,15 @@ def worker_moe():
         kind = jax.devices()[0].device_kind
         out["moe_achieved_tflops"] = round(flops / sec / 1e12, 2)
         out["moe_mfu"] = round(flops / sec / _peak_for(kind), 4)
+    print(json.dumps(out), flush=True)  # headline before the dense twin
+    try:
+        dense_sec, _ = measure(0)
+        out["moe_dense_twin_tokens_per_sec"] = round(bs * seq / dense_sec, 1)
+        # > 1.0 means the MoE model moves FEWER tokens/sec than its dense
+        # twin; the excess is routing + dispatch/combine overhead
+        out["moe_vs_dense_step_ratio"] = round(sec / dense_sec, 3)
+    except Exception as e:
+        out["moe_dense_twin_error"] = repr(e)
     print(json.dumps(out))
 
 
@@ -625,7 +666,7 @@ WORKERS = {
     "transformer": worker_transformer,
     "attention": worker_attention,
     "scaling": worker_scaling,
-    "moe": worker_moe,     # manual/capture-only (not in the main loop)
+    "moe": worker_moe,
 }
 
 
@@ -726,7 +767,7 @@ def main():
         # and the relay can flap: measure it first, then the other
         # headline families, diagnostics last
         for name in ("transformer", "resnet50", "lstm", "convnets",
-                     "alexnet", "attention"):
+                     "alexnet", "attention", "moe"):
             out, err = _run_worker(name, deadline)
             if out:
                 record.update(out)

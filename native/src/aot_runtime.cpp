@@ -13,6 +13,7 @@
 //
 // Opcodes must stay in sync with export.py (OP_* constants).
 
+#include <atomic>
 #include <cmath>
 #include <cstdint>
 #include <cstdio>
@@ -57,6 +58,9 @@ struct Program {
   std::vector<uint32_t> outputs;
   std::vector<std::pair<uint32_t, std::vector<float>>> consts;
   std::vector<Instr> ops;
+  // shared-param instances (ptpu_aot_create_shared) hold the same Program;
+  // the last release frees it
+  std::atomic<int> refs{1};
 };
 
 bool read_exact(FILE* f, void* dst, size_t n) {
@@ -67,6 +71,20 @@ template <typename T>
 bool rd(FILE* f, T* v) { return read_exact(f, v, sizeof(T)); }
 
 constexpr int kMaxRank = 8;
+
+// every dim positive and bounded; total element count bounded (the
+// executor sizes buffers and memcpys from these — a model file from disk
+// must never drive an over/under-flowed allocation)
+bool sane_dims(const TensorMeta& t) {
+  if (t.dims.size() > kMaxRank) return false;
+  int64_t n = 1;
+  for (int64_t d : t.dims) {
+    if (d <= 0 || d > (int64_t(1) << 32)) return false;
+    if (d > (int64_t(1) << 33) / n) return false;  // pre-divide: no overflow
+    n *= d;
+  }
+  return true;
+}
 
 bool validate_program(const Program& p);
 
@@ -92,6 +110,10 @@ Program* load_program(const char* path) {
     t.dims.resize(nd);
     if (nd && !read_exact(f, t.dims.data(), nd * sizeof(int64_t))) return die();
   }
+  // dims must be sane BEFORE const buffers are sized from size(): a
+  // negative or huge dim from disk would otherwise drive the allocation
+  for (const auto& t : p->tensors)
+    if (!sane_dims(t)) return die();
 
   uint32_t ni = 0;
   if (!rd(f, &ni)) return die();
@@ -151,88 +173,193 @@ Program* load_program(const char* path) {
   return p;
 }
 
+// right-aligned numpy broadcast: each input dim must be 1 or equal the
+// out dim, and the out dim must be exactly the broadcast of the two
+bool bcast_ok(const TensorMeta& ma, const TensorMeta& mb,
+              const TensorMeta& mo) {
+  int rank = static_cast<int>(mo.dims.size());
+  if (static_cast<int>(ma.dims.size()) > rank ||
+      static_cast<int>(mb.dims.size()) > rank)
+    return false;
+  for (int i = 0; i < rank; ++i) {
+    int ia = i - (rank - static_cast<int>(ma.dims.size()));
+    int ib = i - (rank - static_cast<int>(mb.dims.size()));
+    int64_t da = ia >= 0 ? ma.dims[ia] : 1;
+    int64_t db = ib >= 0 ? mb.dims[ib] : 1;
+    if (da != 1 && da != mo.dims[i]) return false;
+    if (db != 1 && db != mo.dims[i]) return false;
+    if (mo.dims[i] != (da != 1 ? da : db) && !(da == 1 && db == 1))
+      return false;
+    if (da == 1 && db == 1 && mo.dims[i] != 1) return false;
+  }
+  return true;
+}
+
 // Reject malformed/corrupt programs BEFORE execution: every tensor id in
 // bounds, ranks within the executor's fixed-size index arrays, per-opcode
-// arity and attr counts — a model file from disk must never be able to
-// drive out-of-bounds indexing.
+// arity/attr counts, per-op SHAPE consistency (element counts, matmul
+// dims, concat sums, gather widths, broadcast compatibility), and
+// def-before-use of every op input — a model file from disk must never be
+// able to drive out-of-bounds indexing, a null ptr[] deref, or an
+// overflowed memcpy (ADVICE r4).
 bool validate_program(const Program& p) {
   const size_t nt = p.tensors.size();
   for (const auto& t : p.tensors)
-    if (t.dims.size() > kMaxRank) return false;
-  for (const auto& in : p.inputs)
+    if (!sane_dims(t)) return false;
+  std::vector<char> is_const(nt, 0), defined(nt, 0);
+  for (const auto& c : p.consts) {
+    if (c.first >= nt) return false;
+    is_const[c.first] = 1;
+    defined[c.first] = 1;
+  }
+  for (const auto& in : p.inputs) {
     if (in.first >= nt) return false;
-  for (uint32_t o : p.outputs)
-    if (o >= nt) return false;
+    defined[in.first] = 1;
+  }
   for (const auto& op : p.ops) {
     if (op.out >= nt) return false;
+    // an op may not clobber a weight const or a program input
+    if (is_const[op.out]) return false;
+    for (const auto& in : p.inputs)
+      if (in.first == op.out) return false;
     for (uint32_t i : op.ins)
-      if (i >= nt) return false;
+      if (i >= nt || !defined[i]) return false;  // def-before-use
     size_t nin = op.ins.size(), na = op.attrs.size();
-    int out_rank = static_cast<int>(p.tensors[op.out].dims.size());
+    const TensorMeta& mo = p.tensors[op.out];
+    int out_rank = static_cast<int>(mo.dims.size());
+    const TensorMeta* m0 = nin ? &p.tensors[op.ins[0]] : nullptr;
     switch (op.opcode) {
       case ADD: case SUB: case MUL: case DIV: case MAX_: case MIN_:
       case LT: case LE: case GT: case GE: case EQ: case NE:
-      case DOT:
         if (nin != 2) return false;
+        if (!bcast_ok(*m0, p.tensors[op.ins[1]], mo)) return false;
         break;
+      case DOT: {
+        if (nin != 2) return false;
+        const TensorMeta& m1 = p.tensors[op.ins[1]];
+        if (m0->dims.size() != 2 || m1.dims.size() != 2 || out_rank != 2)
+          return false;
+        if (m0->dims[1] != m1.dims[0] || mo.dims[0] != m0->dims[0] ||
+            mo.dims[1] != m1.dims[1])
+          return false;
+        break;
+      }
       case EXP: case LOG: case TANH: case LOGISTIC: case RSQRT:
       case SQRT: case NEG: case ABS: case RESHAPE: case IDENT:
       case TRUNC:
         if (nin != 1) return false;
+        if (m0->size() != mo.size()) return false;  // elementwise/memcpy
         break;
-      case GATHER_ROWS:
+      case GATHER_ROWS: {
         if (nin != 2) return false;
-        if (p.tensors[op.ins[0]].dims.size() != 2 || out_rank != 2)
-          return false;
+        const TensorMeta& mi = p.tensors[op.ins[1]];
+        if (m0->dims.size() != 2 || out_rank != 2) return false;
+        // out rows read idx[0..n), write rows of width table.dims[1]
+        if (mo.dims[1] != m0->dims[1]) return false;
+        if (mi.size() < mo.dims[0]) return false;
         break;
+      }
       case IPOW:
         if (nin != 1 || na != 1) return false;
+        if (m0->size() != mo.size()) return false;
         break;
-      case BCAST:
-        if (nin != 1 ||
-            na != p.tensors[op.ins[0]].dims.size())
-          return false;
-        for (int64_t d : op.attrs)
-          if (d < 0 || d >= out_rank) return false;
+      case BCAST: {
+        if (nin != 1 || na != m0->dims.size()) return false;
+        std::vector<char> used(out_rank ? out_rank : 1, 0);
+        for (size_t i = 0; i < na; ++i) {
+          int64_t d = op.attrs[i];
+          if (d < 0 || d >= out_rank || used[d]) return false;  // injective
+          used[d] = 1;
+          // mapped dims must match or broadcast from 1
+          if (m0->dims[i] != 1 && m0->dims[i] != mo.dims[d]) return false;
+        }
         break;
+      }
       case TRANSPOSE: {
         if (nin != 1) return false;
-        int in_rank = static_cast<int>(p.tensors[op.ins[0]].dims.size());
-        if (static_cast<int>(na) != in_rank) return false;
-        for (int64_t d : op.attrs)
-          if (d < 0 || d >= in_rank) return false;
+        int in_rank = static_cast<int>(m0->dims.size());
+        if (static_cast<int>(na) != in_rank || out_rank != in_rank)
+          return false;
+        std::vector<char> seen(in_rank, 0);
+        for (int i = 0; i < in_rank; ++i) {
+          int64_t d = op.attrs[i];
+          if (d < 0 || d >= in_rank || seen[d]) return false;  // permutation
+          seen[d] = 1;
+          if (mo.dims[i] != m0->dims[d]) return false;
+        }
         break;
       }
       case RSUM: case RMAX: {
         if (nin != 1) return false;
-        int in_rank = static_cast<int>(p.tensors[op.ins[0]].dims.size());
-        for (int64_t ax : op.attrs)
+        int in_rank = static_cast<int>(m0->dims.size());
+        std::vector<char> reduced(in_rank ? in_rank : 1, 0);
+        for (int64_t ax : op.attrs) {
           if (ax < 0 || ax >= in_rank) return false;
+          reduced[ax] = 1;
+        }
+        int64_t kept = 1;
+        for (int i = 0; i < in_rank; ++i)
+          if (!reduced[i]) kept *= m0->dims[i];
+        if (mo.size() != kept) return false;
         break;
       }
-      case CONV2D:
+      case CONV2D: {
         if (nin != 2 || na != 6) return false;
-        if (p.tensors[op.ins[0]].dims.size() != 4 ||
-            p.tensors[op.ins[1]].dims.size() != 4 || out_rank != 4)
+        const TensorMeta& mw = p.tensors[op.ins[1]];
+        if (m0->dims.size() != 4 || mw.dims.size() != 4 || out_rank != 4)
           return false;
+        // NHWC x HWIO -> NHWC: channel agreement + batch carried through
+        if (m0->dims[3] != mw.dims[2] || mo.dims[3] != mw.dims[3] ||
+            mo.dims[0] != m0->dims[0])
+          return false;
+        if (op.attrs[0] < 1 || op.attrs[1] < 1) return false;  // strides
         break;
+      }
       case MAXPOOL: case SUMPOOL:
         if (nin != 1 || na != 8) return false;
-        if (p.tensors[op.ins[0]].dims.size() != 4 || out_rank != 4)
+        if (m0->dims.size() != 4 || out_rank != 4) return false;
+        if (mo.dims[0] != m0->dims[0] || mo.dims[3] != m0->dims[3])
+          return false;
+        if (op.attrs[0] < 1 || op.attrs[1] < 1 || op.attrs[2] < 1 ||
+            op.attrs[3] < 1)
           return false;
         break;
-      case SELECT_N: case CLAMP:
+      case SELECT_N:
         if (nin != 3) return false;
+        for (uint32_t i : op.ins)
+          if (p.tensors[i].size() != mo.size()) return false;
         break;
-      case CONCAT:
+      case CLAMP: {
+        if (nin != 3) return false;
+        if (p.tensors[op.ins[1]].size() != mo.size()) return false;
+        int64_t lo_n = m0->size(), hi_n = p.tensors[op.ins[2]].size();
+        if (lo_n != 1 && lo_n != mo.size()) return false;
+        if (hi_n != 1 && hi_n != mo.size()) return false;
+        break;
+      }
+      case CONCAT: {
         if (nin < 1 || na != 1 || op.attrs[0] < 0 ||
             op.attrs[0] >= out_rank)
           return false;
+        int axis = static_cast<int>(op.attrs[0]);
+        int64_t ax_sum = 0;
+        for (uint32_t in_t : op.ins) {
+          const TensorMeta& mi = p.tensors[in_t];
+          if (static_cast<int>(mi.dims.size()) != out_rank) return false;
+          for (int i = 0; i < out_rank; ++i)
+            if (i != axis && mi.dims[i] != mo.dims[i]) return false;
+          ax_sum += mi.dims[axis];
+        }
+        if (ax_sum != mo.dims[axis]) return false;
         break;
+      }
       default:
         return false;
     }
+    defined[op.out] = 1;
   }
+  for (uint32_t o : p.outputs)
+    if (o >= nt || !defined[o]) return false;
   return true;
 }
 
@@ -591,6 +718,20 @@ extern "C" {
 
 void* ptpu_aot_load(const char* path) { return load_program(path); }
 
+// Multi-instance serving with ONE weight copy — the reference's
+// paddle_gradient_machine_create_shared_param (capi/gradient_machine.h:88)
+// analog: the returned handle shares the origin's Program (weights are
+// read in place; each ptpu_aot_infer builds its own activation buffers),
+// so any number of threads may infer concurrently through any mix of
+// origin/shared handles. Each handle must be released; the weights are
+// freed on the last release, in any order.
+void* ptpu_aot_create_shared(void* origin) {
+  auto* p = static_cast<Program*>(origin);
+  if (!p) return nullptr;
+  p->refs.fetch_add(1, std::memory_order_relaxed);
+  return p;
+}
+
 // Same calling convention as capi.cpp's ptpu_infer: single dense float
 // input by name, first output copied out. Returns 0 ok, -2 capacity (with
 // required shape in out_rows/out_cols), -3 shape mismatch, -1 failure.
@@ -628,7 +769,8 @@ int ptpu_aot_infer(void* handle, const char* input_name, const float* data,
 }
 
 void ptpu_aot_release(void* handle) {
-  delete static_cast<Program*>(handle);
+  auto* p = static_cast<Program*>(handle);
+  if (p && p->refs.fetch_sub(1, std::memory_order_acq_rel) == 1) delete p;
 }
 
 }  // extern "C"

@@ -148,6 +148,27 @@ int ptpu_infer(void* handle, const char* input_name, const float* data,
   return rc;
 }
 
+// Shared-param multi-instance handle (gradient_machine.h:88 analog):
+// the clone's MergedModel shares the origin's compiled executable, so N
+// serving threads hold N handles over ONE weight copy. Returns nullptr
+// on failure.
+void* ptpu_model_create_shared(void* origin) {
+  auto* m = static_cast<Model*>(origin);
+  if (!m || !m->model) return nullptr;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Model* out = nullptr;
+  PyObject* clone =
+      PyObject_CallMethod(m->model, "create_shared", nullptr);
+  if (clone) {
+    out = new Model();
+    out->model = clone;
+  } else {
+    PyErr_Print();
+  }
+  PyGILState_Release(gil);
+  return out;
+}
+
 void ptpu_model_release(void* handle) {
   auto* m = static_cast<Model*>(handle);
   if (!m) return;

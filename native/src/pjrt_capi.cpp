@@ -19,6 +19,7 @@
 
 #include <dlfcn.h>
 
+#include <atomic>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -68,8 +69,10 @@ bool await_event(const PJRT_Api* api, PJRT_Event* ev, const char* where) {
 
 struct InputSpec {
   std::string name;
+  uint8_t dtype = 0;  // 0 = f32, 1 = i32
+  uint8_t rank = 2;   // 1 ([batch]) or 2 ([batch, dim])
   int64_t batch = 0;
-  int64_t dim = 0;
+  int64_t dim = 0;    // 1 for rank-1 specs
 };
 
 struct Model {
@@ -79,6 +82,9 @@ struct Model {
   PJRT_LoadedExecutable* exec = nullptr;
   size_t num_outputs = 0;
   std::vector<InputSpec> inputs;
+  // shared-param instances (ptpu_pjrt_create_shared) hold the same Model
+  // (one compiled executable, weights baked in on device once)
+  std::atomic<int> refs{1};
 };
 
 bool read_exact(FILE* f, void* dst, size_t n) {
@@ -105,7 +111,8 @@ bool parse_ptpj(const char* path, std::vector<InputSpec>* inputs,
   uint32_t version = 0, ni = 0;
   if (!read_exact(f, magic, 4) || memcmp(magic, "PTPJ", 4) != 0)
     return fail("magic");
-  if (!rd(f, &version) || version != 1) return fail("version");
+  if (!rd(f, &version) || (version != 1 && version != 2))
+    return fail("version");
   if (!rd(f, &ni)) return fail("inputs");
   for (uint32_t i = 0; i < ni; ++i) {
     uint16_t nl = 0;
@@ -114,12 +121,24 @@ bool parse_ptpj(const char* path, std::vector<InputSpec>* inputs,
     spec.name.resize(nl);
     if (nl && !read_exact(f, spec.name.data(), nl)) return fail("name");
     uint8_t dtype = 0, rank = 0;
-    if (!rd(f, &dtype) || !rd(f, &rank) || dtype != 0 || rank != 2)
-      return fail("spec");
-    int64_t dims[2];
-    if (!read_exact(f, dims, sizeof(dims))) return fail("dims");
-    spec.batch = dims[0];
-    spec.dim = dims[1];
+    if (!rd(f, &dtype) || !rd(f, &rank)) return fail("spec");
+    // v1 artifacts only ever declared f32 rank-2; v2 adds i32 rank-1
+    // (integer/embedding feeds) so the spec matches the module signature
+    if (version == 1 && (dtype != 0 || rank != 2)) return fail("spec");
+    if (dtype > 1 || rank < 1 || rank > 2) return fail("spec");
+    spec.dtype = dtype;
+    spec.rank = rank;
+    if (rank == 2) {
+      int64_t dims[2];
+      if (!read_exact(f, dims, sizeof(dims))) return fail("dims");
+      spec.batch = dims[0];
+      spec.dim = dims[1];
+    } else {
+      int64_t d0 = 0;
+      if (!rd(f, &d0)) return fail("dims");
+      spec.batch = d0;
+      spec.dim = 1;
+    }
     inputs->push_back(std::move(spec));
   }
   if (!rd(f, n_outputs)) return fail("outputs");
@@ -271,17 +290,21 @@ void* ptpu_pjrt_load(const char* model_path, const char* plugin_path) {
   return m;
 }
 
-// Single dense input by name → first output, same convention as
-// ptpu_infer/ptpu_aot_infer. 0 ok, -2 capacity, -3 shape mismatch,
-// -4 contract (not single-input / wrong name), -1 runtime failure.
-int ptpu_pjrt_infer(void* handle, const char* input_name, const float* data,
-                    int64_t batch, int64_t dim, float* out,
-                    int64_t out_capacity, int64_t* out_rows,
+}  // extern "C"
+
+namespace {
+
+// Shared single-input execute path. 0 ok, -2 capacity, -3 shape/dtype
+// mismatch, -4 contract (not single-input / wrong name), -1 runtime
+// failure.
+int pjrt_infer_impl(Model* m, const char* input_name, const void* data,
+                    uint8_t dtype_code, int64_t batch, int64_t dim,
+                    float* out, int64_t out_capacity, int64_t* out_rows,
                     int64_t* out_cols) {
-  auto* m = static_cast<Model*>(handle);
   if (!m || !m->exec) return -1;
   if (m->inputs.size() != 1 || m->inputs[0].name != input_name) return -4;
   const InputSpec& spec = m->inputs[0];
+  if (spec.dtype != dtype_code) return -3;
   if (spec.batch != batch || spec.dim != dim) return -3;
 
   const PJRT_Api* api = m->api;
@@ -311,9 +334,9 @@ int ptpu_pjrt_infer(void* handle, const char* input_name, const float* data,
     args.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
     args.client = m->client;
     args.data = data;
-    args.type = PJRT_Buffer_Type_F32;
+    args.type = spec.dtype == 1 ? PJRT_Buffer_Type_S32 : PJRT_Buffer_Type_F32;
     args.dims = dims;
-    args.num_dims = 2;
+    args.num_dims = spec.rank;
     args.host_buffer_semantics =
         PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
     args.device = device;
@@ -404,8 +427,44 @@ int ptpu_pjrt_infer(void* handle, const char* input_name, const float* data,
   return rc;
 }
 
+}  // namespace
+
+extern "C" {
+
+// Single dense f32 input by name → first output, same convention as
+// ptpu_infer/ptpu_aot_infer.
+int ptpu_pjrt_infer(void* handle, const char* input_name, const float* data,
+                    int64_t batch, int64_t dim, float* out,
+                    int64_t out_capacity, int64_t* out_rows,
+                    int64_t* out_cols) {
+  return pjrt_infer_impl(static_cast<Model*>(handle), input_name, data, 0,
+                         batch, dim, out, out_capacity, out_rows, out_cols);
+}
+
+// Single integer-id input ([batch] i32 — embedding models, .ptpj v2).
+int ptpu_pjrt_infer_i32(void* handle, const char* input_name,
+                        const int32_t* data, int64_t batch, float* out,
+                        int64_t out_capacity, int64_t* out_rows,
+                        int64_t* out_cols) {
+  return pjrt_infer_impl(static_cast<Model*>(handle), input_name, data, 1,
+                         batch, 1, out, out_capacity, out_rows, out_cols);
+}
+
+// Shared-param multi-instance serving (gradient_machine.h:88 analog):
+// the compiled executable + its on-device weights are shared; PJRT
+// execution is reentrant, so any number of threads may infer through any
+// mix of handles. Freed on the last release, in any order.
+void* ptpu_pjrt_create_shared(void* origin) {
+  auto* m = static_cast<Model*>(origin);
+  if (!m) return nullptr;
+  m->refs.fetch_add(1, std::memory_order_relaxed);
+  return m;
+}
+
 void ptpu_pjrt_release(void* handle) {
-  destroy_model(static_cast<Model*>(handle));
+  auto* m = static_cast<Model*>(handle);
+  if (m && m->refs.fetch_sub(1, std::memory_order_acq_rel) == 1)
+    destroy_model(m);
 }
 
 }  // extern "C"

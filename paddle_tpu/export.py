@@ -117,6 +117,21 @@ class MergedModel:
         outs = self._exported.call(*args)
         return [np.asarray(o) for o in outs]
 
+    def create_shared(self) -> "MergedModel":
+        """New inference instance sharing this model's compiled executable
+        (weights baked in — ONE copy serves all instances), the
+        paddle_gradient_machine_create_shared_param analog
+        (capi/gradient_machine.h:88): hand each serving thread its own
+        instance. ``infer`` is reentrant either way (the executable is
+        stateless); the clone exists so embedders can mirror the
+        reference's one-handle-per-thread pattern."""
+        clone = object.__new__(MergedModel)
+        clone.manifest = self.manifest
+        clone._exported = self._exported
+        clone.input_names = list(self.input_names)
+        clone.output_names = list(self.output_names)
+        return clone
+
 
 def load_merged_model(path: str) -> MergedModel:
     return MergedModel(path)
@@ -194,14 +209,22 @@ def export_pjrt_model(output_layers, parameters: Parameters, path: str,
     with open(path, "wb") as f:
         w = f.write
         w(b"PTPJ")
-        w(struct.pack("<I", 1))
+        w(struct.pack("<I", 2))
         w(struct.pack("<I", len(data_nodes)))
         for n in data_nodes:
             name = n.name.encode()
             w(struct.pack("<H", len(name)))
             w(name)
-            w(struct.pack("<BB", 0, 2))  # f32, rank 2
-            w(struct.pack("<2q", int(batch_size), int(n.size)))
+            # v2 spec matches the traced entry signature per input:
+            # integer feeds are i32 rank-1 [B], dense are f32 rank-2
+            # [B, size] (ADVICE r4: v1 declared everything f32 rank-2,
+            # contradicting the StableHLO signature for embedding models)
+            if _is_int_feed(n):
+                w(struct.pack("<BB", 1, 1))  # i32, rank 1
+                w(struct.pack("<q", int(batch_size)))
+            else:
+                w(struct.pack("<BB", 0, 2))  # f32, rank 2
+                w(struct.pack("<2q", int(batch_size), int(n.size)))
         w(struct.pack("<I", len(outs)))
         w(struct.pack("<Q", len(mlir)))
         w(mlir)

@@ -68,7 +68,7 @@ def block(x, *, n_heads: int, ffn_mult: int = 4, name: str,
 def build(vocab_size: int = 32768, d_model: int = 512, n_layers: int = 6,
           n_heads: int = 8, max_len: int = 1024, ffn_mult: int = 4,
           dropout: float = 0.0, fused_head: bool = False,
-          moe_experts: int = 0):
+          moe_experts: int = 0, remat: bool = False):
     """Returns (tokens, positions, target, logits, cost).
 
     Feeds: ``tokens`` / ``target`` are integer sequences (next-token
@@ -81,6 +81,13 @@ def build(vocab_size: int = 32768, d_model: int = 512, n_layers: int = 6,
     Training-equivalent to f32 rounding (test_network_compare pins it);
     the returned ``logits`` node still exists for decoding and shares
     the head weight by name.
+
+    ``remat=True`` wraps each block in a topology.remat_scope: backward
+    recomputes per-block activations from the block's input instead of
+    keeping them in HBM — the standard TPU lever that buys batch/sequence
+    with ~1 extra forward of FLOPs. Training-equivalent to remat=False up
+    to f32 rounding (the recomputed forward may fuse/round differently;
+    dropout masks are identical by construction).
     """
     tokens = layer.data(name="tokens",
                         type=paddle.data_type.integer_value_sequence(vocab_size))
@@ -93,15 +100,22 @@ def build(vocab_size: int = 32768, d_model: int = 512, n_layers: int = 6,
     pos_emb = layer.embedding(input=pos, size=d_model, name="pos_embed")
     x = layer.addto(input=[tok_emb, pos_emb], name="embed_sum")
     aux_nodes = []
+    import contextlib
+
+    from paddle_tpu import topology as _topo
+
     for i in range(n_layers):
-        if moe_experts > 0:
-            x, aux = block(x, n_heads=n_heads, ffn_mult=ffn_mult,
-                           name=f"blk{i}", dropout=dropout,
-                           moe_experts=moe_experts)
-            aux_nodes.append(aux)
-        else:
-            x = block(x, n_heads=n_heads, ffn_mult=ffn_mult,
-                      name=f"blk{i}", dropout=dropout)
+        scope = (_topo.remat_scope(f"blk{i}") if remat
+                 else contextlib.nullcontext())
+        with scope:
+            if moe_experts > 0:
+                x, aux = block(x, n_heads=n_heads, ffn_mult=ffn_mult,
+                               name=f"blk{i}", dropout=dropout,
+                               moe_experts=moe_experts)
+                aux_nodes.append(aux)
+            else:
+                x = block(x, n_heads=n_heads, ffn_mult=ffn_mult,
+                          name=f"blk{i}", dropout=dropout)
     x = layer.layer_norm(x, name="final_ln")
     logits = layer.fc(input=x, size=vocab_size, name="lm_head")
     if fused_head:
@@ -374,11 +388,34 @@ def beam_generate_batch(params, prompts, max_new_tokens: int, *,
     return np.asarray(toks), np.asarray(scores)
 
 
-@functools.lru_cache(maxsize=32)
 def _beam_fn(n_layers, n_heads, max_len, n_prompt, total, beam_size, eos_id,
              length_penalty, candidate_adjust=None, path_filter=None,
              stop_condition=None):
-    """Jitted beam-search scan for one static config (weights are args)."""
+    """Jitted beam-search scan for one static config (weights are args).
+
+    Hook-free configs are cached (repeat generate calls skip retracing).
+    Configs WITH hooks bypass the cache: callers naturally pass fresh
+    lambdas/closures, which would never hit the cache anyway and would pin
+    up to 32 closures (plus their captured arrays) alive in it."""
+    if candidate_adjust is None and path_filter is None and \
+            stop_condition is None:
+        return _beam_fn_cached(n_layers, n_heads, max_len, n_prompt, total,
+                               beam_size, eos_id, length_penalty)
+    return _beam_fn_build(n_layers, n_heads, max_len, n_prompt, total,
+                          beam_size, eos_id, length_penalty,
+                          candidate_adjust, path_filter, stop_condition)
+
+
+@functools.lru_cache(maxsize=32)
+def _beam_fn_cached(n_layers, n_heads, max_len, n_prompt, total, beam_size,
+                    eos_id, length_penalty):
+    return _beam_fn_build(n_layers, n_heads, max_len, n_prompt, total,
+                          beam_size, eos_id, length_penalty, None, None, None)
+
+
+def _beam_fn_build(n_layers, n_heads, max_len, n_prompt, total, beam_size,
+                   eos_id, length_penalty, candidate_adjust, path_filter,
+                   stop_condition):
     import jax
     import jax.numpy as jnp
 

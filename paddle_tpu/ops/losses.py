@@ -232,13 +232,32 @@ def _compute_dtype(x):
     return compute_dtype(x)
 
 
+_PAD_NEG = -1e30   # finite -inf: exp underflows to 0, no NaNs
+
+
 def _lm_blocks(w, block_v):
+    """Resolve (block_v, vocab, n_blocks) with ceil-div blocking: any vocab
+    works at full block width — the last block is PADDED (zero weight
+    columns, -1e30 bias) rather than shrinking block_v toward 1, which for
+    an odd vocab (e.g. 50257) would silently degrade the scan to [N, 1]
+    matmuls."""
     v = w.shape[1]
     if block_v <= 0 or block_v > v:
         block_v = v
-    while v % block_v != 0:  # shrink to a divisor; correctness first
-        block_v //= 2
-    return max(1, block_v), v
+    nb = -(-v // block_v)
+    return block_v, v, nb
+
+
+def _padded_wb(w, b, bv, nb):
+    """Pad w/b out to nb*bv columns: padded logits come out ~-1e30, so
+    exp() underflows to exactly 0 in fwd softmax stats and bwd probs."""
+    v = w.shape[1]
+    pad = nb * bv - v
+    if pad == 0:
+        return w, b
+    wp = jnp.concatenate([w, jnp.zeros((w.shape[0], pad), w.dtype)], axis=1)
+    bp = jnp.concatenate([b, jnp.full((pad,), _PAD_NEG, b.dtype)])
+    return wp, bp
 
 
 def lm_head_xent(x, w, b, labels, block_v: int = 4096):
@@ -275,9 +294,9 @@ def _block_logits(x, w, b, j, bv):
 
 
 def _lm_head_fwd_impl(x, w, b, labels, block_v):
-    bv, v = _lm_blocks(w, block_v)
+    bv, v, nb = _lm_blocks(w, block_v)
+    w, b = _padded_wb(w, b, bv, nb)
     n = x.shape[0]
-    nb = v // bv
     neg = jnp.float32(-jnp.inf)
 
     def body(carry, j):
@@ -308,9 +327,9 @@ def _lm_head_xent_fwd(x, w, b, labels, block_v):
 
 def _lm_head_xent_bwd(block_v, res, g):
     x, w, b, labels, logz = res
-    bv, v = _lm_blocks(w, block_v)
+    bv, v, nb = _lm_blocks(w, block_v)
+    w, b = _padded_wb(w, b, bv, nb)
     d = w.shape[0]
-    nb = v // bv
     gf = g.astype(jnp.float32)
 
     def body(carry, j):
@@ -337,7 +356,8 @@ def _lm_head_xent_bwd(block_v, res, g):
             jnp.zeros_like(b))
     (dx, dw, db), _ = jax.lax.scan(body, init,
                                    jnp.arange(nb, dtype=jnp.int32))
-    return dx.astype(x.dtype), dw, db, None
+    # drop the pad columns (grads there are exactly 0 by construction)
+    return dx.astype(x.dtype), dw[:, :v], db[:v], None
 
 
 _lm_head_xent.defvjp(_lm_head_xent_fwd, _lm_head_xent_bwd)

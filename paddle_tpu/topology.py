@@ -48,6 +48,45 @@ def reset_name_scope() -> None:
     _name_counters.clear()
 
 
+# ---------------------------------------------------------------------------
+# Remat (activation checkpointing) scopes
+# ---------------------------------------------------------------------------
+
+_remat_stack: List[str] = []
+
+
+class remat_scope:
+    """Tag every layer created inside with a remat group.
+
+    The classic TPU memory/compute trade: nodes sharing a group are executed
+    as ONE ``jax.checkpoint``-wrapped segment by ``Topology.forward``, so the
+    backward pass recomputes the segment's activations from its boundary
+    inputs instead of keeping them in HBM. Wrapping each transformer block
+    buys O(n_layers) activation memory for ~1 extra forward of FLOPs — the
+    lever that lets the bench run bigger batch/sequence tiers.
+
+    Reference analog: none — the reference keeps every layer's output alive
+    for backward (gserver NeuralNetwork keeps per-layer Arguments); remat is
+    the XLA-era replacement.
+
+    Usage::
+
+        with topology.remat_scope("blk0"):
+            x = layer.fc(...)
+    """
+
+    def __init__(self, group: str):
+        self.group = group
+
+    def __enter__(self):
+        _remat_stack.append(self.group)
+        return self
+
+    def __exit__(self, *exc):
+        _remat_stack.pop()
+        return False
+
+
 @dataclass
 class ParamSpec:
     """Declared parameter of a layer node."""
@@ -117,9 +156,12 @@ class LayerOutput:
     size: Optional[int] = None          # feature dimension, v2-API compatible
     is_sequence: bool = False           # value is a SequenceBatch
     is_cost: bool = False               # per-example loss output
+    remat_group: Optional[str] = None   # set by the enclosing remat_scope
 
     def __post_init__(self):
         enforce_that(self.name is not None, "layer needs a name")
+        if self.remat_group is None and _remat_stack and self.fn is not None:
+            self.remat_group = _remat_stack[-1]
 
     # Graph sugar: l1 + l2 = addto
     def __add__(self, other: "LayerOutput") -> "LayerOutput":
@@ -235,12 +277,21 @@ class Topology:
         wanted = list(outputs) if outputs is not None else self.outputs
         ctx = Context(train=train, rng=rng, state=state, mesh=mesh)
         values: Dict[str, Any] = {}
-        for node in topological_order(wanted):
+        order = topological_order(wanted)
+        done_groups: set = set()
+        for node in order:
             if node.fn is None:  # data layers and frame/memory placeholders
                 if node.name not in feeds:
                     raise EnforceError(f"missing feed for data layer {node.name!r}",
                                        context="forward")
                 values[node.name] = feeds[node.name]
+                continue
+            if node.remat_group is not None:
+                if node.remat_group not in done_groups:
+                    done_groups.add(node.remat_group)
+                    self._run_remat_group(node.remat_group, order, values,
+                                          params, ctx,
+                                          {w.name for w in wanted})
                 continue
             node_params = {p: params[self.param_key(node, p)] for p in node.params}
             ins = [values[i.name] for i in node.inputs]
@@ -265,6 +316,72 @@ class Topology:
             # namespace's other slots
             new_state[ns] = {**new_state.get(ns, {}), **slots}
         return [values[w.name] for w in wanted], new_state
+
+    def _run_remat_group(self, group: str, order: List[LayerOutput],
+                         values: Dict[str, Any],
+                         params: Dict[str, jax.Array], ctx: Context,
+                         wanted_names: set) -> None:
+        """Execute one remat group as a single jax.checkpoint segment.
+
+        The segment is a pure function of (its params, the step rng, its
+        boundary inputs) -> (boundary outputs, state updates); XLA drops
+        the segment's internal activations after forward and recomputes
+        them during backward.
+        """
+        nodes = [n for n in order if n.remat_group == group]
+        in_group = {n.name for n in nodes}
+        ext_in: List[str] = []
+        for n in nodes:
+            for i in n.inputs:
+                if i.name not in in_group and i.name not in ext_in:
+                    ext_in.append(i.name)
+                    enforce_that(
+                        i.name in values,
+                        f"remat group {group!r} input {i.name!r} is not "
+                        f"available yet — the group is not a contiguous "
+                        f"segment of the graph", context="remat")
+        consumed_outside = set(wanted_names)
+        for n in order:
+            if n.remat_group != group:
+                consumed_outside.update(i.name for i in n.inputs)
+        ext_out = [n.name for n in nodes if n.name in consumed_outside]
+        enforce_that(ext_out,
+                     f"remat group {group!r} has no outputs used outside it",
+                     context="remat")
+        pkeys = sorted({self.param_key(n, p) for n in nodes for p in n.params})
+        # rng=None must stay None inside the segment so per-node streams
+        # derive exactly as in the un-rematted graph (rng_for's fallback)
+        has_rng = ctx._rng is not None
+        rng_arg = ctx._rng if has_rng else jax.random.PRNGKey(0)
+
+        def segment(seg_params, seg_rng, ext_vals):
+            local = dict(zip(ext_in, ext_vals))
+            sub = Context(train=ctx.train, rng=seg_rng if has_rng else None,
+                          state=ctx.state_in, mesh=ctx.mesh)
+            for n in nodes:
+                node_params = {p: seg_params[self.param_key(n, p)]
+                               for p in n.params}
+                ins = [local[i.name] for i in n.inputs]
+                sub._current = n.name
+                try:
+                    with jax.named_scope(n.name):
+                        local[n.name] = n.fn(sub, node_params, ins)
+                except Exception as e:
+                    e.add_note(
+                        f"[paddle_tpu] while computing layer {n.name!r} "
+                        f"(type={n.layer_type}, remat group {group!r}, "
+                        f"inputs={[i.name for i in n.inputs]})")
+                    raise
+            return [local[nm] for nm in ext_out], sub.state_out
+
+        with jax.named_scope(f"remat_{group}"):
+            outs, state_out = jax.checkpoint(segment)(
+                {k: params[k] for k in pkeys}, rng_arg,
+                [values[nm] for nm in ext_in])
+        for nm, v in zip(ext_out, outs):
+            values[nm] = v
+        for ns, slots in state_out.items():
+            ctx.state_out.setdefault(ns, {}).update(slots)
 
     def __repr__(self):
         return f"Topology({len(self.nodes)} nodes, outputs={[o.name for o in self.outputs]})"

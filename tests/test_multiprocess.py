@@ -158,3 +158,117 @@ def test_two_process_mesh_and_train_step(tmp_path):
     w0 = [l for l in outs[0].splitlines() if "wsum=" in l][0]
     w1 = [l for l in outs[1].splitlines() if "wsum=" in l][0]
     assert w0.split("wsum=")[1] == w1.split("wsum=")[1], (w0, w1)
+
+
+_WORKER_2X4 = r"""
+import os, sys
+import numpy as np
+pid = int(sys.argv[1]); port = sys.argv[2]
+import paddle_tpu as paddle
+paddle.init(coordinator_address=f"127.0.0.1:{port}", num_processes=2,
+            process_id=pid, platform="cpu")
+import jax, jax.numpy as jnp
+from jax.sharding import Mesh
+
+assert jax.process_count() == 2, jax.process_count()
+devs = jax.devices()
+assert len(devs) == 8, devs
+assert len(jax.local_devices()) == 4, jax.local_devices()
+# hybrid mesh: dp over the PROCESS boundary (the DCN analog), tp+ZeRO
+# over the 4 in-process virtual devices (the ICI analog) — the
+# dryrun_multichip hybrid layout across a real process boundary
+mesh = Mesh(np.array(devs).reshape(2, 4), ("data", "model"))
+
+from paddle_tpu import layer, optimizer, trainer
+from paddle_tpu.parallel import model_parallel_mlp
+from paddle_tpu.topology import Topology
+
+IN_DIM, N_CLS, STEPS = 16, 4, 5
+W = np.random.RandomState(99).randn(IN_DIM, N_CLS)
+rng = np.random.RandomState(5)
+gx = rng.randn(8, IN_DIM).astype(np.float32)
+gy = np.argmax(gx @ W, 1).astype(np.int32)
+
+def build():
+    paddle.topology.reset_name_scope()
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(IN_DIM))
+    y = layer.data(name="y", type=paddle.data_type.integer_value(N_CLS))
+    logits = model_parallel_mlp(x, [32, 32], N_CLS, axis="model")
+    return layer.classification_cost(input=logits, label=y)
+
+def run(mesh_arg, rows):
+    cost = build()
+    params = paddle.Parameters.from_topology(Topology([cost]), seed=0)
+    sgd = trainer.SGD(cost=cost, parameters=params,
+                      update_equation=optimizer.Adam(learning_rate=3e-3),
+                      mesh=mesh_arg,
+                      **({"zero_axis": "model"} if mesh_arg else {}))
+    feeder = sgd._make_feeder({"x": 0, "y": 1})
+    feeds = feeder.feed([(gx[i], int(gy[i])) for i in rows])
+    feeds = sgd._shard_feeds(feeds)
+    step = sgd._build_step()
+    p, o, m = sgd.parameters.as_dict(), sgd.opt_state, sgd.model_state
+    key = jax.random.PRNGKey(0)
+    losses = []
+    for _ in range(STEPS):
+        loss, p, o, m, _ = step(p, o, m, key, feeds)
+        losses.append(float(loss))
+    return losses, p, o
+
+# distributed: each process feeds ITS half; global batch = concat
+d_losses, p, o = run(mesh, range(pid * 4, pid * 4 + 4))
+w = p["mp_fc0.w0"]
+assert w.addressable_shards[0].data.size < w.size, "weight not sharded"
+slot = next(iter(o["slots"].values()))["mp_fc0.w0"]
+assert slot.addressable_shards[0].data.size < slot.size, "slot not sharded"
+
+# serial oracle IN the same process: same init, the FULL global batch,
+# no mesh — the hybrid dp x tp run must follow the same trajectory
+s_losses, _, _ = run(None, range(8))
+assert np.allclose(d_losses, s_losses, rtol=2e-4, atol=1e-6), (
+    d_losses, s_losses)
+assert d_losses[-1] < d_losses[0], d_losses
+print(f"pid{pid} HYBRID24 OK losses=" +
+      ",".join(f"{v:.6f}" for v in d_losses), flush=True)
+"""
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="gloo CPU collectives")
+def test_two_process_by_four_device_hybrid_mesh(tmp_path):
+    """2 processes x 4 virtual CPU devices each: the dryrun_multichip
+    hybrid layout (dp over the process boundary, tp+ZeRO inside) across a
+    REAL process boundary, with sharded-weight training parity against a
+    serial oracle (test_ParameterServer2.cpp:554-560's role, scaled up)."""
+    worker = tmp_path / "worker24.py"
+    worker.write_text(_WORKER_2X4)
+    port = _free_port()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {
+        "PATH": os.environ.get("PATH", ""),
+        "HOME": os.environ.get("HOME", "/root"),
+        "PYTHONPATH": repo,          # NO ambient sitecustomize (axon hook)
+        "JAX_PLATFORMS": "cpu",
+        "JAX_CPU_COLLECTIVES_IMPLEMENTATION": "gloo",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+    }
+    procs = [subprocess.Popen([sys.executable, str(worker), str(i),
+                               str(port)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True, env=env)
+             for i in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"pid{i} failed:\n{out[-2500:]}"
+        assert f"pid{i} HYBRID24 OK" in out
+    # both ranks computed the IDENTICAL loss trajectory (sync-SGD invariant)
+    l0 = [l for l in outs[0].splitlines() if "losses=" in l][0]
+    l1 = [l for l in outs[1].splitlines() if "losses=" in l][0]
+    assert l0.split("losses=")[1] == l1.split("losses=")[1], (l0, l1)

@@ -505,3 +505,266 @@ int main(int argc, char** argv) {
     finally:
         FLAGS.use_bf16 = old
     np.testing.assert_allclose(got, np.asarray(expect[0]), atol=1e-5)
+
+
+def test_pjrt_export_int_feed_specs(tmp_path):
+    """.ptpj v2 input specs must match the traced StableHLO signature:
+    integer feeds (embedding models) declare i32 rank-1 [B], dense feeds
+    f32 rank-2 [B, size] (ADVICE r4: v1 declared everything f32 rank-2)."""
+    import struct
+
+    from paddle_tpu import export as pexport
+    from paddle_tpu import layer
+
+    paddle.topology.reset_name_scope()
+    ids = layer.data(name="ids", type=paddle.data_type.integer_value(50))
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(6))
+    emb = layer.embedding(input=ids, size=6, name="tbl")
+    out = layer.fc(layer.addto(input=[emb, x]), size=3, act="softmax")
+    topo = paddle.topology.Topology([out])
+    params = paddle.Parameters.from_topology(topo, seed=0)
+    path = str(tmp_path / "emb.ptpj")
+    pexport.export_pjrt_model(out, params, path, batch_size=4)
+
+    with open(path, "rb") as f:
+        assert f.read(4) == b"PTPJ"
+        version, ni = struct.unpack("<II", f.read(8))
+        assert version == 2
+        specs = {}
+        for _ in range(ni):
+            (nl,) = struct.unpack("<H", f.read(2))
+            name = f.read(nl).decode()
+            dtype, rank = struct.unpack("<BB", f.read(2))
+            dims = struct.unpack(f"<{rank}q", f.read(8 * rank))
+            specs[name] = (dtype, rank, dims)
+    assert specs["ids"] == (1, 1, (4,))
+    assert specs["x"] == (0, 2, (4, 6))
+
+
+def _write_ptnm(path, tensors, inputs, outputs, consts, ops):
+    """Hand-rolled .ptnm writer for crafting adversarial programs (same
+    layout as export.export_aot_program's writer)."""
+    import struct
+
+    with open(path, "wb") as f:
+        w = f.write
+        w(b"PTNM")
+        w(struct.pack("<I", 1))
+        w(struct.pack("<I", len(tensors)))
+        for dtype, dims in tensors:
+            w(struct.pack("<BB", dtype, len(dims)))
+            w(struct.pack(f"<{len(dims)}q", *dims))
+        w(struct.pack("<I", len(inputs)))
+        for tid, name in inputs:
+            nm = name.encode()
+            w(struct.pack("<IH", tid, len(nm)))
+            w(nm)
+        w(struct.pack("<I", len(outputs)))
+        for tid in outputs:
+            w(struct.pack("<I", tid))
+        w(struct.pack("<I", len(consts)))
+        for tid, arr in consts:
+            raw = np.asarray(arr, np.float32).tobytes()
+            w(struct.pack("<IQ", tid, len(raw)))
+            w(raw)
+        w(struct.pack("<I", len(ops)))
+        for opcode, ins, out, attrs in ops:
+            w(struct.pack("<II", opcode, len(ins)))
+            w(struct.pack(f"<{len(ins)}I", *ins))
+            w(struct.pack("<II", out, len(attrs)))
+            w(struct.pack(f"<{len(attrs)}q", *attrs))
+
+
+def test_aot_validator_rejects_malicious_programs(native, tmp_path):
+    """validate_program must refuse crafted .ptnm files whose shapes would
+    drive OOB reads/writes or null derefs in the executor (ADVICE r4):
+    gather width mismatch, undersized DOT output, def-before-use
+    violations, negative dims, shrinking RESHAPE, CONCAT overflow."""
+    import ctypes
+
+    from paddle_tpu.export import (OP_CONCAT, OP_DOT, OP_GATHER_ROWS,
+                                   OP_IDENT, OP_RESHAPE)
+
+    lib = ctypes.CDLL(native.build_aot())
+    lib.ptpu_aot_load.restype = ctypes.c_void_p
+    lib.ptpu_aot_load.argtypes = [ctypes.c_char_p]
+
+    def load(name, *spec):
+        path = str(tmp_path / name)
+        _write_ptnm(path, *spec)
+        return lib.ptpu_aot_load(path.encode())
+
+    # sanity: a well-formed program loads (validator not over-rejecting)
+    ok = load("ok.ptnm",
+              [(0, (2, 3)), (0, (3, 4)), (0, (2, 4))],
+              [(0, "x")], [2], [(1, np.zeros((3, 4)))],
+              [(OP_DOT, [0, 1], 2, [])])
+    assert ok
+    lib.ptpu_aot_release(ctypes.c_void_p(ok))
+
+    # GATHER_ROWS: out width 8 vs table width 4 -> heap overflow write
+    assert not load("gather.ptnm",
+                    [(0, (5, 4)), (0, (3, 1)), (0, (3, 8))],
+                    [(1, "ids")], [2], [(0, np.zeros((5, 4)))],
+                    [(OP_GATHER_ROWS, [0, 1], 2, [])])
+    # DOT writes M*N=8 floats into a 4-float output
+    assert not load("dot.ptnm",
+                    [(0, (2, 3)), (0, (3, 4)), (0, (2, 2))],
+                    [(0, "x")], [2], [(1, np.zeros((3, 4)))],
+                    [(OP_DOT, [0, 1], 2, [])])
+    # op reads tensor 1 which is neither const, input, nor produced
+    assert not load("undef.ptnm",
+                    [(0, (2, 3)), (0, (2, 3)), (0, (2, 3))],
+                    [(0, "x")], [2], [],
+                    [(OP_IDENT, [1], 2, [])])
+    # negative dim -> size() underflow
+    assert not load("negdim.ptnm",
+                    [(0, (-4, 2)), (0, (2, 2))],
+                    [(0, "x")], [1], [],
+                    [(OP_IDENT, [0], 1, [])])
+    # RESHAPE copies out.size()=16 elements from a 4-element input
+    assert not load("reshape.ptnm",
+                    [(0, (2, 2)), (0, (4, 4))],
+                    [(0, "x")], [1], [],
+                    [(OP_RESHAPE, [0], 1, [])])
+    # CONCAT axis dims sum to 4 but out claims 5 rows
+    assert not load("concat.ptnm",
+                    [(0, (2, 3)), (0, (2, 3)), (0, (5, 3))],
+                    [(0, "x"), (1, "y")], [2], [],
+                    [(OP_CONCAT, [0, 1], 2, [0])])
+    # output id never defined by any op
+    assert not load("outundef.ptnm",
+                    [(0, (2, 3)), (0, (2, 3))],
+                    [(0, "x")], [1], [], [])
+    # an op clobbering a weight const
+    assert not load("clobber.ptnm",
+                    [(0, (2, 3)), (0, (2, 3))],
+                    [(0, "x")], [1], [(1, np.zeros((2, 3)))],
+                    [(OP_IDENT, [0], 1, [])])
+
+
+C_AOT_SHARED_TEST = r"""
+#include <pthread.h>
+#include <stdio.h>
+#include <string.h>
+
+extern void* ptpu_aot_load(const char* path);
+extern void* ptpu_aot_create_shared(void* origin);
+extern int ptpu_aot_infer(void* h, const char* name, const float* data,
+                          long long batch, long long dim, float* out,
+                          long long cap, long long* rows, long long* cols);
+extern void ptpu_aot_release(void* h);
+
+static float g_in[16];
+static float g_expect[64];
+static long long g_n = 0;
+
+static void* worker(void* arg) {
+  void* h = arg;
+  float out[64];
+  long long r = 0, c = 0;
+  for (int it = 0; it < 50; ++it) {
+    int rc = ptpu_aot_infer(h, "x", g_in, 2, 8, out, 64, &r, &c);
+    if (rc != 0 || r * c != g_n ||
+        memcmp(out, g_expect, g_n * sizeof(float)) != 0)
+      return (void*)1;
+  }
+  return (void*)0;
+}
+
+int main(int argc, char** argv) {
+  void* origin = ptpu_aot_load(argv[1]);
+  if (!origin) return 1;
+  void* s1 = ptpu_aot_create_shared(origin);
+  void* s2 = ptpu_aot_create_shared(origin);
+  if (!s1 || !s2) return 2;
+  /* shared instances must outlive the origin handle (refcounted) */
+  ptpu_aot_release(origin);
+  for (int i = 0; i < 16; ++i) g_in[i] = (float)((i * 37 % 100) - 50) / 100.0f;
+  long long r = 0, c = 0;
+  if (ptpu_aot_infer(s1, "x", g_in, 2, 8, g_expect, 64, &r, &c) != 0)
+    return 3;
+  g_n = r * c;
+  pthread_t t1, t2;
+  pthread_create(&t1, 0, worker, s1);
+  pthread_create(&t2, 0, worker, s2);
+  void *r1 = 0, *r2 = 0;
+  pthread_join(t1, &r1);
+  pthread_join(t2, &r2);
+  ptpu_aot_release(s1);
+  ptpu_aot_release(s2);
+  if (r1 || r2) return 4;
+  printf("OK %lld\n", g_n);
+  return 0;
+}
+"""
+
+
+def test_aot_c_shared_param_concurrent(native, tmp_path):
+    """create_shared (the paddle_gradient_machine_create_shared_param
+    analog, capi/gradient_machine.h:88): two threads infer concurrently
+    through shared handles over ONE weight copy, with the origin handle
+    released first (refcounted lifetime) — outputs bit-identical to the
+    single-thread run."""
+    from paddle_tpu import export as pexport
+    from paddle_tpu import layer
+
+    paddle.topology.reset_name_scope()
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(8))
+    out = layer.fc(layer.fc(x, size=16, act="relu"), size=3, act="softmax")
+    topo = paddle.topology.Topology([out])
+    params = paddle.Parameters.from_topology(topo, seed=0)
+    model_path = str(tmp_path / "shared.ptnm")
+    pexport.export_aot_program(out, params, model_path, batch_size=2)
+
+    aot_so = native.build_aot()
+    csrc = tmp_path / "shared_client.c"
+    csrc.write_text(C_AOT_SHARED_TEST)
+    exe = str(tmp_path / "shared_client")
+    subprocess.run(["gcc", "-pthread", "-o", exe, str(csrc), aot_so,
+                    f"-Wl,-rpath,{os.path.dirname(aot_so)}"],
+                   check=True, capture_output=True)
+    proc = subprocess.run([exe, model_path], capture_output=True, text=True,
+                          timeout=120)
+    assert proc.returncode == 0, (proc.returncode, proc.stderr)
+    assert proc.stdout.startswith("OK")
+
+
+def test_merged_model_create_shared(tmp_path):
+    """MergedModel.create_shared: clone shares the compiled executable,
+    infers identically, and concurrent inference from two python threads
+    agrees with the single-thread result."""
+    import threading
+
+    from paddle_tpu import export as pexport
+    from paddle_tpu import layer
+
+    paddle.topology.reset_name_scope()
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(6))
+    out = layer.fc(x, size=4, act="softmax")
+    topo = paddle.topology.Topology([out])
+    params = paddle.Parameters.from_topology(topo, seed=1)
+    path = str(tmp_path / "m.ptmodel")
+    pexport.merge_model(out, params, path, batch_size=3)
+
+    m = pexport.load_merged_model(path)
+    clone = m.create_shared()
+    assert clone._exported is m._exported  # one executable, one weight copy
+    fx = np.random.RandomState(0).randn(3, 6).astype(np.float32)
+    want = m.infer({"x": fx})[0]
+    np.testing.assert_array_equal(clone.infer({"x": fx})[0], want)
+
+    results = {}
+
+    def run(tag, inst):
+        for _ in range(10):
+            results[tag] = inst.infer({"x": fx})[0]
+
+    ts = [threading.Thread(target=run, args=("a", m)),
+          threading.Thread(target=run, args=("b", clone))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    np.testing.assert_array_equal(results["a"], want)
+    np.testing.assert_array_equal(results["b"], want)

@@ -181,7 +181,7 @@ def test_lm_head_cost_vs_unfused_pair():
     """Fused blockwise LM-head xent == fc(vocab) -> classification_cost
     with the same weights, outputs AND grads (incl. through the input)."""
     paddle.topology.reset_name_scope()
-    V, D = 37, 6   # non-power-of-two vocab exercises the divisor fallback
+    V, D = 37, 6   # 37 % 8 != 0 exercises the padded last block
     x = layer.data(name="x", type=paddle.data_type.dense_vector(D))
     lab = layer.data(name="lab", type=paddle.data_type.integer_value(V))
     a = layer.classification_cost(
