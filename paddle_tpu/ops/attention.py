@@ -11,14 +11,24 @@ back-to-back in one buffer and attention never crosses a segment boundary,
 so there is no padding waste.
 
 Design notes (TPU-first):
-  - forward is a pallas kernel: grid (batch, heads, q-blocks); K/V live in
-    VMEM per (batch, head); online-softmax accumulation in fp32; matmuls hit
-    the MXU with block_q x head_dim x block_k shapes.
-  - backward is TWO pallas kernels (dK/dV gridded over key blocks, dQ over
-    query blocks) recomputing P blockwise from (q, k, lse) — the S x S score
-    matrix never exists in either direction; fp32 accumulation on the MXU.
+  - forward is a pallas kernel: grid (batch, heads, q-blocks, k-blocks) with
+    the key axis STREAMED through the grid — only one (block_q x D) and one
+    (block_k x D) tile is ever resident in VMEM, with the online-softmax
+    carry (m, l, acc) held in VMEM scratch across the key axis.  VMEM use is
+    O(block^2) at ANY sequence length (the previous design kept full-seq K/V
+    resident per grid cell and hit the 16 MB scoped-vmem wall at 8192 packed
+    tokens).  Pallas double-buffers the streamed tiles, so the K/V DMA for
+    block j+1 overlaps the block-j matmuls; matmuls hit the MXU with
+    block_q x head_dim x block_k shapes and fp32 accumulation.
+  - backward is TWO pallas kernels (dK/dV with the QUERY axis streamed
+    through the grid, dQ with the KEY axis streamed), each recomputing P
+    blockwise from (q, k, lse) — the S x S score matrix never exists in
+    either direction, and neither kernel holds a full sequence in VMEM.
     FLAGS.use_pallas=False falls back to a blockwise lax.scan in plain JAX
     with identical semantics.
+  - causal masking skips fully-masked blocks with pl.when AND clamps the
+    streamed-tile index maps, so the revisiting optimisation elides the DMA
+    for blocks that would be skipped (~half the grid for causal).
   - on CPU (tests / 8-device virtual mesh) the kernels run in interpret mode.
 """
 
@@ -30,6 +40,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
@@ -69,65 +80,120 @@ def mha_reference(q, k, v, segment_ids=None, kv_segment_ids=None,
 # Pallas forward kernel
 # ---------------------------------------------------------------------------
 
+_LANES = 128  # lane width for the (block_q, _LANES) m/l scratch carries
+
+
+def _seg_live(qseg_ref, kseg_ref, b):
+    """Runtime block-skip predicate: packed sequences give each (q, k) block
+    an id range; disjoint ranges mean no q_seg == k_seg pair exists, so the
+    whole block is dead.  Conservative (overlapping ranges without an equal
+    pair still compute), hence correct for ANY id assignment.  Forward and
+    both backward kernels MUST use this same predicate so lse is never
+    consumed by a pair the forward skipped."""
+    q_sg = qseg_ref[b, :]
+    k_sg = kseg_ref[b, :]
+    return ((jnp.max(q_sg) >= jnp.min(k_sg)) &
+            (jnp.min(q_sg) <= jnp.max(k_sg)))
+
+
+def _clamped_kv_maps(causal, block_q, block_k):
+    """Index maps for the streamed key-axis tiles on a (b, h, i, j) grid.
+    Under causal masking, clamp j to the last live key block for q block i
+    (`j*block_k < (i+1)*block_q` — the same bound the kernels' live
+    predicate uses), so skipped blocks repeat the previous index and the
+    revisiting optimisation elides their DMA entirely."""
+    if causal:
+        def kv_idx(b, h, i, j):
+            return (b, h, jnp.minimum(j, ((i + 1) * block_q - 1) // block_k),
+                    0)
+
+        def kseg_idx(b, h, i, j):
+            return (0, jnp.minimum(j, ((i + 1) * block_q - 1) // block_k))
+    else:
+        def kv_idx(b, h, i, j):
+            return (b, h, j, 0)
+
+        def kseg_idx(b, h, i, j):
+            return (0, j)
+    return kv_idx, kseg_idx
+
+
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref,
-                      lse_ref, *, block_k: int, sm_scale: float,
-                      causal: bool):
-    # q_ref: (1, 1, block_q, D); k_ref/v_ref: (1, 1, Sk, D)
-    # qseg_ref: (B, block_q); kseg_ref: (B, Sk) — full batch dim because TPU
-    # block shapes must tile (8, 128) or span the whole array dim
+                      lse_ref, m_scr, l_scr, acc_scr, *, sm_scale: float,
+                      causal: bool, num_kb: int):
+    # q_ref: (1, 1, block_q, D); k_ref/v_ref: (1, 1, block_k, D) — the key
+    # axis is the LAST grid dim, streamed; carries (m, l, acc) persist in
+    # VMEM scratch across it.  qseg_ref: (B, block_q); kseg_ref: (B, block_k)
+    # — full batch dim because TPU block shapes must tile (8, 128) or span
+    # the whole array dim.
     block_q = q_ref.shape[2]
-    head_dim = q_ref.shape[3]
-    seq_k = k_ref.shape[2]
+    block_k = k_ref.shape[2]
     b = pl.program_id(0)
     qi = pl.program_id(2)
+    j = pl.program_id(3)
 
-    q = q_ref[0, 0, :, :].astype(jnp.float32) * sm_scale
-    q_ids = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
-    q_seg = qseg_ref[b, :].reshape(block_q, 1)
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    num_kb = seq_k // block_k
+    # causal: key blocks strictly after this q block are fully masked;
+    # _seg_live skips cross-segment blocks at runtime
+    seg_live = _seg_live(qseg_ref, kseg_ref, b)
+    live = seg_live & (j * block_k < (qi + 1) * block_q) if causal \
+        else seg_live
 
-    def body(j, carry):
-        m_prev, l_prev, acc = carry
-        kb = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        vb = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0, :, :].astype(jnp.float32) * sm_scale
+        kb = k_ref[0, 0, :, :].astype(jnp.float32)
+        vb = v_ref[0, 0, :, :].astype(jnp.float32)
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        k_ids = j * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        k_seg = kseg_ref[b, pl.ds(j * block_k, block_k)]
-        mask = (q_seg == k_seg.reshape(1, block_k))
+        q_seg = qseg_ref[b, :].reshape(block_q, 1)
+        k_seg = kseg_ref[b, :].reshape(1, block_k)
+        mask = (q_seg == k_seg)
         if causal:
+            q_ids = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_ids = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
             mask = mask & (q_ids >= k_ids)
         s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
 
+        # m/l ride as (block_q, _LANES) lane-replicated values; a lane-max
+        # recovers the scalar column
+        m_prev = jnp.max(m_scr[...], axis=1, keepdims=True)
+        l_prev = jnp.max(l_scr[...], axis=1, keepdims=True)
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
-        acc = acc * alpha + jax.lax.dot_general(
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
             p, vb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return m_new, l_new, acc
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
-    m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
+    @pl.when(j == num_kb - 1)
+    def _finalize():
+        m = jnp.max(m_scr[...], axis=1, keepdims=True)
+        l = jnp.max(l_scr[...], axis=1, keepdims=True)
+        l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zeros, not NaN
+        o_ref[0, 0, :, :] = (acc_scr[...] / l).astype(o_ref.dtype)
+        lse_ref[0, 0, :, :] = m + jnp.log(l)
 
-    if causal:
-        # skip key blocks strictly after this q block
-        num_kb_eff = jnp.minimum(
-            num_kb, (qi + 1) * block_q // block_k +
-            jnp.int32(block_q % block_k != 0) + 1)
-    else:
-        num_kb_eff = num_kb
-    m, l, acc = jax.lax.fori_loop(0, num_kb_eff, body, (m0, l0, acc0))
 
-    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zeros, not NaN
-    o_ref[0, 0, :, :] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0, 0, :, :] = m + jnp.log(l)
+def _dim_semantics(grid_ndim: int, interpret: bool):
+    """Grid (batch, heads, blocks, streamed): all parallel but the last —
+    only the streamed axis carries scratch state, so megacore may split any
+    earlier dim across cores."""
+    if interpret:
+        return None  # interpret mode ignores TPU compiler params
+    sem = ("parallel",) * (grid_ndim - 1) + ("arbitrary",)
+    return pltpu.CompilerParams(dimension_semantics=sem)
 
 
 def _flash_fwd(q, k, v, q_seg, kv_seg, causal, sm_scale, block_q, block_k,
@@ -144,31 +210,37 @@ def _flash_fwd(q, k, v, q_seg, kv_seg, causal, sm_scale, block_q, block_k,
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
 
-    grid = (batch, heads, seq_q // block_q)
-    kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
-                               sm_scale=sm_scale, causal=causal)
+    num_kb = seq_k // block_k
+    kv_idx, kseg_idx = _clamped_kv_maps(causal, block_q, block_k)
+    grid = (batch, heads, seq_q // block_q, num_kb)
+    kernel = functools.partial(_flash_fwd_kernel, sm_scale=sm_scale,
+                               causal=causal, num_kb=num_kb)
     out_t, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, head_dim),
-                         lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, seq_k, head_dim),
-                         lambda b, h, i: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, seq_k, head_dim),
-                         lambda b, h, i: (b, h, 0, 0)),
-            pl.BlockSpec((batch, block_q), lambda b, h, i: (0, i)),
-            pl.BlockSpec((batch, seq_k), lambda b, h, i: (0, 0)),
+                         lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, head_dim), kv_idx),
+            pl.BlockSpec((1, 1, block_k, head_dim), kv_idx),
+            pl.BlockSpec((batch, block_q), lambda b, h, i, j: (0, i)),
+            pl.BlockSpec((batch, block_k), kseg_idx),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, head_dim),
-                         lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+                         lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((batch, heads, seq_q, head_dim), q.dtype),
             jax.ShapeDtypeStruct((batch, heads, seq_q, 1), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, head_dim), jnp.float32),
+        ],
+        compiler_params=_dim_semantics(4, interpret),
         interpret=interpret,
     )(qt, kt, vt, q_seg, kv_seg)
     return out_t.transpose(0, 2, 1, 3), lse[..., 0]
@@ -184,86 +256,100 @@ def _flash_fwd(q, k, v, q_seg, kv_seg, causal, sm_scale, block_q, block_k,
 
 
 def _flash_bwd_kv_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref,
-                         lse_ref, delta_ref, dk_ref, dv_ref, *,
-                         block_q: int, sm_scale: float, causal: bool):
-    # k_ref/v_ref: (1, 1, block_k, D); q/do: (1, 1, Sq, D);
-    # lse/delta: (1, 1, Sq, 1); qseg: (B, Sq); kseg: (B, block_k)
+                         lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                         *, sm_scale: float, causal: bool, num_qb: int):
+    # grid (B, H, k-blocks, q-blocks): the QUERY axis is streamed through
+    # the last grid dim; dk/dv accumulate in VMEM scratch across it.
+    # k_ref/v_ref: (1, 1, block_k, D); q/do: (1, 1, block_q, D);
+    # lse/delta: (1, 1, block_q, 1); qseg: (B, block_q); kseg: (B, block_k)
     block_k = k_ref.shape[2]
-    head_dim = k_ref.shape[3]
-    seq_q = q_ref.shape[2]
+    block_q = q_ref.shape[2]
     b = pl.program_id(0)
     kj = pl.program_id(2)
+    i = pl.program_id(3)
 
-    kb = k_ref[0, 0, :, :].astype(jnp.float32)
-    vb = v_ref[0, 0, :, :].astype(jnp.float32)
-    k_seg = kseg_ref[b, :].reshape(1, block_k)
-    k_ids = kj * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
-    num_qb = seq_q // block_q
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    def body(i, carry):
-        dk, dv = carry
-        qb = q_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        dob = do_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lseb = lse_ref[0, 0, pl.ds(i * block_q, block_q), :]
-        deltab = delta_ref[0, 0, pl.ds(i * block_q, block_q), :]
-        q_seg = qseg_ref[b, pl.ds(i * block_q, block_q)].reshape(block_q, 1)
+    # causal: q blocks whose last row precedes this k block are fully
+    # masked; _seg_live skips cross-segment blocks at runtime
+    seg_live = _seg_live(qseg_ref, kseg_ref, b)
+    live = seg_live & ((i + 1) * block_q > kj * block_k) if causal \
+        else seg_live
+
+    @pl.when(live)
+    def _compute():
+        kb = k_ref[0, 0, :, :].astype(jnp.float32)
+        vb = v_ref[0, 0, :, :].astype(jnp.float32)
+        qb = q_ref[0, 0, :, :].astype(jnp.float32)
+        dob = do_ref[0, 0, :, :].astype(jnp.float32)
+        lseb = lse_ref[0, 0, :, :]
+        deltab = delta_ref[0, 0, :, :]
+        q_seg = qseg_ref[b, :].reshape(block_q, 1)
+        k_seg = kseg_ref[b, :].reshape(1, block_k)
         s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         mask = q_seg == k_seg
         if causal:
             q_ids = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
+            k_ids = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
             mask = mask & (q_ids >= k_ids)
         p = jnp.where(mask, jnp.exp(s - lseb), 0.0)
-        dv = dv + jax.lax.dot_general(p, dob, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
+        dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
+            p, dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - deltab) * sm_scale
-        dk = dk + jax.lax.dot_general(ds, qb, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
-        return dk, dv
+        dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    if causal:
-        # q blocks strictly before this k block are fully masked
-        start_qb = (kj * block_k) // block_q
-    else:
-        start_qb = 0
-    dk0 = jnp.zeros((block_k, head_dim), jnp.float32)
-    dv0 = jnp.zeros((block_k, head_dim), jnp.float32)
-    dk, dv = jax.lax.fori_loop(start_qb, num_qb, body, (dk0, dv0))
-    dk_ref[0, 0, :, :] = dk.astype(dk_ref.dtype)
-    dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
+    @pl.when(i == num_qb - 1)
+    def _finalize():
+        dk_ref[0, 0, :, :] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref,
-                         lse_ref, delta_ref, dq_ref, *, block_k: int,
-                         sm_scale: float, causal: bool):
-    # q/do/lse/delta blocked over q; k/v full-seq per (b, h)
+                         lse_ref, delta_ref, dq_ref, dq_scr, *,
+                         sm_scale: float, causal: bool, num_kb: int):
+    # grid (B, H, q-blocks, k-blocks): the KEY axis is streamed through the
+    # last grid dim; dq accumulates in VMEM scratch across it.
     block_q = q_ref.shape[2]
-    head_dim = q_ref.shape[3]
-    seq_k = k_ref.shape[2]
+    block_k = k_ref.shape[2]
     b = pl.program_id(0)
     qi = pl.program_id(2)
+    j = pl.program_id(3)
 
-    qb = q_ref[0, 0, :, :].astype(jnp.float32)
-    dob = do_ref[0, 0, :, :].astype(jnp.float32)
-    lseb = lse_ref[0, 0, :, :]
-    deltab = delta_ref[0, 0, :, :]
-    q_seg = qseg_ref[b, :].reshape(block_q, 1)
-    q_ids = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
-    num_kb = seq_k // block_k
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    def body(j, dq):
-        kb = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        vb = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        k_seg = kseg_ref[b, pl.ds(j * block_k, block_k)].reshape(1, block_k)
+    seg_live = _seg_live(qseg_ref, kseg_ref, b)
+    live = seg_live & (j * block_k < (qi + 1) * block_q) if causal \
+        else seg_live
+
+    @pl.when(live)
+    def _compute():
+        qb = q_ref[0, 0, :, :].astype(jnp.float32)
+        dob = do_ref[0, 0, :, :].astype(jnp.float32)
+        lseb = lse_ref[0, 0, :, :]
+        deltab = delta_ref[0, 0, :, :]
+        kb = k_ref[0, 0, :, :].astype(jnp.float32)
+        vb = v_ref[0, 0, :, :].astype(jnp.float32)
+        q_seg = qseg_ref[b, :].reshape(block_q, 1)
+        k_seg = kseg_ref[b, :].reshape(1, block_k)
         s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         mask = q_seg == k_seg
         if causal:
+            q_ids = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
             k_ids = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             mask = mask & (q_ids >= k_ids)
@@ -271,18 +357,13 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref,
         dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - deltab) * sm_scale
-        return dq + jax.lax.dot_general(ds, kb, (((1,), (0,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
+        dq_scr[...] = dq_scr[...] + jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    if causal:
-        num_kb_eff = jnp.minimum(
-            num_kb, (qi + 1) * block_q // block_k +
-            jnp.int32(block_q % block_k != 0) + 1)
-    else:
-        num_kb_eff = num_kb
-    dq = jax.lax.fori_loop(0, num_kb_eff, body,
-                           jnp.zeros((block_q, head_dim), jnp.float32))
-    dq_ref[0, 0, :, :] = dq.astype(dq_ref.dtype)
+    @pl.when(j == num_kb - 1)
+    def _finalize():
+        dq_ref[0, 0, :, :] = dq_scr[...].astype(dq_ref.dtype)
 
 
 def _flash_bwd_pallas(res, do, *, causal, sm_scale, block_q, block_k,
@@ -292,6 +373,8 @@ def _flash_bwd_pallas(res, do, *, causal, sm_scale, block_q, block_k,
     seq_k = k.shape[1]
     block_q = min(block_q, seq_q)
     block_k = min(block_k, seq_k)
+    num_qb = seq_q // block_q
+    num_kb = seq_k // block_k
 
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
@@ -302,40 +385,81 @@ def _flash_bwd_pallas(res, do, *, causal, sm_scale, block_q, block_k,
                     axis=-1, keepdims=True)               # (B, H, Sq, 1)
     lse_t = lse[..., None]                                # (B, H, Sq, 1)
 
-    full_q = pl.BlockSpec((1, 1, seq_q, head_dim), lambda b, h, i: (b, h, 0, 0))
-    full_q1 = pl.BlockSpec((1, 1, seq_q, 1), lambda b, h, i: (b, h, 0, 0))
-    blk_q = pl.BlockSpec((1, 1, block_q, head_dim), lambda b, h, i: (b, h, i, 0))
-    blk_q1 = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0))
-    full_k = pl.BlockSpec((1, 1, seq_k, head_dim), lambda b, h, i: (b, h, 0, 0))
-    blk_k = pl.BlockSpec((1, 1, block_k, head_dim), lambda b, h, i: (b, h, i, 0))
-    qseg_all = pl.BlockSpec((batch, seq_q), lambda b, h, i: (0, 0))
-    qseg_blk = pl.BlockSpec((batch, block_q), lambda b, h, i: (0, i))
-    kseg_all = pl.BlockSpec((batch, seq_k), lambda b, h, i: (0, 0))
-    kseg_blk = pl.BlockSpec((batch, block_k), lambda b, h, i: (0, i))
+    # --- dK/dV: grid (B, H, k-blocks, q-blocks), query axis streamed ---
+    if causal:
+        # clamp the streamed q-tile index so fully-masked q blocks (strictly
+        # before the k block) don't re-DMA; pl.when skips their compute
+        def q_idx(b, h, kj, i):
+            return (b, h, jnp.maximum(i, (kj * block_k) // block_q), 0)
+
+        def qseg_idx(b, h, kj, i):
+            return (0, jnp.maximum(i, (kj * block_k) // block_q))
+    else:
+        def q_idx(b, h, kj, i):
+            return (b, h, i, 0)
+
+        def qseg_idx(b, h, kj, i):
+            return (0, i)
 
     dk_t, dv_t = pl.pallas_call(
-        functools.partial(_flash_bwd_kv_kernel, block_q=block_q,
-                          sm_scale=sm_scale, causal=causal),
-        grid=(batch, heads, seq_k // block_k),
-        in_specs=[full_q, blk_k, blk_k, qseg_all, kseg_blk, full_q,
-                  full_q1, full_q1],
-        out_specs=[blk_k, blk_k],
+        functools.partial(_flash_bwd_kv_kernel, sm_scale=sm_scale,
+                          causal=causal, num_qb=num_qb),
+        grid=(batch, heads, num_kb, num_qb),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, head_dim), q_idx),
+            pl.BlockSpec((1, 1, block_k, head_dim),
+                         lambda b, h, kj, i: (b, h, kj, 0)),
+            pl.BlockSpec((1, 1, block_k, head_dim),
+                         lambda b, h, kj, i: (b, h, kj, 0)),
+            pl.BlockSpec((batch, block_q), qseg_idx),
+            pl.BlockSpec((batch, block_k), lambda b, h, kj, i: (0, kj)),
+            pl.BlockSpec((1, 1, block_q, head_dim), q_idx),
+            pl.BlockSpec((1, 1, block_q, 1), q_idx),
+            pl.BlockSpec((1, 1, block_q, 1), q_idx),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, head_dim),
+                         lambda b, h, kj, i: (b, h, kj, 0)),
+            pl.BlockSpec((1, 1, block_k, head_dim),
+                         lambda b, h, kj, i: (b, h, kj, 0)),
+        ],
         out_shape=[
             jax.ShapeDtypeStruct((batch, heads, seq_k, head_dim), k.dtype),
             jax.ShapeDtypeStruct((batch, heads, seq_k, head_dim), v.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, head_dim), jnp.float32),
+            pltpu.VMEM((block_k, head_dim), jnp.float32),
+        ],
+        compiler_params=_dim_semantics(4, interpret),
         interpret=interpret,
     )(qt, kt, vt, q_seg, kv_seg, dot, lse_t, delta)
 
+    # --- dQ: grid (B, H, q-blocks, k-blocks), key axis streamed ---
+    kv_idx, kseg_idx = _clamped_kv_maps(causal, block_q, block_k)
+    blk_q = pl.BlockSpec((1, 1, block_q, head_dim),
+                         lambda b, h, i, j: (b, h, i, 0))
+    blk_q1 = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
+
     dq_t = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
-                          sm_scale=sm_scale, causal=causal),
-        grid=(batch, heads, seq_q // block_q),
-        in_specs=[blk_q, full_k, full_k, qseg_blk, kseg_all, blk_q,
-                  blk_q1, blk_q1],
+        functools.partial(_flash_bwd_dq_kernel, sm_scale=sm_scale,
+                          causal=causal, num_kb=num_kb),
+        grid=(batch, heads, num_qb, num_kb),
+        in_specs=[
+            blk_q,
+            pl.BlockSpec((1, 1, block_k, head_dim), kv_idx),
+            pl.BlockSpec((1, 1, block_k, head_dim), kv_idx),
+            pl.BlockSpec((batch, block_q), lambda b, h, i, j: (0, i)),
+            pl.BlockSpec((batch, block_k), kseg_idx),
+            blk_q,
+            blk_q1,
+            blk_q1,
+        ],
         out_specs=blk_q,
         out_shape=jax.ShapeDtypeStruct((batch, heads, seq_q, head_dim),
                                        q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
+        compiler_params=_dim_semantics(4, interpret),
         interpret=interpret,
     )(qt, kt, vt, q_seg, kv_seg, dot, lse_t, delta)
 
@@ -427,7 +551,8 @@ _flash_attention.defvjp(_fwd_rule, _bwd_rule)
 
 def flash_attention(q, k, v, segment_ids=None, kv_segment_ids=None,
                     causal: bool = False, sm_scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: Optional[bool] = None):
     """Blockwise multi-head attention (pallas forward, blockwise backward).
 
@@ -444,8 +569,31 @@ def flash_attention(q, k, v, segment_ids=None, kv_segment_ids=None,
         sm_scale = float(q.shape[-1]) ** -0.5
     if interpret is None:
         interpret = _interpret_default()
+    # FLAGS.attn_block retunes the DEFAULT tile edge only — call sites that
+    # chose their blocks explicitly (ring/ulysses shard-sized tiles, tests)
+    # are never trampled.  The auto default picks the largest tile that
+    # divides the sequence: streaming keeps VMEM at O(block^2), so big tiles
+    # are free memory-wise and each grid cell amortizes its fixed cost over
+    # 16x more MXU work than a 128 tile (measured: 128 tiles at seq 4096 =
+    # 32k grid cells of ~760ns overhead each, dwarfing the matmuls).
+    from paddle_tpu.platform.flags import FLAGS
+
+    def _auto_block(seq):
+        # the flag retunes the preferred edge but still falls through the
+        # ladder when it doesn't divide this call's sequence (a global flag
+        # must never crash an oddly-sized layer the auto path handles)
+        preferred = (int(FLAGS.attn_block),) if FLAGS.attn_block else ()
+        for edge in preferred + (512, 256, 128):
+            if seq % edge == 0:
+                return edge
+        return 128  # small/ragged seqs: min() below clamps to seq
+
     batch, seq_q = q.shape[0], q.shape[1]
     seq_k = k.shape[1]
+    if block_q is None:
+        block_q = _auto_block(seq_q)
+    if block_k is None:
+        block_k = _auto_block(seq_k)
     if segment_ids is None:
         q_seg = jnp.zeros((batch, seq_q), jnp.int32)
         kv_seg = jnp.zeros((batch, seq_k), jnp.int32)

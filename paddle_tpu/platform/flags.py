@@ -101,6 +101,11 @@ FLAGS.define("bf16_dense_activations", False,
              "residual stream) in bfloat16. Norm statistics and losses "
              "still reduce in f32. Off by default: flip for bandwidth-"
              "bound dense models. Only active when use_bf16 is also on.")
+FLAGS.define("attn_block", 0,
+             "flash-attention tile edge (query AND key block size). 0 = "
+             "per-call defaults (128). Larger tiles amortize per-block "
+             "overhead; VMEM use is O(block^2) so 256/512 still fit.",
+             parser=int)
 FLAGS.define("save_dir", "./output", "default checkpoint output directory")
 FLAGS.define("log_level", "INFO", "logging level")
 FLAGS.define("prealloc_mem", False, "let XLA preallocate the whole HBM arena")
