@@ -286,7 +286,7 @@ def worker_lstm():
             out[key.replace("_ms", "_error")] = repr(e)
             continue
         print(json.dumps(out), flush=True)
-    print(json.dumps(out))
+    print(json.dumps(out), flush=True)
 
 
 def worker_convnets():
@@ -310,7 +310,7 @@ def worker_convnets():
         out[f"{key}_ms"] = ms
         out[f"{key}_vs_baseline"] = round(base / ms, 1)
         print(json.dumps(out), flush=True)  # incremental (relay hang rule)
-    print(json.dumps(out))
+    print(json.dumps(out), flush=True)
 
 
 def worker_transformer():
@@ -430,7 +430,20 @@ def worker_transformer():
             out["transformer_seq2048_remat_mfu"] = lc["transformer_mfu"]
     except Exception as e:
         out["transformer_seq2048_remat_error"] = repr(e)
-    print(json.dumps(out))
+    print(json.dumps(out), flush=True)
+    try:  # single-sequence long-context tier: 8192 tokens in ONE segment
+        # (not 8 packed ones), the shape the streamed flash kernels
+        # unlocked — the round-4 kernels hit the 16MB scoped-vmem wall
+        # here; remat caps saved activations per block
+        lc8 = measure(d=d_used, layers=8, heads=16, seq=8192, bs=1,
+                      remat=True, iters=4)
+        out["transformer_seq8192_remat_tokens_per_sec"] = \
+            lc8["transformer_tokens_per_sec"]
+        if "transformer_mfu" in lc8:
+            out["transformer_seq8192_remat_mfu"] = lc8["transformer_mfu"]
+    except Exception as e:
+        out["transformer_seq8192_remat_error"] = repr(e)
+    print(json.dumps(out), flush=True)
 
 
 def worker_attention():
@@ -643,7 +656,7 @@ def worker_moe():
         out["moe_vs_dense_step_ratio"] = round(sec / dense_sec, 3)
     except Exception as e:
         out["moe_dense_twin_error"] = repr(e)
-    print(json.dumps(out))
+    print(json.dumps(out), flush=True)
 
 
 def worker_probe():

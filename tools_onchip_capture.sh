@@ -11,7 +11,10 @@ date >> "$OUT"
 if ! timeout 120 python bench.py --worker probe >> "$OUT" 2>/tmp/onchip_err.txt; then
   echo "probe failed -- relay still down" | tee -a "$OUT"; exit 1
 fi
-for w in transformer resnet50 lstm convnets alexnet attention moe; do
+# order = what's missing or stale first: the transformer re-measures the
+# streaming-kernel bs8 tier, attention re-measures at auto-512 tiles, moe
+# has never produced a row; the already-fresh tables go last
+for w in transformer attention moe resnet50 lstm convnets alexnet; do
   echo "== $w ==" >> "$OUT"
   timeout 600 python bench.py --worker "$w" >> "$OUT" 2>>/tmp/onchip_err.txt
   echo "rc=$? for $w" >> "$OUT"
