@@ -444,6 +444,21 @@ def worker_transformer():
     except Exception as e:
         out["transformer_seq8192_remat_error"] = repr(e)
     print(json.dumps(out), flush=True)
+    try:  # layer ablation: (t8 - t4)/4 = marginal ms per block, and
+        # t8 - 8*marginal = fixed cost (embedding + LM head + optimizer +
+        # dispatch). The profiler-free split of where the step time goes
+        # (traces hang the relay — BENCH_NOTES methodology). L=4 rather
+        # than L=16 so the ablation never OOMs a config the headline fit.
+        l4 = measure(d=d_used, layers=4, heads=16, seq=1024, bs=bs_used,
+                     remat=remat_used, iters=4)
+        t8 = out["transformer_ms_per_batch"]
+        t4 = l4["transformer_ms_per_batch"]
+        per_block = (t8 - t4) / 4.0
+        out["transformer_ablation_ms_per_block"] = round(per_block, 2)
+        out["transformer_ablation_fixed_ms"] = round(t8 - 8 * per_block, 2)
+    except Exception as e:
+        out["transformer_ablation_error"] = repr(e)
+    print(json.dumps(out), flush=True)
 
 
 def worker_attention():
