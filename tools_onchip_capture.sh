@@ -13,10 +13,13 @@ if ! timeout 120 python bench.py --worker probe >> "$OUT" 2>/tmp/onchip_err.txt;
 fi
 # order = what's missing or stale first: the transformer re-measures the
 # streaming-kernel bs8 tier, attention re-measures at auto-512 tiles, moe
-# has never produced a row; the already-fresh tables go last
-for w in transformer attention moe resnet50 lstm convnets alexnet; do
+# has never produced a row; the already-fresh tables go last. Workers
+# with full-table sweeps get a bigger budget (every row prints
+# incrementally, so a timeout only loses not-yet-measured rows).
+for spec in transformer:900 attention:600 moe:600 resnet50:600 lstm:900 convnets:900 alexnet:900; do
+  w="${spec%%:*}"; t="${spec##*:}"
   echo "== $w ==" >> "$OUT"
-  timeout 600 python bench.py --worker "$w" >> "$OUT" 2>>/tmp/onchip_err.txt
+  BENCH_FULL_SWEEP=1 timeout "$t" python bench.py --worker "$w" >> "$OUT" 2>>/tmp/onchip_err.txt
   echo "rc=$? for $w" >> "$OUT"
 done
 echo "done; results in $OUT"
