@@ -41,6 +41,8 @@ def _full_sweep() -> bool:
     The driver's plain `python bench.py` keeps its original duration so
     the 900s global deadline still reaches every worker."""
     return os.environ.get("BENCH_FULL_SWEEP", "") == "1"
+
+
 ALEXNET_BASELINE_MS = 334.0   # reference Paddle, AlexNet bs=128, K40m
 LSTM_BASELINE_MS = 184.0      # reference Paddle, IMDB LSTM h=512 bs=64, K40m
 
@@ -576,7 +578,7 @@ def worker_attention():
             "bwd_pallas_ms": round(bwd_pallas * 1000, 3),
             "bwd_plain_jax_ms": round(bwd_plain * 1000, 3),
             "bwd_speedup": round(bwd_plain / bwd_pallas, 2),
-        }}))
+        }}), flush=True)
 
 
 def worker_scaling():
@@ -656,7 +658,7 @@ def worker_scaling():
                       "not chip timing; a lower bound on real-chip DP "
                       "efficiency. This JSON field is the one canonical "
                       "number for this metric (BENCH_NOTES quotes it).",
-        }}))
+        }}), flush=True)
 
 
 def worker_moe():
@@ -729,7 +731,8 @@ def worker_probe():
     kind = jax.devices()[0].device_kind
     x = jnp.ones((256, 256), jnp.bfloat16)
     v = float((x @ x).sum())
-    print(json.dumps({"probe_device_kind": kind, "probe_ok": v > 0}))
+    print(json.dumps({"probe_device_kind": kind, "probe_ok": v > 0}),
+          flush=True)
 
 
 WORKERS = {

@@ -146,11 +146,17 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref,
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0, 0, :, :].astype(jnp.float32) * sm_scale
-        kb = k_ref[0, 0, :, :].astype(jnp.float32)
-        vb = v_ref[0, 0, :, :].astype(jnp.float32)
+        # MXU inputs stay in the tiles' native dtype (bf16 under the
+        # global compute policy; f32 in f32 models/tests) with f32
+        # accumulation — an .astype(f32) before the dot would force the
+        # ~4x-slower f32 MXU path. sm_scale is applied to the f32 product
+        # (same math as pre-scaling q, better bf16 precision).
+        q = q_ref[0, 0, :, :]
+        kb = k_ref[0, 0, :, :]
+        vb = v_ref[0, 0, :, :]
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        s = s * sm_scale
         q_seg = qseg_ref[b, :].reshape(block_q, 1)
         k_seg = kseg_ref[b, :].reshape(1, block_k)
         mask = (q_seg == k_seg)
@@ -172,7 +178,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref,
         p = jnp.exp(s - m_new)
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
-            p, vb, (((1,), (0,)), ((), ())),
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
@@ -281,10 +287,11 @@ def _flash_bwd_kv_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref,
 
     @pl.when(live)
     def _compute():
-        kb = k_ref[0, 0, :, :].astype(jnp.float32)
-        vb = v_ref[0, 0, :, :].astype(jnp.float32)
-        qb = q_ref[0, 0, :, :].astype(jnp.float32)
-        dob = do_ref[0, 0, :, :].astype(jnp.float32)
+        # native-dtype MXU operands, f32 accumulation (see forward kernel)
+        kb = k_ref[0, 0, :, :]
+        vb = v_ref[0, 0, :, :]
+        qb = q_ref[0, 0, :, :]
+        dob = do_ref[0, 0, :, :]
         lseb = lse_ref[0, 0, :, :]
         deltab = delta_ref[0, 0, :, :]
         q_seg = qseg_ref[b, :].reshape(block_q, 1)
@@ -300,13 +307,13 @@ def _flash_bwd_kv_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref,
             mask = mask & (q_ids >= k_ids)
         p = jnp.where(mask, jnp.exp(s - lseb), 0.0)
         dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
-            p, dob, (((0,), (0,)), ((), ())),
+            p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - deltab) * sm_scale
         dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
-            ds, qb, (((0,), (0,)), ((), ())),
+            ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(i == num_qb - 1)
@@ -336,12 +343,13 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref,
 
     @pl.when(live)
     def _compute():
-        qb = q_ref[0, 0, :, :].astype(jnp.float32)
-        dob = do_ref[0, 0, :, :].astype(jnp.float32)
+        # native-dtype MXU operands, f32 accumulation (see forward kernel)
+        qb = q_ref[0, 0, :, :]
+        dob = do_ref[0, 0, :, :]
         lseb = lse_ref[0, 0, :, :]
         deltab = delta_ref[0, 0, :, :]
-        kb = k_ref[0, 0, :, :].astype(jnp.float32)
-        vb = v_ref[0, 0, :, :].astype(jnp.float32)
+        kb = k_ref[0, 0, :, :]
+        vb = v_ref[0, 0, :, :]
         q_seg = qseg_ref[b, :].reshape(block_q, 1)
         k_seg = kseg_ref[b, :].reshape(1, block_k)
         s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
@@ -358,7 +366,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref,
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - deltab) * sm_scale
         dq_scr[...] = dq_scr[...] + jax.lax.dot_general(
-            ds, kb, (((1,), (0,)), ((), ())),
+            ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(j == num_kb - 1)
@@ -590,6 +598,13 @@ def flash_attention(q, k, v, segment_ids=None, kv_segment_ids=None,
 
     batch, seq_q = q.shape[0], q.shape[1]
     seq_k = k.shape[1]
+    # the kernels feed operands to the MXU in their native dtype (no f32
+    # upcast), which requires uniform q/k/v dtypes — normalize mixed-dtype
+    # calls (e.g. a bf16 query against an f32 KV cache) to q's dtype here
+    if k.dtype != q.dtype:
+        k = k.astype(q.dtype)
+    if v.dtype != q.dtype:
+        v = v.astype(q.dtype)
     if block_q is None:
         block_q = _auto_block(seq_q)
     if block_k is None:
