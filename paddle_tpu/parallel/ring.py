@@ -127,7 +127,8 @@ def ring_attention(q, k, v, mesh, axis: str = "seq", segment_ids=None,
 
 def ulysses_attention(q, k, v, mesh, axis: str = "seq", segment_ids=None,
                       causal: bool = False, sm_scale: Optional[float] = None,
-                      block_q: int = 128, block_k: int = 128,
+                      block_q: Optional[int] = None,
+                      block_k: Optional[int] = None,
                       interpret: Optional[bool] = None):
     """DeepSpeed-Ulysses-style sequence parallelism.
 
