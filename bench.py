@@ -754,8 +754,59 @@ def worker_probe():
           flush=True)
 
 
+def worker_matmul():
+    """Achievable dense-MFU ceiling on this chip: chained bf16 matmuls at
+    the transformer's dominant shapes. Calibrates the roofline the model
+    MFU numbers are judged against — if [4096,2048]x[2048,8192] tops out
+    at X, a model step cannot beat X and the gap model-vs-X is what
+    optimization can actually recover."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    _init_paddle()
+    kind = jax.devices()[0].device_kind
+    peak = _peak_for(kind)
+    rng = np.random.RandomState(0)
+    out = {}
+    for label, (m, k_, n) in (("ffn", (4096, 2048, 8192)),
+                              ("proj", (4096, 2048, 2048)),
+                              ("lmhead", (4096, 2048, 32768))):
+        a = jnp.asarray(rng.randn(m, k_).astype(np.float32),
+                        dtype=jnp.bfloat16)
+        b = jnp.asarray(rng.randn(k_, n).astype(np.float32),
+                        dtype=jnp.bfloat16)
+
+        @jax.jit
+        def chain(a, b):
+            # 8 dependent matmuls so dispatch/transfer amortizes; the next
+            # input reduces over ALL output columns (n is a multiple of k)
+            # so XLA cannot dead-code-eliminate any part of the dot — a
+            # plain slice would let it compute only the kept columns
+            x = a
+            for _ in range(8):
+                y = jax.lax.dot(x, b, preferred_element_type=jnp.float32)
+                x = y.reshape(m, n // k_, k_).sum(axis=1).astype(jnp.bfloat16)
+            return x
+
+        float(jnp.asarray(chain(a, b)).ravel()[0])  # compile
+        float(jnp.asarray(chain(a, b)).ravel()[0])  # warm
+        iters = 5
+        start = time.perf_counter()
+        for _ in range(iters):
+            x = chain(a, b)
+        float(jnp.asarray(x).ravel()[0])
+        sec = (time.perf_counter() - start) / iters
+        flops = 8 * 2.0 * m * k_ * n
+        out[f"matmul_{label}_tflops"] = round(flops / sec / 1e12, 1)
+        out[f"matmul_{label}_mfu"] = round(flops / sec / peak, 3)
+        print(json.dumps(out), flush=True)
+    print(json.dumps(out), flush=True)
+
+
 WORKERS = {
     "probe": worker_probe,
+    "matmul": worker_matmul,
     "resnet50": worker_resnet50,
     "alexnet": worker_alexnet,
     "lstm": worker_lstm,

@@ -16,7 +16,7 @@ fi
 # has never produced a row; the already-fresh tables go last. Workers
 # with full-table sweeps get a bigger budget (every row prints
 # incrementally, so a timeout only loses not-yet-measured rows).
-for spec in transformer:900 attention:600 moe:600 resnet50:600 lstm:900 convnets:900 alexnet:900; do
+for spec in transformer:900 matmul:300 attention:600 moe:600 resnet50:600 lstm:900 convnets:900 alexnet:900; do
   w="${spec%%:*}"; t="${spec##*:}"
   echo "== $w ==" >> "$OUT"
   BENCH_FULL_SWEEP=1 timeout "$t" python bench.py --worker "$w" >> "$OUT" 2>>/tmp/onchip_err.txt

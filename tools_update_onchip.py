@@ -18,7 +18,7 @@ import time
 
 KEEP_PREFIXES = (
     "transformer_", "resnet50_", "lstm_", "googlenet_", "smallnet_",
-    "alexnet_", "attention_", "moe_", "batch", "device_kind",
+    "alexnet_", "attention_", "moe_", "matmul_", "batch", "device_kind",
     "peak_tflops_assumed", "flops_source",
 )
 
