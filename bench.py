@@ -493,6 +493,54 @@ def worker_transformer():
     except Exception as e:
         out["transformer_seq8192_remat_error"] = repr(e)
     print(json.dumps(out), flush=True)
+    try:  # best-known combo for the MFU headline: the largest batch with
+        # the bf16 residual stream (halves saved activations, so plain
+        # bs8 may fit where f32 OOM'd; measured faster at bs4 both
+        # windows), falling back to +remat. Reported as transformer_best_*
+        # with its exact config — the number to quote for the >=0.40 gate.
+        from paddle_tpu.platform.flags import FLAGS
+
+        # candidate pool: the bf16-resid variant already measured at the
+        # headline config, plus the d2048 bs8 attempts (skipping any combo
+        # the variant already covers so 'best' can never silently be a
+        # strictly worse config)
+        cands = []
+        if "transformer_bf16_resid_tokens_per_sec" in out:
+            cands.append((out.get("transformer_bf16_resid_mfu"),
+                          out["transformer_bf16_resid_tokens_per_sec"],
+                          f"d{d_used} bs{bs_used} bf16resid"
+                          + (" remat" if remat_used else "")))
+        FLAGS.bf16_dense_activations = True
+        try:
+            for bs_b, remat_b in ((8, False), (8, True)):
+                if d_used == 2048 and bs_b == bs_used \
+                        and remat_b == remat_used:
+                    continue  # the variant above IS this combo
+                try:
+                    r = measure(d=2048, layers=8, heads=16, seq=1024,
+                                bs=bs_b, remat=remat_b, iters=6)
+                    cands.append((r.get("transformer_mfu"),
+                                  r["transformer_tokens_per_sec"],
+                                  f"d2048 bs{bs_b} bf16resid"
+                                  + (" remat" if remat_b else "")))
+                    break
+                except Exception as e:
+                    out["transformer_best_attempt_error"] = repr(e)
+        finally:
+            FLAGS.bf16_dense_activations = False
+        if cands:
+            # the gate metric is MFU; tokens/sec breaks ties (and orders
+            # candidates whose cost analysis failed)
+            mfu_b, tps_b, cfg_b = max(
+                cands, key=lambda c: (c[0] if c[0] is not None else -1.0,
+                                      c[1]))
+            out["transformer_best_tokens_per_sec"] = tps_b
+            out["transformer_best_config"] = cfg_b
+            if mfu_b is not None:
+                out["transformer_best_mfu"] = mfu_b
+    except Exception as e:
+        out["transformer_best_error"] = repr(e)
+    print(json.dumps(out), flush=True)
     try:  # layer ablation: (t8 - t4)/4 = marginal ms per block, and
         # t8 - 8*marginal = fixed cost (embedding + LM head + optimizer +
         # dispatch). The profiler-free split of where the step time goes
