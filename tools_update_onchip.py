@@ -49,10 +49,12 @@ def main() -> int:
                             cwd=os.path.dirname(os.path.abspath(__file__))
                             ).stdout.strip()
     out = {
-        "note": "Numbers measured on the real TPU chip; surfaced by "
-                "bench.py ONLY when the relay is unreachable at bench "
-                "time, and NOT from that run. Update or delete when "
-                "re-measured.",
+        "note": "Numbers measured on the real TPU chip in an earlier "
+                "capture window, NOT from the bench run that surfaced "
+                "them. bench.py attaches this block when the relay is "
+                "unreachable at bench time OR some workers could not "
+                "run within its deadline; per-worker fields the run DID "
+                "measure fresh appear at top level and take precedence.",
         "measured_on": time.strftime("%Y-%m-%d"),
         "code_state": f"commit {commit}",
         **merged,
