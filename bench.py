@@ -723,6 +723,74 @@ def worker_scaling():
         }}), flush=True)
 
 
+def worker_zero1():
+    """ZeRO-1 sharded weight update (arXiv 2004.13336) vs the replicated
+    optimizer path on the serialized virtual-8 CPU mesh: same ResNet DP
+    train step, zero_stage 0 vs 1. Reports per-chip optimizer-state bytes
+    (exact, from the slot arrays' shard shapes — the N x HBM headroom
+    claim) and the step-time delta (PROXY ONLY on the contended single
+    host core: the 8 partitions run serially, so the reduce-scatter/
+    all-gather pair shows up as overhead here while on real ICI it
+    REPLACES the grad all-reduce)."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer, trainer
+    from paddle_tpu.models import resnet
+    from paddle_tpu.parallel import make_mesh, opt_state_bytes_per_device
+
+    batch, img, depth = 32, 48, 18
+    devs = jax.devices()
+    assert len(devs) >= 8, f"need 8 virtual devices, have {len(devs)}"
+
+    def build(zero, opt_factory):
+        paddle.topology.reset_name_scope()
+        images, label, logits, cost = resnet.build(depth=depth, img_size=img,
+                                                   num_classes=100)
+        params = paddle.Parameters.from_topology(
+            paddle.topology.Topology([cost]), seed=0)
+        return trainer.SGD(cost=cost, parameters=params,
+                           update_equation=opt_factory(),
+                           mesh=make_mesh((8,), ("data",), devs[:8]),
+                           zero=zero)
+
+    def time_step(sgd, iters=3):
+        rng = np.random.RandomState(0)
+        feeds = sgd._shard_feeds({
+            "image": rng.randn(batch, img, img, 3).astype(np.float32),
+            "label": rng.randint(0, 100, size=batch).astype(np.int32),
+        })
+        args = _step_args(sgd, feeds)
+        step, _ = _aot_compile(sgd._build_step(), args)
+        return _time_steps(step, args, iters=iters)
+
+    momentum = lambda: optimizer.Momentum(momentum=0.9, learning_rate=0.01)
+    out = {"zero1_model": f"resnet{depth}_img{img}_bs{batch}_mesh8"}
+    s0 = build(0, momentum)
+    out["zero0_opt_state_bytes_per_chip"] = opt_state_bytes_per_device(
+        s0.opt_state["slots"])
+    out["zero0_step_ms"] = round(time_step(s0) * 1000, 3)
+    print(json.dumps(out), flush=True)  # headline before the zero1 twin
+    del s0
+    s1 = build(1, momentum)
+    out["zero1_opt_state_bytes_per_chip"] = opt_state_bytes_per_device(
+        s1.opt_state["slots"])
+    out["zero1_step_ms"] = round(time_step(s1) * 1000, 3)
+    out["zero1_opt_state_reduction"] = round(
+        out["zero0_opt_state_bytes_per_chip"]
+        / max(1, out["zero1_opt_state_bytes_per_chip"]), 2)
+    print(json.dumps(out), flush=True)
+    del s1
+    # Adam doubles the slot set — the config where the N x matters most
+    adam = lambda: optimizer.Adam(learning_rate=1e-3)
+    out["zero0_adam_opt_state_bytes_per_chip"] = opt_state_bytes_per_device(
+        build(0, adam).opt_state["slots"])
+    out["zero1_adam_opt_state_bytes_per_chip"] = opt_state_bytes_per_device(
+        build(1, adam).opt_state["slots"])
+    print(json.dumps(out), flush=True)
+
+
 def worker_moe():
     """MoE transformer LM vs its dense twin on one chip: single-chip
     Switch-style MoE (top-1 routing, dense dispatch formulation) at the
@@ -876,6 +944,7 @@ WORKERS = {
     "transformer": worker_transformer,
     "attention": worker_attention,
     "scaling": worker_scaling,
+    "zero1": worker_zero1,
     "moe": worker_moe,
 }
 
@@ -960,12 +1029,13 @@ def main():
     errors = {}
 
     # cheap + hardware-independent first: never starved by a dead tunnel
-    out, err = _run_worker("scaling", deadline, cpu=True,
-                           attempt_timeout=380, max_attempts=1)
-    if out:
-        record.update(out)
-    else:
-        errors["scaling"] = err
+    for cpu_worker in ("scaling", "zero1"):
+        out, err = _run_worker(cpu_worker, deadline, cpu=True,
+                               attempt_timeout=380, max_attempts=1)
+        if out:
+            record.update(out)
+        else:
+            errors[cpu_worker] = err
 
     # fast liveness probe: a dead TPU tunnel HANGS (round-1 failure mode);
     # fail it fast rather than crawling through per-model retries

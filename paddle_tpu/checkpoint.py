@@ -67,7 +67,15 @@ def pass_dir(root: str, pass_id: int) -> str:
 
 def save_checkpoint(root: str, pass_id: int, parameters: Parameters,
                     opt_state: Any = None, model_state: Any = None,
-                    extra_meta: Optional[Dict] = None) -> str:
+                    extra_meta: Optional[Dict] = None,
+                    shard_plan: Any = None) -> str:
+    """``shard_plan`` (a ``parallel.zero.ZeroPlan``): when the trainer runs
+    ZeRO-1, slot state lives as padded 1/N flat shards per replica; the
+    plan gathers them back to full tensor shapes before pickling so the
+    artifact stays layout-independent — a zero_stage=1 save loads under
+    zero_stage=0 (or a different mesh size) and vice versa."""
+    if shard_plan is not None and opt_state is not None:
+        opt_state = shard_plan.gather_state(opt_state)
     d = pass_dir(root, pass_id)
     os.makedirs(d, exist_ok=True)
     params_path = os.path.join(d, "params.tar")

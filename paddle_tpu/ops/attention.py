@@ -83,6 +83,17 @@ def mha_reference(q, k, v, segment_ids=None, kv_segment_ids=None,
 _LANES = 128  # lane width for the (block_q, _LANES) m/l scratch carries
 
 
+def _pv_operands(probs, other, pv_f32: bool):
+    """Operand dtypes for the P/dS-side matmuls (PV, dV, dK, dQ).
+
+    Default: cast the f32 probs/dS down to the tiles' native dtype so the
+    MXU runs its fast path. ``pv_f32`` (FLAGS.attn_pv_f32): upcast the
+    other operand instead — no softmax-prob rounding, slower f32 MXU."""
+    if pv_f32:
+        return probs, other.astype(jnp.float32)
+    return probs.astype(other.dtype), other
+
+
 def _seg_live(qseg_ref, kseg_ref, b):
     """Runtime block-skip predicate: packed sequences give each (q, k) block
     an id range; disjoint ranges mean no q_seg == k_seg pair exists, so the
@@ -120,7 +131,7 @@ def _clamped_kv_maps(causal, block_q, block_k):
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref,
                       lse_ref, m_scr, l_scr, acc_scr, *, sm_scale: float,
-                      causal: bool, num_kb: int):
+                      causal: bool, num_kb: int, pv_f32: bool):
     # q_ref: (1, 1, block_q, D); k_ref/v_ref: (1, 1, block_k, D) — the key
     # axis is the LAST grid dim, streamed; carries (m, l, acc) persist in
     # VMEM scratch across it.  qseg_ref: (B, block_q); kseg_ref: (B, block_k)
@@ -177,8 +188,12 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref,
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        # FLAGS.attn_pv_f32: keep the PV operands in f32 (no softmax-prob
+        # rounding) for accuracy-sensitive runs; default rides the fast
+        # native-dtype MXU path
+        pb, vmm = _pv_operands(p, vb, pv_f32)
         acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
-            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            pb, vmm, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
@@ -203,7 +218,7 @@ def _dim_semantics(grid_ndim: int, interpret: bool):
 
 
 def _flash_fwd(q, k, v, q_seg, kv_seg, causal, sm_scale, block_q, block_k,
-               interpret):
+               interpret, pv_f32=False):
     batch, seq_q, heads, head_dim = q.shape
     seq_k = k.shape[1]
     block_q = min(block_q, seq_q)
@@ -220,7 +235,7 @@ def _flash_fwd(q, k, v, q_seg, kv_seg, causal, sm_scale, block_q, block_k,
     kv_idx, kseg_idx = _clamped_kv_maps(causal, block_q, block_k)
     grid = (batch, heads, seq_q // block_q, num_kb)
     kernel = functools.partial(_flash_fwd_kernel, sm_scale=sm_scale,
-                               causal=causal, num_kb=num_kb)
+                               causal=causal, num_kb=num_kb, pv_f32=pv_f32)
     out_t, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -263,7 +278,8 @@ def _flash_fwd(q, k, v, q_seg, kv_seg, causal, sm_scale, block_q, block_k,
 
 def _flash_bwd_kv_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref,
                          lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr,
-                         *, sm_scale: float, causal: bool, num_qb: int):
+                         *, sm_scale: float, causal: bool, num_qb: int,
+                         pv_f32: bool):
     # grid (B, H, k-blocks, q-blocks): the QUERY axis is streamed through
     # the last grid dim; dk/dv accumulate in VMEM scratch across it.
     # k_ref/v_ref: (1, 1, block_k, D); q/do: (1, 1, block_q, D);
@@ -306,14 +322,16 @@ def _flash_bwd_kv_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref,
                 jnp.int32, (block_q, block_k), 1)
             mask = mask & (q_ids >= k_ids)
         p = jnp.where(mask, jnp.exp(s - lseb), 0.0)
+        pb, domm = _pv_operands(p, dob, pv_f32)
         dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
-            p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
+            pb, domm, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - deltab) * sm_scale
+        dsb, qmm = _pv_operands(ds, qb, pv_f32)
         dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
-            ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
+            dsb, qmm, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(i == num_qb - 1)
@@ -324,7 +342,8 @@ def _flash_bwd_kv_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref,
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref,
                          lse_ref, delta_ref, dq_ref, dq_scr, *,
-                         sm_scale: float, causal: bool, num_kb: int):
+                         sm_scale: float, causal: bool, num_kb: int,
+                         pv_f32: bool):
     # grid (B, H, q-blocks, k-blocks): the KEY axis is streamed through the
     # last grid dim; dq accumulates in VMEM scratch across it.
     block_q = q_ref.shape[2]
@@ -365,8 +384,9 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref,
         dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - deltab) * sm_scale
+        dsb, kmm = _pv_operands(ds, kb, pv_f32)
         dq_scr[...] = dq_scr[...] + jax.lax.dot_general(
-            ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
+            dsb, kmm, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(j == num_kb - 1)
@@ -375,7 +395,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref,
 
 
 def _flash_bwd_pallas(res, do, *, causal, sm_scale, block_q, block_k,
-                      interpret):
+                      interpret, pv_f32=False):
     q, k, v, q_seg, kv_seg, out, lse = res
     batch, seq_q, heads, head_dim = q.shape
     seq_k = k.shape[1]
@@ -396,12 +416,18 @@ def _flash_bwd_pallas(res, do, *, causal, sm_scale, block_q, block_k,
     # --- dK/dV: grid (B, H, k-blocks, q-blocks), query axis streamed ---
     if causal:
         # clamp the streamed q-tile index so fully-masked q blocks (strictly
-        # before the k block) don't re-DMA; pl.when skips their compute
+        # before the k block) don't re-DMA; pl.when skips their compute.
+        # The upper clamp to num_qb-1 covers causal cross-attention with
+        # seq_k > seq_q, where (kj*block_k)//block_q can exceed the last
+        # q block (the old code degraded to an out-of-range block index).
         def q_idx(b, h, kj, i):
-            return (b, h, jnp.maximum(i, (kj * block_k) // block_q), 0)
+            return (b, h, jnp.minimum(num_qb - 1,
+                                      jnp.maximum(i, (kj * block_k) // block_q)),
+                    0)
 
         def qseg_idx(b, h, kj, i):
-            return (0, jnp.maximum(i, (kj * block_k) // block_q))
+            return (0, jnp.minimum(num_qb - 1,
+                                   jnp.maximum(i, (kj * block_k) // block_q)))
     else:
         def q_idx(b, h, kj, i):
             return (b, h, i, 0)
@@ -411,7 +437,7 @@ def _flash_bwd_pallas(res, do, *, causal, sm_scale, block_q, block_k,
 
     dk_t, dv_t = pl.pallas_call(
         functools.partial(_flash_bwd_kv_kernel, sm_scale=sm_scale,
-                          causal=causal, num_qb=num_qb),
+                          causal=causal, num_qb=num_qb, pv_f32=pv_f32),
         grid=(batch, heads, num_kb, num_qb),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, head_dim), q_idx),
@@ -451,7 +477,7 @@ def _flash_bwd_pallas(res, do, *, causal, sm_scale, block_q, block_k,
 
     dq_t = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, sm_scale=sm_scale,
-                          causal=causal, num_kb=num_kb),
+                          causal=causal, num_kb=num_kb, pv_f32=pv_f32),
         grid=(batch, heads, num_qb, num_kb),
         in_specs=[
             blk_q,
@@ -528,28 +554,28 @@ def _flash_bwd(res, do, *, causal, sm_scale, block_k):
 # Public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
 def _flash_attention(q, k, v, q_seg, kv_seg, causal, sm_scale, block_q,
-                     block_k, interpret):
+                     block_k, interpret, pv_f32):
     out, _ = _flash_fwd(q, k, v, q_seg, kv_seg, causal, sm_scale, block_q,
-                        block_k, interpret)
+                        block_k, interpret, pv_f32=pv_f32)
     return out
 
 
 def _fwd_rule(q, k, v, q_seg, kv_seg, causal, sm_scale, block_q, block_k,
-              interpret):
+              interpret, pv_f32):
     out, lse = _flash_fwd(q, k, v, q_seg, kv_seg, causal, sm_scale, block_q,
-                          block_k, interpret)
+                          block_k, interpret, pv_f32=pv_f32)
     return out, (q, k, v, q_seg, kv_seg, out, lse)
 
 
-def _bwd_rule(causal, sm_scale, block_q, block_k, interpret, res, do):
+def _bwd_rule(causal, sm_scale, block_q, block_k, interpret, pv_f32, res, do):
     from paddle_tpu.platform.flags import FLAGS
 
     if FLAGS.use_pallas:
         return _flash_bwd_pallas(res, do, causal=causal, sm_scale=sm_scale,
                                  block_q=block_q, block_k=block_k,
-                                 interpret=interpret)
+                                 interpret=interpret, pv_f32=pv_f32)
     return _flash_bwd(res, do, causal=causal, sm_scale=sm_scale,
                       block_k=block_k)
 
@@ -618,4 +644,4 @@ def flash_attention(q, k, v, segment_ids=None, kv_segment_ids=None,
                   else kv_segment_ids.astype(jnp.int32))
     return _flash_attention(q, k, v, q_seg, kv_seg, bool(causal),
                             float(sm_scale), int(block_q), int(block_k),
-                            bool(interpret))
+                            bool(interpret), bool(FLAGS.attn_pv_f32))

@@ -91,6 +91,7 @@ class Optimizer:
         self.global_clip = float(gradient_clipping_threshold or 0.0)
         self.model_average = model_average
         self._specs: Dict[str, ParamSpec] = {}
+        self._zero_plan = None  # ZeRO-1 shard plan (parallel/zero.py)
 
     # -- wiring ------------------------------------------------------------
 
@@ -101,12 +102,36 @@ class Optimizer:
         spec = self._specs.get(name)
         return spec.attr if spec is not None else None
 
+    def set_zero_plan(self, plan) -> None:
+        """Enable ZeRO-1 optimizer-state sharding (parallel/zero.py): slot
+        state is allocated and updated as padded 1/N flat shards per
+        replica; params/grads pass through the same shard view around
+        ``_update``.  One wrapper for every optimizer — subclasses keep
+        their elementwise ``_update`` untouched."""
+        self._zero_plan = plan
+
     # -- slots -------------------------------------------------------------
 
     def slot_names(self) -> Tuple[str, ...]:
         return ()
 
     def init_state(self, params: Dict[str, jax.Array]) -> Dict[str, Any]:
+        # prune masks are value-quantile-based: always computed on the FULL
+        # tensors (a padded flat view would skew the quantile with zeros)
+        masks = self._make_prune_masks(params)
+        if self._zero_plan is not None:
+            # hand _init_state the flat sharded views so every slot
+            # (zeros_like and param-copy alike) is BORN sharded — no device
+            # ever materializes a replicated slot of a planned param
+            params = self._zero_plan.shard_tree(params)
+        state = self._init_state(params)
+        if masks:
+            state["prune_masks"] = (self._zero_plan.shard_tree(masks)
+                                    if self._zero_plan is not None else masks)
+        return state
+
+    def _init_state(self, params: Dict[str, jax.Array]) -> Dict[str, Any]:
+        """Build the slot pytree from (possibly ZeRO-shard-view) params."""
         slots = {
             s: {k: jnp.zeros_like(v) for k, v in params.items()}
             for s in self.slot_names()
@@ -115,9 +140,6 @@ class Optimizer:
         if self.model_average is not None:
             state["avg"] = {k: jnp.array(v) for k, v in params.items()}
             state["avg_count"] = jnp.zeros(())
-        masks = self._make_prune_masks(params)
-        if masks:
-            state["prune_masks"] = masks
         return state
 
     def _make_prune_masks(self, params) -> Dict[str, jax.Array]:
@@ -161,6 +183,20 @@ class Optimizer:
 
     def apply(self, params: Dict[str, jax.Array], grads: Dict[str, jax.Array],
               state: Dict[str, Any]) -> Tuple[Dict[str, jax.Array], Dict[str, Any]]:
+        plan = self._zero_plan
+        if plan is None:
+            return self._apply(params, grads, state)
+        # ZeRO-1 (arXiv 2004.13336): grads reduce-scatter into 1/N flat
+        # shards (GSPMD lowers psum + this constraint into psum_scatter),
+        # the whole update pipeline below runs on the shard views (slot
+        # state already lives flat-sharded), and the updated weights
+        # all-gather back to full replicated tensors.
+        new_flat, new_state = self._apply(plan.shard_tree(params),
+                                          plan.shard_tree(grads), state)
+        return plan.gather_tree(new_flat), new_state
+
+    def _apply(self, params: Dict[str, jax.Array], grads: Dict[str, jax.Array],
+               state: Dict[str, Any]) -> Tuple[Dict[str, jax.Array], Dict[str, Any]]:
         step = state["step"]
         base_lr = self.learning_rate * self.schedule(step.astype(jnp.float32))
         self._aux = self._pre_update(state, base_lr)
@@ -289,8 +325,8 @@ class SparseMomentum(Optimizer):
     def slot_names(self):
         return ("u", "v")
 
-    def init_state(self, params):
-        state = super().init_state(params)
+    def _init_state(self, params):
+        state = super()._init_state(params)
         # v_0 = theta_0 (the reference's first-touch assign, t0Vec_)
         state["slots"]["v"] = {k: jnp.array(v) for k, v in params.items()}
         state["sm"] = {"alpha": jnp.ones(()), "beta": jnp.ones(()),

@@ -15,3 +15,5 @@ from paddle_tpu.parallel.placement import (stage_attrs, model_parallel_fc,
 from paddle_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
 from paddle_tpu.parallel.moe import (MoEParams, init_moe_params, moe_ffn,
                                      moe_ffn_reference)
+from paddle_tpu.parallel.zero import (ZeroPlan, build_zero_plan,
+                                      opt_state_bytes_per_device)

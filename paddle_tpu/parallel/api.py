@@ -42,6 +42,21 @@ def replicate(mesh, tree):
     return jax.device_put(tree, NamedSharding(mesh, P()))
 
 
+def put_global(v, sharding):
+    """Place a host array onto a (possibly multi-process) sharding.
+
+    Single-process: plain device_put. Multi-process: device_put cannot
+    address other hosts' devices, so build the global array from a
+    callback over the full host copy every process holds (params and
+    replicated feeds are host-identical across processes — the pserver
+    sendBackParameter invariant)."""
+    if jax.process_count() <= 1:
+        return jax.device_put(v, sharding)
+    host = np.asarray(v)
+    return jax.make_array_from_callback(host.shape, sharding,
+                                        lambda idx: host[idx])
+
+
 def param_sharding(mesh, params: Dict[str, jax.Array], specs=None,
                    zero_axis: Optional[str] = None):
     """Build NamedShardings for a param dict.

@@ -103,9 +103,24 @@ FLAGS.define("bf16_dense_activations", False,
              "bound dense models. Only active when use_bf16 is also on.")
 FLAGS.define("attn_block", 0,
              "flash-attention tile edge (query AND key block size). 0 = "
-             "per-call defaults (128). Larger tiles amortize per-block "
+             "auto: the largest of 512/256/128 that divides the sequence "
+             "(small/ragged seqs clamp to the sequence length). A nonzero "
+             "value is tried first, falling through the same ladder when "
+             "it does not divide. Larger tiles amortize per-block "
              "overhead; VMEM use is O(block^2) so 256/512 still fit.",
              parser=int)
+FLAGS.define("attn_pv_f32", False,
+             "keep the flash-attention PV-matmul operands (softmax probs "
+             "and V, plus the backward dS/P operands) in f32 instead of "
+             "the tiles' native dtype. Removes the bf16 softmax-prob "
+             "rounding for accuracy-sensitive runs at the cost of the "
+             "slower f32 MXU path for those matmuls.")
+FLAGS.define("zero_stage", 0,
+             "cross-replica sharded weight update (arXiv 2004.13336): "
+             "0 = replicated optimizer state (default), 1 = ZeRO-1 — "
+             "reduce-scatter grads, update a 1/N optimizer-state shard "
+             "per replica over the 'data' mesh axis, all-gather updated "
+             "weights. Per-trainer override: SGD(zero=...).")
 FLAGS.define("save_dir", "./output", "default checkpoint output directory")
 FLAGS.define("log_level", "INFO", "logging level")
 FLAGS.define("prealloc_mem", False, "let XLA preallocate the whole HBM arena")

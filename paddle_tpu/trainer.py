@@ -66,7 +66,8 @@ class SGD:
                  extra_layers: Optional[Sequence[LayerOutput]] = None,
                  is_local: bool = True, mesh=None,
                  metrics: Optional[Dict[str, LayerOutput]] = None,
-                 zero_axis: Optional[str] = None):
+                 zero_axis: Optional[str] = None,
+                 zero: Optional[int] = None):
         costs = [cost] if isinstance(cost, LayerOutput) else list(cost)
         self.metrics = dict(metrics or {})
         # auto-collect evaluator nodes passed via extra_layers
@@ -87,6 +88,37 @@ class SGD:
         # then inherit the committed shardings, so no device ever
         # materializes a full slot replica of a sharded weight
         self._place_on_mesh(slots_too=False)
+        # ZeRO-1 (zero= arg, default FLAGS.zero_stage): shard optimizer
+        # state 1/N over the 'data' axis while params stay replicated —
+        # the plan threads through init_state so slots are sharded from
+        # step 0, and through apply for the per-step reduce-scatter /
+        # all-gather pair (parallel/zero.py)
+        self._zero_plan = None
+        stage = int(FLAGS.zero_stage if zero is None else zero)
+        if stage:
+            enforce_that(stage == 1, f"zero_stage={stage} not implemented "
+                         "(0 = off, 1 = optimizer-state sharding)",
+                         context="trainer")
+            usable = mesh is not None and "data" in mesh.axis_names
+            # an EXPLICIT zero= request that cannot take effect is an
+            # error (silently training replicated would fake the N x
+            # memory claim); the process-wide FLAGS.zero_stage stays
+            # permissive so single-device tools keep working
+            enforce_that(usable or zero is None,
+                         "zero=1 needs mesh= with a 'data' axis (got "
+                         + ("no mesh" if mesh is None else
+                            f"axes {tuple(mesh.axis_names)}") + ")",
+                         context="trainer")
+            if usable:
+                from paddle_tpu.parallel.zero import build_zero_plan
+
+                self._zero_plan = build_zero_plan(
+                    mesh, parameters.as_dict(),
+                    specs=self.topology.param_specs(),
+                    zero_axis=self._zero_axis)
+        # unconditional (including None): a reused optimizer instance must
+        # not carry a previous trainer's plan into this one
+        self.optimizer.set_zero_plan(self._zero_plan)
         self.opt_state = self.optimizer.init_state(parameters.as_dict())
         self._rng = jax.random.PRNGKey(FLAGS.seed or 0)
         self._step_fn = None
@@ -170,18 +202,29 @@ class SGD:
              for k, v in self.parameters.as_dict().items()})
         if not slots_too or not isinstance(self.opt_state, dict):
             return
+        plan = getattr(self, "_zero_plan", None)
+        if plan is not None:
+            # ZeRO: planned params' slots (and avg/prune masks) live as
+            # flat 1/N shards; checkpoint loads hand back full-shape host
+            # arrays, which shard_state flattens/pads/places. Passthrough
+            # params fall to the declared shardings below.
+            self.opt_state = plan.shard_state(self.opt_state)
+
+        def _slot_put(k, v):
+            if plan is not None and plan.is_sharded(k):
+                return v  # already placed by shard_state
+            return _put_global(v, shardings[k]) if k in shardings else v
+
         new_state = dict(self.opt_state)
         for key in ("slots",):
             if key in new_state:
                 new_state[key] = {
-                    s: {k: (_put_global(v, shardings[k])
-                            if k in shardings else v)
-                        for k, v in d.items()}
+                    s: {k: _slot_put(k, v) for k, v in d.items()}
                     for s, d in new_state[key].items()}
-        if "avg" in new_state:
-            new_state["avg"] = {
-                k: (jax.device_put(v, shardings[k]) if k in shardings else v)
-                for k, v in new_state["avg"].items()}
+        for key in ("avg", "prune_masks"):
+            if key in new_state:
+                new_state[key] = {
+                    k: _slot_put(k, v) for k, v in new_state[key].items()}
         self.opt_state = new_state
 
     def _shard_feeds(self, feeds):
@@ -437,7 +480,8 @@ class SGD:
                     save_dir, ck_id, self.parameters,
                     opt_state=self.opt_state, model_state=self.model_state,
                     extra_meta={"next_step": step, "pass_id": meta_pass,
-                                "epoch": epoch, "task_ids": list(unacked)})
+                                "epoch": epoch, "task_ids": list(unacked)},
+                    shard_plan=self._zero_plan)
                 ckpt.prune_checkpoints(save_dir, keep=2)
                 ck_id += 1
             for tid in unacked:
@@ -573,7 +617,8 @@ class SGD:
         from paddle_tpu import checkpoint as ckpt
         return ckpt.save_checkpoint(root, pass_id, self.parameters,
                                     opt_state=self.opt_state,
-                                    model_state=self.model_state)
+                                    model_state=self.model_state,
+                                    shard_plan=self._zero_plan)
 
     def load_checkpoint(self, root: str, pass_id: Optional[int] = None) -> None:
         from paddle_tpu import checkpoint as ckpt
@@ -595,18 +640,10 @@ class SGD:
 
 
 def _put_global(v, sharding) -> jax.Array:
-    """Place a host array onto a (possibly multi-process) sharding.
+    """Multi-process-safe placement — see parallel.api.put_global."""
+    from paddle_tpu.parallel.api import put_global
 
-    Single-process: plain device_put. Multi-process: device_put cannot
-    address other hosts' devices, so build the global array from a
-    callback over the full host copy every process holds (params and
-    replicated feeds are host-identical across processes — the pserver
-    sendBackParameter invariant)."""
-    if jax.process_count() <= 1:
-        return jax.device_put(v, sharding)
-    host = np.asarray(v)
-    return jax.make_array_from_callback(host.shape, sharding,
-                                        lambda idx: host[idx])
+    return put_global(v, sharding)
 
 
 def _default_event_handler(ev) -> None:

@@ -81,6 +81,61 @@ def test_flash_cross_attention(rng):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+def test_flash_causal_cross_attention_grads(rng):
+    """Causal CROSS-attention with seq_k > seq_q through the backward pass:
+    the dK/dV kernel's streamed q-tile index (kj*block_k)//block_q exceeds
+    the last q block for late key blocks, which an earlier clamp let
+    through as an out-of-range block index (ADVICE r5 item 1). Forward and
+    all three grads must match the oracle."""
+    q = jnp.asarray(rng.randn(2, 32, 2, 16).astype(np.float32))
+    k = jnp.asarray(rng.randn(2, 128, 2, 16).astype(np.float32))
+    v = jnp.asarray(rng.randn(2, 128, 2, 16).astype(np.float32))
+
+    out = attention.flash_attention(q, k, v, causal=True, block_q=32,
+                                    block_k=32)
+    ref = attention.mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    g1 = jax.grad(lambda q, k, v: jnp.sum(attention.flash_attention(
+        q, k, v, causal=True, block_q=32, block_k=32) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda q, k, v: jnp.sum(attention.mha_reference(
+        q, k, v, causal=True) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4,
+                                   err_msg=f"d{name}")
+
+
+def test_flash_pv_f32_matches_default_in_f32(rng):
+    """FLAGS.attn_pv_f32 only changes the PV/dS operand dtype: in an f32
+    model both paths are identical math (the flag's effect is bf16-only)."""
+    from paddle_tpu.platform.flags import FLAGS
+
+    q, k, v = _mk(rng, 2, 64, 2, 16)
+    seg = _segments(rng, 2, 64, 3)
+
+    def loss(q, k, v):
+        o = attention.flash_attention(q, k, v, segment_ids=seg, causal=True,
+                                      block_q=32, block_k=32)
+        return jnp.sum(o * o)
+
+    old = FLAGS.attn_pv_f32
+    try:
+        FLAGS.attn_pv_f32 = False
+        o0 = attention.flash_attention(q, k, v, segment_ids=seg, causal=True,
+                                       block_q=32, block_k=32)
+        g0 = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        FLAGS.attn_pv_f32 = True
+        o1 = attention.flash_attention(q, k, v, segment_ids=seg, causal=True,
+                                       block_q=32, block_k=32)
+        g1 = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    finally:
+        FLAGS.attn_pv_f32 = old
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o0))
+    for a, b in zip(g1, g0):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_pallas_backward_matches_plain_jax_backward(rng, causal):
     """The pallas dQ/dK/dV kernels and the plain-JAX blockwise fallback

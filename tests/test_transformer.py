@@ -511,15 +511,23 @@ def test_remat_training_parity(rng):
             losses.append(float(loss))
         return losses
 
-    l_plain = run(False)
-    l_remat = run(True)
-    # Not bit-identical: XLA schedules the recomputed backward differently,
-    # and the flash kernels' bf16 softmax-prob rounding sits at quantization
-    # boundaries that the ~1e-7 scheduling noise can flip, so trajectories
-    # drift apart chaotically after a few Adam steps (first steps identical,
-    # observed ~2.2e-4 relative by step 6). rtol gives ~4x headroom over the
-    # observed drift while still catching any remat bug that alters math.
-    np.testing.assert_allclose(l_remat, l_plain, rtol=1e-3)
+    # Force full-f32 compute (matmuls AND the flash kernels' softmax-prob
+    # path): in bf16 the prob rounding sits at quantization boundaries that
+    # the ~1e-7 backward-rescheduling noise can flip, which drifts the Adam
+    # trajectories apart chaotically and forced a 1000x-loosened rtol. In
+    # f32 the only difference is XLA scheduling of the recomputed backward,
+    # so the original tight tolerance holds and the test guards remat math
+    # again. (bf16 remat numerics are covered by test_remat_moe_trains.)
+    from paddle_tpu.platform.flags import FLAGS
+
+    old_bf16 = FLAGS.use_bf16
+    FLAGS.use_bf16 = False
+    try:
+        l_plain = run(False)
+        l_remat = run(True)
+    finally:
+        FLAGS.use_bf16 = old_bf16
+    np.testing.assert_allclose(l_remat, l_plain, rtol=1e-6)
 
 
 def test_remat_moe_trains(rng):

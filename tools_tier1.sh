@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Tier-1 verify wrapper: runs the ROADMAP.md tier-1 command verbatim and
+# prints DOTS_PASSED, so the verify line is one script instead of a paste.
+#
+#   ./tools_tier1.sh            # exit code = pytest's; last line DOTS_PASSED=N
+set -o pipefail
+cd "$(dirname "$0")"
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+    -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc
