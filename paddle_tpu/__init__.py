@@ -33,6 +33,7 @@ from paddle_tpu import dataset
 from paddle_tpu import minibatch
 from paddle_tpu import parallel
 from paddle_tpu import sequence
+from paddle_tpu import serving
 
 from paddle_tpu.minibatch import batch
 from paddle_tpu.parameters import Parameters
@@ -64,6 +65,7 @@ __all__ = [
     "initializer",
     "pooling",
     "sequence",
+    "serving",
     "Parameters",
     "DataFeeder",
     "SequenceBatch",

@@ -48,8 +48,35 @@ class Inference:
 
     def infer(self, input, feeding=None, field: str = "value",
               batch_size: int = 256):
-        """input: a list of sample tuples (v2 semantics); batched internally."""
-        batches = [input[i:i + batch_size] for i in range(0, len(input), batch_size)]
+        """input: a list of sample tuples (v2 semantics); batched internally.
+
+        The final partial batch is PADDED (repeating the last sample) and
+        the padded rows sliced off the result, so the tail reuses an
+        already-compiled jit specialization instead of compiling a fresh
+        one per distinct tail size: with multiple batches the tail pads
+        up to ``batch_size`` (sharing the full-batch executable); a
+        single short batch pads to the next power of two (a bounded
+        bucket ladder across calls).  Topologies with SEQUENCE outputs
+        keep the exact tail (padded samples would concatenate extra
+        tokens into the packed output that no batch-axis slice can
+        remove)."""
+        n = len(input)
+        if n == 0:
+            return None
+        batches = [input[i:i + batch_size]
+                   for i in range(0, n, batch_size)]
+        tail = len(batches[-1])
+        if any(o.is_sequence for o in self.topology.outputs):
+            target = tail
+        elif len(batches) > 1:
+            target = batch_size
+        else:
+            target = 1
+            while target < tail:
+                target *= 2
+        pad = target - tail
+        if pad:
+            batches[-1] = list(batches[-1]) + [input[-1]] * pad
         results: List[List[np.ndarray]] = None
         for outs in self.iter_infer(batches, feeding):
             arrays = [_to_numpy(o) for o in outs]
@@ -62,6 +89,12 @@ class Inference:
             return None
         merged = [np.concatenate(parts, axis=0) if parts[0].ndim else np.stack(parts)
                   for parts in results]
+        if pad:
+            # slice the padding off every output whose leading axis is
+            # the (padded) batch; other shapes (packed sequences,
+            # reductions) pass through untouched
+            merged = [a[:n] if a.ndim and a.shape[0] == n + pad else a
+                      for a in merged]
         return merged[0] if len(merged) == 1 else merged
 
 
@@ -72,6 +105,10 @@ def _to_numpy(o):
 
 
 def infer(output_layer, parameters: Parameters, input, feeding=None,
-          field: str = "value"):
-    return Inference(output_layer, parameters).infer(input, feeding=feeding,
-                                                     field=field)
+          field: str = "value", model_state=None, batch_size: int = 256):
+    """One-shot inference.  ``model_state`` forwards a trainer's model
+    state (batch-norm moving statistics) so trained stats are used
+    without constructing :class:`Inference` directly."""
+    return Inference(output_layer, parameters,
+                     model_state=model_state).infer(
+        input, feeding=feeding, field=field, batch_size=batch_size)

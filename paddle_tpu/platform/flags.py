@@ -121,6 +121,24 @@ FLAGS.define("zero_stage", 0,
              "reduce-scatter grads, update a 1/N optimizer-state shard "
              "per replica over the 'data' mesh axis, all-gather updated "
              "weights. Per-trainer override: SGD(zero=...).")
+FLAGS.define("serving_page_size", 128,
+             "paged-KV cache page size in tokens (serving engine). 128 "
+             "matches the TPU lane width so a page's K/V tile feeds the "
+             "MXU without padding; tests and small models may pass a "
+             "smaller explicit page_size to ServingEngine.")
+FLAGS.define("serving_max_pages", 512,
+             "total pages in the serving KV pool (page 0 is reserved as "
+             "the null page that masked/inactive writes land on). "
+             "HBM cost = 2 * layers * pages * page_size * heads * "
+             "head_dim * dtype bytes.")
+FLAGS.define("serving_max_slots", 8,
+             "maximum concurrently-decoding sequences per serving engine "
+             "tick (the static batch dimension of the fused decode step)")
+FLAGS.define("serving_prefill_buckets", "32,64,128,256,512",
+             "comma ladder of padded prefill lengths: each admitted "
+             "prompt is padded to the smallest bucket that holds it so "
+             "the prefill jit specializes once per bucket, not once per "
+             "distinct prompt length")
 FLAGS.define("save_dir", "./output", "default checkpoint output directory")
 FLAGS.define("log_level", "INFO", "logging level")
 FLAGS.define("prealloc_mem", False, "let XLA preallocate the whole HBM arena")

@@ -1,0 +1,29 @@
+"""paddle_tpu.serving — paged-KV continuous-batching inference engine.
+
+The serving-side counterpart of the training stack: block-paged KV
+storage (``kv_cache``), a ragged-page-table decode-attention kernel
+(``decode_attention``), a continuous-batching scheduler with admission
+control and preemption (``scheduler``), and the user-facing
+:class:`ServingEngine` (``engine``) with scrapeable ``metrics``.
+"""
+
+from paddle_tpu.serving.decode_attention import (
+    paged_decode_attention, paged_decode_attention_reference)
+from paddle_tpu.serving.engine import (DecodeModel, DecoderLM, ServingEngine,
+                                       greedy_decode_reference)
+from paddle_tpu.serving.kv_cache import (NULL_PAGE, KVPages, PagedKVConfig,
+                                         PagePool, append_token, gather_kv,
+                                         init_kv_pages, write_prompt)
+from paddle_tpu.serving.metrics import ServingMetrics
+from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
+                                          Request, SchedulerConfig,
+                                          bucket_for)
+
+__all__ = [
+    "ServingEngine", "DecodeModel", "DecoderLM", "greedy_decode_reference",
+    "paged_decode_attention", "paged_decode_attention_reference",
+    "PagedKVConfig", "KVPages", "PagePool", "NULL_PAGE",
+    "init_kv_pages", "append_token", "write_prompt", "gather_kv",
+    "ContinuousBatchingScheduler", "Request", "SchedulerConfig",
+    "bucket_for", "ServingMetrics",
+]

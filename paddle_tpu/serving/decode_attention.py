@@ -1,0 +1,212 @@
+"""Single-token decode attention over the paged KV cache.
+
+One query token per sequence attends over everything that sequence has
+cached, where the cache is scattered across non-contiguous pages (see
+``kv_cache.py``).  Two paths with identical semantics:
+
+- **Pallas kernel** (``use_kernel=True`` or auto on TPU when the shape
+  allows): grid ``(batch, heads, pages-per-seq)`` with the page axis
+  streamed — the page table rides in as a *scalar-prefetch* operand
+  (``pltpu.PrefetchScalarGridSpec``) so the K/V BlockSpec index maps can
+  chase it and DMA exactly the pages each sequence owns, page j+1's
+  fetch overlapping page j's compute.  The online-softmax carry (m, l,
+  acc) lives in VMEM scratch across the page axis, the same pattern as
+  ``ops/attention.py``'s flash forward.  Pages past a sequence's length
+  are skipped with ``pl.when`` AND their index maps clamp to the last
+  live page, so the revisiting optimisation elides the dead DMAs (the
+  ragged-page-table trick of arXiv 2604.15464).
+- **Reference path** (the CPU/interpreter fallback and the test oracle):
+  ``gather_kv``-style linearization + ``ops.attention.mha_reference``
+  with length masking expressed as segment ids — no new math to trust.
+
+Decode is bandwidth-bound (a [1, D] x [page, D] product per page), so
+the kernel's job is DMA shape, not MXU utilisation.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from paddle_tpu.ops.attention import (DEFAULT_MASK_VALUE, _dim_semantics,
+                                      mha_reference)
+from paddle_tpu.ops.kernel_util import interpret_default as _interpret_default
+
+_LANES = 128  # lane width of the (1, _LANES) m/l scratch carries
+
+
+# ---------------------------------------------------------------------------
+# Reference path (oracle + CPU fallback)
+# ---------------------------------------------------------------------------
+
+def paged_decode_attention_reference(q, k_pages, v_pages, page_table,
+                                     lengths, sm_scale: Optional[float] = None):
+    """Gather-then-mask oracle.
+
+    q: [B, H, D]; k_pages/v_pages: [num_pages, page, H, D] (ONE layer's
+    pool slice); page_table: [B, max_pages_per_seq] int32; lengths: [B]
+    int32 — the number of valid cached tokens per sequence (the query
+    attends over positions 0..len-1).  Returns [B, H, D].
+
+    Rows with length 0 return an arbitrary finite value (a fully-masked
+    softmax degenerates to uniform); the engine never reads them."""
+    b, pm = page_table.shape
+    _, page, h, d = k_pages.shape
+    k = k_pages[page_table].reshape(b, pm * page, h, d)
+    v = v_pages[page_table].reshape(b, pm * page, h, d)
+    pos = jnp.arange(pm * page, dtype=jnp.int32)[None, :]
+    kv_seg = jnp.where(pos < lengths[:, None], 0, 1).astype(jnp.int32)
+    q_seg = jnp.zeros((b, 1), jnp.int32)
+    out = mha_reference(q[:, None], k, v, segment_ids=q_seg,
+                        kv_segment_ids=kv_seg, sm_scale=sm_scale)
+    return out[:, 0]
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+
+def _paged_decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_scr, l_scr, acc_scr, *, page_size: int,
+                         num_pb: int, sm_scale: float):
+    # grid (B, H, pages-per-seq): the page axis is streamed; (m, l, acc)
+    # persist in VMEM scratch across it.  pt_ref/len_ref are the
+    # scalar-prefetched page table [B, Pm] and lengths [B] (SMEM).
+    # q_ref/o_ref: (1, 1, D); k_ref/v_ref: (1, 1, page, D).
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    n = len_ref[b]
+    live = j * page_size < n
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]                       # (1, D)
+        kb = k_ref[0, 0, :, :]             # (page, D)
+        vb = v_ref[0, 0, :, :]
+        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale                   # (1, page)
+        tok = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        s = jnp.where(tok < n, s, DEFAULT_MASK_VALUE)
+
+        m_prev = jnp.max(m_scr[...], axis=1, keepdims=True)
+        l_prev = jnp.max(l_scr[...], axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == num_pb - 1)
+    def _finalize():
+        l = jnp.max(l_scr[...], axis=1, keepdims=True)
+        l = jnp.where(l == 0.0, 1.0, l)    # length-0 rows -> zeros, not NaN
+        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+
+def _paged_decode_pallas(q, k_pages, v_pages, page_table, lengths, sm_scale,
+                         interpret: bool):
+    b, h, d = q.shape
+    _, page, _, _ = k_pages.shape
+    pm = page_table.shape[1]
+    # [P, page, H, D] -> [H, P, page, D]: per-head pages are contiguous
+    # blocks the index map can address as (h, page_id, 0, 0)
+    kt = k_pages.transpose(2, 0, 1, 3)
+    vt = v_pages.transpose(2, 0, 1, 3)
+    pt = page_table.astype(jnp.int32)
+    ln = lengths.astype(jnp.int32)
+
+    def kv_idx(bi, hi, j, pt_ref, len_ref):
+        # clamp dead pages (j past the sequence's last live page) to the
+        # last live one so their DMA is elided by revisiting; pl.when
+        # skips their compute.  max(len-1, 0) keeps length-0 rows legal.
+        last = jnp.maximum(len_ref[bi] - 1, 0) // page
+        return (hi, pt_ref[bi, jnp.minimum(j, last)], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h, pm),
+        in_specs=[
+            pl.BlockSpec((1, 1, d), lambda bi, hi, j, pt_ref, len_ref:
+                         (bi, hi, 0)),
+            pl.BlockSpec((1, 1, page, d), kv_idx),
+            pl.BlockSpec((1, 1, page, d), kv_idx),
+        ],
+        out_specs=pl.BlockSpec((1, 1, d), lambda bi, hi, j, pt_ref, len_ref:
+                               (bi, hi, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, _LANES), jnp.float32),
+            pltpu.VMEM((1, _LANES), jnp.float32),
+            pltpu.VMEM((1, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_paged_decode_kernel, page_size=page,
+                               num_pb=pm, sm_scale=sm_scale)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        compiler_params=_dim_semantics(3, interpret),
+        interpret=interpret,
+    )(pt, ln, q, kt, vt)
+    return out
+
+
+def _kernel_shape_ok(head_dim: int, page_size: int) -> bool:
+    """Native-compile gate: the kernel's tiles are (page, D) and (1, D);
+    lane-aligned D and sublane-aligned pages avoid relayouts on real
+    hardware.  Anything else rides the reference path (still correct)."""
+    return head_dim % _LANES == 0 and page_size % 8 == 0
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+def paged_decode_attention(q, k_pages, v_pages, page_table, lengths, *,
+                           sm_scale: Optional[float] = None,
+                           use_kernel: Optional[bool] = None,
+                           interpret: Optional[bool] = None):
+    """Decode-step attention over a paged KV cache.
+
+    q: [B, H, D] — this tick's single query token per sequence (its K/V
+    already appended, so ``lengths`` INCLUDES it); k_pages/v_pages:
+    [num_pages, page, H, D]; page_table: [B, max_pages_per_seq] int32;
+    lengths: [B] int32.  Returns [B, H, D] in q's dtype.
+
+    ``use_kernel=None`` auto-selects: the pallas kernel on TPU when the
+    shape is lane/sublane aligned, otherwise the ``mha_reference``-based
+    path (which is also the CPU/interpreter-mode fallback — the kernel
+    itself runs under ``interpret=True`` only when forced, for tests)."""
+    if sm_scale is None:
+        sm_scale = float(q.shape[-1]) ** -0.5
+    if interpret is None:
+        interpret = _interpret_default()
+    if use_kernel is None:
+        use_kernel = (not interpret) and _kernel_shape_ok(
+            q.shape[-1], k_pages.shape[1])
+    if not use_kernel:
+        return paged_decode_attention_reference(
+            q, k_pages, v_pages, page_table, lengths,
+            sm_scale=sm_scale).astype(q.dtype)
+    return _paged_decode_pallas(q, k_pages, v_pages,
+                                page_table.astype(jnp.int32),
+                                lengths.astype(jnp.int32),
+                                float(sm_scale), bool(interpret))

@@ -1,0 +1,405 @@
+"""ServingEngine: the user-facing paged-KV continuous-batching API.
+
+Usage::
+
+    model = DecoderLM(vocab_size=512, num_layers=2, num_heads=2,
+                      head_dim=16)
+    params = model.init_params(jax.random.PRNGKey(0))
+    eng = ServingEngine(model, params, eos_id=1, page_size=16,
+                        num_pages=96, max_pages_per_seq=8, max_slots=8)
+    rid = eng.submit([7, 12, 3], max_tokens=32,
+                     on_token=lambda tok: print(tok))
+    results = eng.run()          # {rid: [generated tokens...]}
+    eng.metrics.snapshot()       # tokens/s, TTFT, occupancy, ...
+
+The engine owns exactly two compiled functions:
+
+- a **bucketed prefill** (one jit specialization per padded length in
+  the bucket ladder): full causal self-attention over the prompt —
+  through ``ops.attention.flash_attention`` when the bucket is
+  kernel-shaped, ``mha_reference`` otherwise — that writes the prompt's
+  K/V into the request's pages and emits the first token from the
+  last-position logits;
+- a **fused decode step** over ALL running sequences per tick: embed the
+  last emitted tokens, append their K/V into each sequence's current
+  page, and attend over the paged cache (``paged_decode_attention``).
+
+Decoding is greedy (argmax) — the deterministic contract the parity
+tests pin; sampling policies layer on top later.
+
+The model plugs in through the small :class:`DecodeModel` contract
+rather than a ``Topology``: serving needs per-layer access to Q/K/V
+*before* attention runs (the cache sits between them), which the opaque
+layer graph doesn't expose.  :class:`DecoderLM` is the built-in
+reference implementation (and the bench model); any object with the same
+methods works, so a topology-built transformer can be adapted by
+exposing its projection weights.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops.attention import flash_attention, mha_reference
+from paddle_tpu.platform.flags import FLAGS
+from paddle_tpu.serving.decode_attention import paged_decode_attention
+from paddle_tpu.serving.kv_cache import (NULL_PAGE, KVPages, PagedKVConfig,
+                                         PagePool, append_token,
+                                         init_kv_pages, write_prompt)
+from paddle_tpu.serving.metrics import ServingMetrics
+from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
+                                          Request, SchedulerConfig,
+                                          bucket_for)
+
+__all__ = ["DecodeModel", "DecoderLM", "ServingEngine",
+           "greedy_decode_reference"]
+
+
+class DecodeModel:
+    """Structural contract the engine drives (duck-typed; subclassing is
+    optional).  All methods must be jax-traceable and shape-polymorphic
+    over leading batch/sequence dims:
+
+    - ``num_layers``, ``num_heads``, ``head_dim``, ``vocab_size``
+    - ``embed(params, tokens, positions) -> [..., E]``
+    - ``qkv(params, layer, x) -> (q, k, v)`` each ``[..., H, D]``
+    - ``attn_out(params, layer, ctx, x) -> [..., E]`` — attention output
+      ``ctx`` [..., H, D] combined with the residual stream ``x``
+      (projection, residual, FFN — whatever the architecture does after
+      attention)
+    - ``logits(params, x) -> [..., vocab_size]``
+    """
+
+    num_layers: int
+    num_heads: int
+    head_dim: int
+    vocab_size: int
+
+
+def _rms(x, eps: float = 1e-6):
+    return x * jax.lax.rsqrt(jnp.mean(jnp.square(x), axis=-1,
+                                      keepdims=True) + eps)
+
+
+class DecoderLM(DecodeModel):
+    """A compact pre-norm decoder-only transformer LM implementing the
+    :class:`DecodeModel` contract — the built-in serving/bench model.
+    Parameter-free RMSNorm keeps the param dict to embeddings +
+    projections."""
+
+    def __init__(self, vocab_size: int, num_layers: int = 2,
+                 num_heads: int = 2, head_dim: int = 16,
+                 ffn_mult: int = 4, max_positions: int = 1024):
+        self.vocab_size = vocab_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.head_dim = head_dim
+        self.embed_dim = num_heads * head_dim
+        self.ffn_dim = ffn_mult * self.embed_dim
+        self.max_positions = max_positions
+
+    def init_params(self, key) -> Dict[str, jax.Array]:
+        e, f, v = self.embed_dim, self.ffn_dim, self.vocab_size
+        keys = jax.random.split(key, 2 + 6 * self.num_layers + 1)
+        ki = iter(keys)
+
+        def mat(shape, scale):
+            return (jax.random.normal(next(ki), shape, jnp.float32) * scale)
+
+        p = {"emb": mat((v, e), 0.02), "pos": mat((self.max_positions, e),
+                                                  0.02)}
+        for l in range(self.num_layers):
+            p[f"l{l}.wq"] = mat((e, e), e ** -0.5)
+            p[f"l{l}.wk"] = mat((e, e), e ** -0.5)
+            p[f"l{l}.wv"] = mat((e, e), e ** -0.5)
+            p[f"l{l}.wo"] = mat((e, e), e ** -0.5)
+            p[f"l{l}.w1"] = mat((e, f), e ** -0.5)
+            p[f"l{l}.w2"] = mat((f, e), f ** -0.5)
+        p["out"] = mat((e, v), e ** -0.5)
+        return p
+
+    def embed(self, params, tokens, positions):
+        return params["emb"][tokens] + params["pos"][positions]
+
+    def qkv(self, params, layer, x):
+        h, d = self.num_heads, self.head_dim
+        xn = _rms(x)
+        shape = x.shape[:-1] + (h, d)
+        q = (xn @ params[f"l{layer}.wq"]).reshape(shape)
+        k = (xn @ params[f"l{layer}.wk"]).reshape(shape)
+        v = (xn @ params[f"l{layer}.wv"]).reshape(shape)
+        return q, k, v
+
+    def attn_out(self, params, layer, ctx, x):
+        flat = ctx.reshape(x.shape[:-1] + (self.embed_dim,))
+        a = x + flat @ params[f"l{layer}.wo"]
+        return a + jax.nn.gelu(_rms(a) @ params[f"l{layer}.w1"]) \
+            @ params[f"l{layer}.w2"]
+
+    def logits(self, params, x):
+        return _rms(x) @ params["out"]
+
+
+def greedy_decode_reference(model: DecodeModel, params, prompt: List[int],
+                            max_tokens: int, eos_id: int) -> List[int]:
+    """The NON-paged oracle: a host loop that re-runs the full causal
+    forward over the whole history each step (``mha_reference``, no KV
+    cache at all) and greedily extends.  Slow by construction — it
+    exists as the parity target for the engine's paged path."""
+    tokens = list(prompt)
+    out: List[int] = []
+    for _ in range(max_tokens):
+        t = jnp.asarray(tokens, jnp.int32)[None]          # [1, T]
+        pos = jnp.arange(len(tokens), dtype=jnp.int32)[None]
+        x = model.embed(params, t, pos)
+        for l in range(model.num_layers):
+            q, k, v = model.qkv(params, l, x)
+            ctx = mha_reference(q, k, v, causal=True)
+            x = model.attn_out(params, l, ctx, x)
+        nxt = int(jnp.argmax(model.logits(params, x[0, -1])))
+        out.append(nxt)
+        tokens.append(nxt)
+        if nxt == eos_id:
+            break
+    return out
+
+
+def _parse_buckets(spec: str) -> Tuple[int, ...]:
+    return tuple(sorted(int(t) for t in spec.split(",") if t.strip()))
+
+
+class ServingEngine:
+    """Paged-KV continuous-batching inference engine (see module doc)."""
+
+    def __init__(self, model: DecodeModel, params, *, eos_id: int,
+                 page_size: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 max_pages_per_seq: Optional[int] = None,
+                 max_slots: Optional[int] = None,
+                 buckets: Optional[Sequence[int]] = None,
+                 max_queue: Optional[int] = None,
+                 dtype=jnp.float32,
+                 use_kernel: Optional[bool] = None):
+        self.model = model
+        self.params = params
+        self.eos_id = int(eos_id)
+        page_size = int(page_size or FLAGS.serving_page_size)
+        num_pages = int(num_pages or FLAGS.serving_max_pages)
+        max_slots = int(max_slots or FLAGS.serving_max_slots)
+        if max_pages_per_seq is None:
+            # default: one sequence may claim up to half the usable pool
+            max_pages_per_seq = max(1, (num_pages - 1) // 2)
+        self.kv_cfg = PagedKVConfig(
+            num_layers=model.num_layers, num_heads=model.num_heads,
+            head_dim=model.head_dim, page_size=page_size,
+            num_pages=num_pages, max_pages_per_seq=int(max_pages_per_seq),
+            dtype=dtype)
+        self._kv: KVPages = init_kv_pages(self.kv_cfg)
+        self.pool = PagePool(num_pages)
+        self.scheduler = ContinuousBatchingScheduler(
+            self.pool, SchedulerConfig(
+                max_slots=max_slots, page_size=page_size,
+                max_pages_per_seq=int(max_pages_per_seq),
+                max_queue=max_queue))
+        self.metrics = ServingMetrics(pool_pages=self.pool.num_usable)
+        self._use_kernel = use_kernel
+        self._buckets = tuple(sorted(int(b) for b in buckets)) if buckets \
+            else _parse_buckets(FLAGS.serving_prefill_buckets)
+        self._max_slots = max_slots
+        # donate the incoming KV pool: every call overwrites self._kv
+        # with the returned pool, so XLA may update pages in place —
+        # without this the decode tick copies the whole pool and peak
+        # HBM doubles the documented cost.  CPU doesn't support donation
+        # (it would just warn), hence the gate.
+        self._donate_kv = (1,) if jax.default_backend() != "cpu" else ()
+        self._decode_fn = jax.jit(self._build_decode_fn(),
+                                  donate_argnums=self._donate_kv)
+        self._prefill_fns: Dict[int, Callable] = {}
+        self._results: Dict[int, List[int]] = {}
+        self._requests: Dict[int, Request] = {}
+
+    # ---- compiled device functions --------------------------------------
+
+    def _build_decode_fn(self):
+        model, cfg = self.model, self.kv_cfg
+        page, use_kernel = cfg.page_size, self._use_kernel
+
+        def fn(params, kv: KVPages, tokens, positions, page_table, lens,
+               active):
+            # tokens/positions/lens/active: [B]; page_table: [B, Pm].
+            # One fused decode step: embed, per-layer append + paged
+            # attention, logits.  Inactive rows write the null page and
+            # produce garbage logits the host ignores.
+            b = tokens.shape[0]
+            x = model.embed(params, tokens, positions)
+            page_ids = jnp.where(
+                active, page_table[jnp.arange(b), lens // page], NULL_PAGE)
+            offs = lens % page
+            att_lens = jnp.where(active, lens + 1, 0)
+            for l in range(cfg.num_layers):
+                q, k, v = model.qkv(params, l, x)
+                kv = append_token(kv, l, k, v, page_ids, offs)
+                ctx = paged_decode_attention(
+                    q, kv.k[l], kv.v[l], page_table, att_lens,
+                    use_kernel=use_kernel)
+                x = model.attn_out(params, l, ctx, x)
+            return model.logits(params, x), kv
+
+        return fn
+
+    def _prefill_fn(self, bucket: int):
+        fn = self._prefill_fns.get(bucket)
+        if fn is not None:
+            return fn
+        model, cfg = self.model, self.kv_cfg
+        page = cfg.page_size
+        # kernel-shaped buckets prefill through the flash kernel; the
+        # rest (short buckets, odd head dims) use the plain reference
+        use_flash = (bucket % 128 == 0 and
+                     (cfg.head_dim * cfg.num_heads) % 8 == 0)
+
+        def raw(params, kv: KVPages, tokens, n, page_row):
+            # tokens: [bucket] i32 (padded); n: scalar i32 true length;
+            # page_row: [Pm] i32 — this request's page table row.
+            pos = jnp.arange(bucket, dtype=jnp.int32)
+            x = model.embed(params, tokens[None], pos[None])   # [1, T, E]
+            tmask = pos < n
+            dest = jnp.where(tmask, page_row[pos // page], NULL_PAGE)
+            offs = pos % page
+            seg = jnp.where(tmask, 0, 1)[None].astype(jnp.int32)
+            for l in range(cfg.num_layers):
+                q, k, v = model.qkv(params, l, x)              # [1, T, H, D]
+                kv = write_prompt(kv, l, k[0], v[0], dest, offs)
+                if use_flash:
+                    ctx = flash_attention(q, k, v, segment_ids=seg,
+                                          causal=True)
+                else:
+                    ctx = mha_reference(q, k, v, segment_ids=seg,
+                                        causal=True)
+                x = model.attn_out(params, l, ctx, x)
+            last = jnp.take(x[0], jnp.maximum(n - 1, 0), axis=0)
+            return model.logits(params, last), kv
+
+        fn = jax.jit(raw, donate_argnums=self._donate_kv)
+        self._prefill_fns[bucket] = fn
+        return fn
+
+    # ---- user surface ----------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], max_tokens: int,
+               on_token: Optional[Callable[[int], None]] = None,
+               now: Optional[float] = None) -> Optional[int]:
+        """Queue a request.  Returns its rid, or None if rejected
+        (infeasible size, or queue backpressure)."""
+        req = Request(prompt=list(int(t) for t in prompt),
+                      max_tokens=int(max_tokens), on_token=on_token)
+        t = time.monotonic() if now is None else now
+        ok = self.scheduler.submit(req, now=t)
+        self.metrics.on_submit(t, ok)
+        if not ok:
+            return None
+        self._requests[req.rid] = req
+        return req.rid
+
+    @property
+    def has_work(self) -> bool:
+        return self.scheduler.has_work
+
+    def step(self, now: Optional[float] = None) -> bool:
+        """One engine tick: admit + prefill, grow/preempt, one fused
+        decode over all running sequences.  Returns True if any work
+        remains."""
+        now = time.monotonic() if now is None else now
+        sched, m = self.scheduler, self.metrics
+        # growth/preemption BEFORE admission: a tick must not pay for a
+        # new request's prefill and then immediately preempt it (the
+        # youngest) to grow older sequences.  admit() reserves the first
+        # decode append's page, so fresh admissions never need same-tick
+        # growth either.
+        m.on_preempt(len(sched.ensure_decode_pages()))
+        for req in sched.admit():
+            self._do_prefill(req)
+        running = [r for r in sched.running_requests()
+                   if r.status == "running"]
+        if running:
+            self._do_decode(running)
+        m.on_tick(sched.queue_depth, self.pool.num_in_use)
+        return self.has_work
+
+    def run(self, max_ticks: Optional[int] = None) -> Dict[int, List[int]]:
+        """Tick until drained (or ``max_ticks``); returns
+        {rid: generated tokens} for everything completed so far."""
+        ticks = 0
+        while self.has_work:
+            self.step()
+            ticks += 1
+            if max_ticks is not None and ticks >= max_ticks:
+                break
+        return dict(self._results)
+
+    def result(self, rid: int) -> Optional[List[int]]:
+        return self._results.get(rid)
+
+    # ---- internals -------------------------------------------------------
+
+    def _do_prefill(self, req: Request) -> None:
+        toks = req.cache_tokens
+        n = len(toks)
+        bucket = bucket_for(n, self._buckets, self.kv_cfg.max_seq_len)
+        padded = np.zeros((bucket,), np.int32)
+        padded[:n] = toks
+        row = np.full((self.kv_cfg.max_pages_per_seq,), NULL_PAGE, np.int32)
+        row[:len(req.pages)] = req.pages
+        logits, self._kv = self._prefill_fn(bucket)(
+            self.params, self._kv, jnp.asarray(padded),
+            jnp.asarray(n, jnp.int32), jnp.asarray(row))
+        req.cache_len = n
+        self.metrics.on_prefill(n)
+        tok = int(np.argmax(np.asarray(logits)))  # forces device sync
+        # stamp AFTER the sync so TTFT includes the prefill compute
+        self._emit(req, tok, time.monotonic())
+
+    def _do_decode(self, running: List[Request]) -> None:
+        b = self._max_slots
+        cfg = self.kv_cfg
+        tokens = np.zeros((b,), np.int32)
+        positions = np.zeros((b,), np.int32)
+        lens = np.zeros((b,), np.int32)
+        active = np.zeros((b,), bool)
+        table = np.full((b, cfg.max_pages_per_seq), NULL_PAGE, np.int32)
+        for req in running:
+            s = req.slot
+            tokens[s] = req.generated[-1]
+            positions[s] = req.cache_len
+            lens[s] = req.cache_len
+            active[s] = True
+            table[s, :len(req.pages)] = req.pages
+        logits, self._kv = self._decode_fn(
+            self.params, self._kv, jnp.asarray(tokens),
+            jnp.asarray(positions), jnp.asarray(table), jnp.asarray(lens),
+            jnp.asarray(active))
+        logits = np.asarray(logits)   # forces device sync
+        now = time.monotonic()        # emission time includes the compute
+        for req in running:
+            req.cache_len += 1
+            self._emit(req, int(np.argmax(logits[req.slot])), now)
+
+    def _emit(self, req: Request, tok: int, now: float) -> None:
+        req.generated.append(tok)
+        ttft = None
+        if req.first_token_at is None:
+            req.first_token_at = now
+            ttft = max(0.0, now - (req.submitted_at or now))
+        self.metrics.on_token(now, ttft)
+        if req.on_token is not None:
+            req.on_token(tok)
+        if tok == self.eos_id or len(req.generated) >= req.max_tokens:
+            req.finished_at = now
+            self.scheduler.release(req)
+            self._results[req.rid] = list(req.generated)
+            self.metrics.on_complete()

@@ -1,0 +1,175 @@
+"""Block-paged KV cache for the serving engine.
+
+The TPU-native analog of vLLM-style paged KV storage (Ragged Paged
+Attention, arXiv 2604.15464): instead of one contiguous per-sequence
+[max_len, H, D] buffer, K/V live in a preallocated pool of fixed-size
+pages ``[num_pages, page_size, H, D]`` (one pool slice per layer).  Each
+sequence owns an ordered list of page ids — its *page table* — and grows
+one page at a time, so HBM is shared at page granularity across
+concurrently-decoding requests with zero fragmentation beyond the last
+partial page.
+
+Split of responsibilities:
+
+- **Device side** (pure functions, jit-safe): ``append_token`` /
+  ``write_prompt`` scatter new K/V into pages, ``gather_kv`` linearizes a
+  page table back into a contiguous view (the oracle/fallback path).
+  These take page ids and offsets as *arrays*, so one jit specialization
+  serves every allocation pattern.
+- **Host side**: :class:`PagePool` is the free list.  Allocation is a
+  scheduling decision (admission control, growth, preemption), so it
+  stays in python — the device never sees the free list, only page
+  tables.
+
+Page 0 is **reserved as the null page**: masked writes (prompt padding,
+inactive decode slots) are steered to it instead of being predicated
+out, which keeps every scatter dense and shape-stable under jit.  No
+live sequence is ever granted page 0.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.platform.enforce import enforce_that
+
+NULL_PAGE = 0
+
+
+@dataclass(frozen=True)
+class PagedKVConfig:
+    """Static geometry of the paged pool (one pool shared by all layers:
+    page id ``p`` addresses layer ``l``'s slice ``k[l, p]`` for every l)."""
+
+    num_layers: int
+    num_heads: int
+    head_dim: int
+    page_size: int
+    num_pages: int           # includes the reserved null page 0
+    max_pages_per_seq: int   # page-table width (static decode grid bound)
+    dtype: jnp.dtype = jnp.float32
+
+    def __post_init__(self):
+        enforce_that(self.num_pages >= 2,
+                     "need at least one usable page beyond the null page",
+                     context="serving")
+        enforce_that(self.page_size >= 1 and self.max_pages_per_seq >= 1,
+                     "page_size and max_pages_per_seq must be positive",
+                     context="serving")
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.page_size * self.max_pages_per_seq
+
+    @property
+    def usable_pages(self) -> int:
+        return self.num_pages - 1  # page 0 is the null page
+
+    def kv_bytes(self) -> int:
+        per = (self.num_layers * self.num_pages * self.page_size *
+               self.num_heads * self.head_dim *
+               jnp.dtype(self.dtype).itemsize)
+        return 2 * per
+
+
+class KVPages(NamedTuple):
+    """The device-resident pool: ``k``/``v`` are
+    [num_layers, num_pages, page_size, num_heads, head_dim]."""
+
+    k: jax.Array
+    v: jax.Array
+
+
+def init_kv_pages(cfg: PagedKVConfig) -> KVPages:
+    shape = (cfg.num_layers, cfg.num_pages, cfg.page_size, cfg.num_heads,
+             cfg.head_dim)
+    return KVPages(jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+
+
+def append_token(kv: KVPages, layer: int, k_new: jax.Array, v_new: jax.Array,
+                 page_ids: jax.Array, offsets: jax.Array) -> KVPages:
+    """Scatter one decode token per sequence into its current page.
+
+    k_new/v_new: [B, H, D]; page_ids/offsets: [B] int32 (inactive slots
+    pass page_ids == NULL_PAGE — duplicates on the null page are fine,
+    nothing reads it).  Pure; returns the updated pool."""
+    k = kv.k.at[layer, page_ids, offsets].set(k_new.astype(kv.k.dtype))
+    v = kv.v.at[layer, page_ids, offsets].set(v_new.astype(kv.v.dtype))
+    return KVPages(k, v)
+
+
+def write_prompt(kv: KVPages, layer: int, k_seq: jax.Array, v_seq: jax.Array,
+                 dest_pages: jax.Array, offsets: jax.Array) -> KVPages:
+    """Scatter a whole (padded) prompt into pages at prefill.
+
+    k_seq/v_seq: [T, H, D]; dest_pages/offsets: [T] int32, with padded
+    positions (t >= true length) steered to NULL_PAGE by the caller."""
+    k = kv.k.at[layer, dest_pages, offsets].set(k_seq.astype(kv.k.dtype))
+    v = kv.v.at[layer, dest_pages, offsets].set(v_seq.astype(kv.v.dtype))
+    return KVPages(k, v)
+
+
+def gather_kv(kv: KVPages, layer: int, page_table: jax.Array):
+    """Linearize page tables into contiguous K/V.
+
+    page_table: [B, max_pages_per_seq] int32.  Returns (k, v) each
+    [B, max_pages_per_seq * page_size, H, D] — positions beyond a
+    sequence's length hold whatever the referenced pages contain (callers
+    mask by length; this is the oracle/fallback read path)."""
+    kl, vl = kv.k[layer], kv.v[layer]
+    b, pm = page_table.shape
+    _, page, h, d = kl.shape
+    k = kl[page_table].reshape(b, pm * page, h, d)
+    v = vl[page_table].reshape(b, pm * page, h, d)
+    return k, v
+
+
+@dataclass
+class PagePool:
+    """Host-side free list over page ids 1..num_pages-1 (0 is the null
+    page).  Allocation is all-or-nothing so admission control can't
+    partially strand a request."""
+
+    num_pages: int
+    _free: List[int] = field(default_factory=list)
+
+    def __post_init__(self):
+        enforce_that(self.num_pages >= 2, "pool needs >= 2 pages",
+                     context="serving")
+        # LIFO over ascending ids: recently-freed pages are re-granted
+        # first, keeping the working set compact
+        self._free = list(range(self.num_pages - 1, NULL_PAGE, -1))
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_usable(self) -> int:
+        return self.num_pages - 1
+
+    @property
+    def num_in_use(self) -> int:
+        return self.num_usable - self.num_free
+
+    def occupancy(self) -> float:
+        return self.num_in_use / max(1, self.num_usable)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Grant ``n`` pages, or None (and no change) if fewer are free."""
+        if n < 0 or n > len(self._free):
+            return None
+        got = [self._free.pop() for _ in range(n)]
+        return got
+
+    def free(self, pages: List[int]) -> None:
+        for p in pages:
+            enforce_that(p != NULL_PAGE, "cannot free the null page",
+                         context="serving")
+            enforce_that(p not in self._free, f"double free of page {p}",
+                         context="serving")
+            self._free.append(p)

@@ -1,0 +1,99 @@
+"""Serving metrics: the counters the bench (and any scraper) reads.
+
+Kept deliberately flat — ``snapshot()`` returns one JSON-able dict so
+``bench.py``'s one-line-of-JSON contract and an external exporter see
+the same numbers.  Time handling: the engine stamps events with
+``time.monotonic()`` and the throughput window runs from the first
+submission to the last emitted token, so idle tails (drained engine
+waiting for arrivals) don't deflate tokens/s.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+class ServingMetrics:
+    def __init__(self, pool_pages: int):
+        self.pool_pages = max(1, pool_pages)
+        self.submitted = 0
+        self.rejected = 0
+        self.completed = 0
+        self.preemptions = 0
+        self.ticks = 0
+        self.tokens_generated = 0
+        self.prefill_tokens = 0
+        self.queue_depth = 0          # gauge: last tick
+        self.pages_in_use = 0         # gauge: last tick
+        self.peak_pages_in_use = 0
+        self.ttft_s: List[float] = []
+        self._first_event_at: Optional[float] = None
+        self._last_token_at: Optional[float] = None
+
+    # ---- event hooks (called by the engine) ------------------------------
+
+    def on_submit(self, now: float, accepted: bool) -> None:
+        self.submitted += 1
+        if not accepted:
+            self.rejected += 1
+        if self._first_event_at is None:
+            self._first_event_at = now
+
+    def on_prefill(self, n_tokens: int) -> None:
+        self.prefill_tokens += n_tokens
+
+    def on_token(self, now: float, ttft_s: Optional[float] = None) -> None:
+        self.tokens_generated += 1
+        self._last_token_at = now
+        if ttft_s is not None:
+            self.ttft_s.append(ttft_s)
+
+    def on_complete(self) -> None:
+        self.completed += 1
+
+    def on_preempt(self, n: int) -> None:
+        self.preemptions += n
+
+    def on_tick(self, queue_depth: int, pages_in_use: int) -> None:
+        self.ticks += 1
+        self.queue_depth = queue_depth
+        self.pages_in_use = pages_in_use
+        self.peak_pages_in_use = max(self.peak_pages_in_use, pages_in_use)
+
+    # ---- scrape ----------------------------------------------------------
+
+    def tokens_per_s(self) -> float:
+        if (self._first_event_at is None or self._last_token_at is None or
+                self._last_token_at <= self._first_event_at):
+            return 0.0
+        return self.tokens_generated / (self._last_token_at -
+                                        self._first_event_at)
+
+    def ttft_ms_mean(self) -> float:
+        if not self.ttft_s:
+            return 0.0
+        return 1000.0 * sum(self.ttft_s) / len(self.ttft_s)
+
+    def ttft_ms_p95(self) -> float:
+        if not self.ttft_s:
+            return 0.0
+        s = sorted(self.ttft_s)
+        return 1000.0 * s[min(len(s) - 1, int(0.95 * len(s)))]
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "tokens_per_s": round(self.tokens_per_s(), 2),
+            "ttft_ms_mean": round(self.ttft_ms_mean(), 3),
+            "ttft_ms_p95": round(self.ttft_ms_p95(), 3),
+            "tokens_generated": self.tokens_generated,
+            "prefill_tokens": self.prefill_tokens,
+            "requests_submitted": self.submitted,
+            "requests_rejected": self.rejected,
+            "requests_completed": self.completed,
+            "preemptions": self.preemptions,
+            "ticks": self.ticks,
+            "queue_depth": self.queue_depth,
+            "page_occupancy": round(self.pages_in_use / self.pool_pages, 4),
+            "page_occupancy_peak": round(
+                self.peak_pages_in_use / self.pool_pages, 4),
+        }

@@ -1,0 +1,227 @@
+"""Continuous-batching scheduler: the host-side policy of the serving
+engine.
+
+The reference served generation through ``SequenceGenerator``
+(paddle/api/SequenceGenerator.cpp:38-96) — one request at a time, one
+host->C++ forward per token.  Here requests arrive and finish at
+different times and the chip must stay busy throughout, so scheduling is
+continuous: every engine tick (1) admits queued requests while slots AND
+pages are available, (2) prefills them bucketed to a small ladder of
+padded lengths (one jit specialization per bucket), (3) runs ONE fused
+decode step over all running sequences, (4) retires sequences on EOS or
+``max_tokens`` and returns their pages, and (5) when the page pool runs
+dry mid-decode, preempts the youngest running sequence (its pages are
+freed, its tokens re-queued for re-prefill — the recompute flavour of
+vLLM-style preemption) so the oldest requests always make progress.
+
+This module is pure bookkeeping — no jax.  The engine owns the compiled
+prefill/decode functions and calls into the scheduler for decisions, so
+the policy is testable without a model.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+from paddle_tpu.platform.enforce import enforce_that
+from paddle_tpu.serving.kv_cache import PagePool
+
+_rid_counter = itertools.count()
+
+
+@dataclass
+class Request:
+    """One generation request and its runtime bookkeeping."""
+
+    prompt: List[int]
+    max_tokens: int
+    on_token: Optional[Callable[[int], None]] = None
+    rid: int = field(default_factory=lambda: next(_rid_counter))
+
+    # runtime state (owned by the scheduler/engine)
+    generated: List[int] = field(default_factory=list)
+    pages: List[int] = field(default_factory=list)
+    slot: Optional[int] = None
+    cache_len: int = 0              # tokens currently materialized in KV
+    status: str = "queued"          # queued | running | done | rejected
+    submitted_at: Optional[float] = None
+    first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    preemptions: int = 0
+
+    @property
+    def cache_tokens(self) -> List[int]:
+        """Tokens that must be in the KV cache before the next decode:
+        the prompt plus everything generated so far (after a preemption
+        the whole list is re-prefilled and the prefill's last-position
+        logits produce the NEXT, not-yet-emitted token)."""
+        return self.prompt + self.generated
+
+    @property
+    def finished(self) -> bool:
+        return self.status in ("done", "rejected")
+
+
+@dataclass(frozen=True)
+class SchedulerConfig:
+    max_slots: int
+    page_size: int
+    max_pages_per_seq: int
+    max_queue: Optional[int] = None   # None = unbounded queueing
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.page_size * self.max_pages_per_seq
+
+
+class ContinuousBatchingScheduler:
+    """Queue + slot + page bookkeeping.  All methods are host-side and
+    cheap; device work happens in the engine between calls."""
+
+    def __init__(self, pool: PagePool, cfg: SchedulerConfig):
+        self.pool = pool
+        self.cfg = cfg
+        self.queue: Deque[Request] = deque()
+        self.running: Dict[int, Request] = {}       # slot -> request
+        self._free_slots: List[int] = list(range(cfg.max_slots - 1, -1, -1))
+        self.preemption_count = 0
+
+    # ---- admission -------------------------------------------------------
+
+    def submit(self, req: Request, now: Optional[float] = None) -> bool:
+        """Enqueue, or refuse.  Refusal (returns False, status
+        'rejected') happens for requests that could NEVER run — longer
+        than ``max_seq_len`` or needing more pages than the pool owns —
+        and as backpressure when the queue is at ``max_queue``."""
+        enforce_that(len(req.prompt) >= 1, "empty prompt", context="serving")
+        enforce_that(req.max_tokens >= 1, "max_tokens must be >= 1",
+                     context="serving")
+        req.submitted_at = time.monotonic() if now is None else now
+        total = len(req.prompt) + req.max_tokens
+        if total > self.cfg.max_seq_len or \
+                self._pages_for(total) > self.pool.num_usable:
+            req.status = "rejected"
+            return False
+        if self.cfg.max_queue is not None and \
+                len(self.queue) >= self.cfg.max_queue:
+            req.status = "rejected"
+            return False
+        req.status = "queued"
+        self.queue.append(req)
+        return True
+
+    def _pages_for(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.cfg.page_size)  # ceil
+
+    def admit(self) -> List[Request]:
+        """Move queued requests into slots while a slot AND the pages for
+        their (re-)prefill are available.  FIFO with head-of-line
+        blocking: a big request at the head waits rather than being
+        starved by small ones slipping past it.
+
+        The allocation covers ``cache_tokens + 1`` — the prefill plus
+        the first decode append — so a freshly-admitted request can
+        never be the growth victim of the very tick that paid for its
+        prefill (the engine runs growth/preemption BEFORE admission)."""
+        admitted: List[Request] = []
+        while self.queue and self._free_slots:
+            req = self.queue[0]
+            pages = self.pool.alloc(
+                self._pages_for(len(req.cache_tokens) + 1))
+            if pages is None:
+                break
+            self.queue.popleft()
+            req.pages = pages
+            req.slot = self._free_slots.pop()
+            req.status = "running"
+            self.running[req.slot] = req
+            admitted.append(req)
+        return admitted
+
+    # ---- decode-time growth / preemption --------------------------------
+
+    def ensure_decode_pages(self) -> List[Request]:
+        """Before a decode tick: every running sequence whose next append
+        lands on a page boundary needs one more page.  Oldest requests
+        are served first; when the pool is dry the YOUNGEST running
+        sequence is preempted (pages freed, tokens re-queued at the
+        front) until the growth fits.  Returns the preempted requests."""
+        preempted: List[Request] = []
+        for req in sorted(self.running.values(),
+                          key=lambda r: (r.submitted_at, r.rid)):
+            if req.status != "running":
+                continue  # preempted below while an older one grew
+            if req.cache_len < len(req.pages) * self.cfg.page_size:
+                continue
+            while True:
+                got = self.pool.alloc(1)
+                if got is not None:
+                    req.pages.extend(got)
+                    break
+                victim = self._youngest_running(exclude=req)
+                if victim is None:
+                    victim = req  # alone and stuck: requeue itself
+                self._preempt(victim)
+                preempted.append(victim)
+                if victim is req:
+                    break
+        return preempted
+
+    def _youngest_running(self, exclude: Request) -> Optional[Request]:
+        cands = [r for r in self.running.values() if r is not exclude]
+        if not cands:
+            return None
+        return max(cands, key=lambda r: (r.submitted_at, r.rid))
+
+    def _preempt(self, req: Request) -> None:
+        self._release_slot_and_pages(req)
+        req.cache_len = 0
+        req.status = "queued"
+        req.preemptions += 1
+        self.preemption_count += 1
+        self.queue.appendleft(req)
+
+    # ---- completion ------------------------------------------------------
+
+    def release(self, req: Request) -> None:
+        """Return a finished sequence's slot and pages to the pool."""
+        self._release_slot_and_pages(req)
+        req.status = "done"
+
+    def _release_slot_and_pages(self, req: Request) -> None:
+        if req.pages:
+            self.pool.free(req.pages)
+            req.pages = []
+        if req.slot is not None:
+            del self.running[req.slot]
+            self._free_slots.append(req.slot)
+            req.slot = None
+
+    # ---- views -----------------------------------------------------------
+
+    def running_requests(self) -> List[Request]:
+        return [self.running[s] for s in sorted(self.running)]
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.queue or self.running)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.queue)
+
+
+def bucket_for(length: int, buckets: Tuple[int, ...], max_len: int) -> int:
+    """Smallest bucket >= length; lengths beyond the ladder round up to
+    the next page-agnostic multiple of the largest bucket, capped at
+    ``max_len`` (so the number of prefill jit specializations stays
+    O(len(buckets) + max_len / max(buckets)))."""
+    for b in sorted(buckets):
+        if length <= b <= max_len:
+            return b
+    top = max(buckets) if buckets else max_len
+    return min(max_len, -(-length // top) * top)

@@ -7,6 +7,7 @@ set -o pipefail
 cd "$(dirname "$0")"
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --durations=10 \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
     -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
