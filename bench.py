@@ -863,6 +863,104 @@ def worker_serving():
     print(json.dumps(out), flush=True)
 
 
+def worker_serving_chaos():
+    """worker_serving's Poisson trace re-run under the default seeded
+    FaultPlan — page-pool pressure, one NaN-poisoned rid, random
+    transient decode errors, and slow ticks — on the INJECTED clock (no
+    wall-clock dependence, so the numbers replay bit-identically).  The
+    SLO contract is asserted, not just reported: every non-poisoned
+    request completes within its deadline or is shed with a terminal
+    status, the poisoned rid ends FAILED while its fused batchmates keep
+    greedy parity with the non-paged oracle, and the free-list
+    conservation check passes at drain (a violation raises PageLeakError
+    and fails the worker)."""
+    import numpy as np
+
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import (DecoderLM, FaultPlan, ManualClock,
+                                    RequestStatus, ServingEngine,
+                                    greedy_decode_reference)
+
+    paddle.init()
+    rng = np.random.RandomState(0)
+    vocab, eos = 512, 1
+    model = DecoderLM(vocab_size=vocab, num_layers=2, num_heads=2,
+                      head_dim=16, max_positions=256)
+    params = model.init_params(jax.random.PRNGKey(0))
+    clock = ManualClock(tick_s=0.02)
+    plan = FaultPlan(seed=0, clock=clock,
+                     decode_error_rate=0.05,          # transient, retried
+                     slow_ticks={7: 0.3, 19: 0.5},    # injected tail ticks
+                     page_pressure=(6, 26, 44))       # squeeze the pool
+    eng = ServingEngine(model, params, eos_id=eos, page_size=16,
+                        num_pages=64, max_pages_per_seq=8, max_slots=8,
+                        buckets=(16, 32, 48), faults=plan,
+                        watchdog_ticks=32, preempt_budget=3)
+    n_req, rate = 24, 50.0          # same offered trace as worker_serving
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, n_req))
+    prompts = [rng.randint(2, vocab, size=rng.randint(4, 49)).tolist()
+               for _ in range(n_req)]
+    poison_idx, deadline_s = 5, 10.0
+
+    rids = [None] * n_req
+    i = 0
+    while i < n_req or eng.has_work:
+        while i < n_req and arrivals[i] <= clock():
+            rids[i] = eng.submit(prompts[i], max_tokens=16,
+                                 deadline_s=deadline_s)
+            if i == poison_idx:
+                plan.poison_nan(rids[i])
+            i += 1
+        eng.step()                  # advances the injected clock
+        assert eng.metrics.ticks < 5000, "chaos trace failed to drain"
+    results = eng.run(max_ticks=1)  # drained: runs the conservation check
+
+    parity_checked = parity_ok = 0
+    terminal_ok = True
+    for j, rid in enumerate(rids):
+        st = eng.status(rid)
+        if j == poison_idx:
+            assert st is RequestStatus.FAILED, f"poisoned rid: {st}"
+            continue
+        if st is RequestStatus.COMPLETED:
+            parity_checked += 1
+            want = greedy_decode_reference(model, params, prompts[j], 16,
+                                           eos)
+            parity_ok += int(results[rid] == want)
+        else:
+            # shed, not wedged: only terminal statuses are acceptable
+            terminal_ok &= st in (RequestStatus.TIMED_OUT,
+                                  RequestStatus.REJECTED,
+                                  RequestStatus.CANCELLED)
+    assert terminal_ok, "non-terminal survivor after drain"
+    assert parity_checked == parity_ok, "greedy parity broke under chaos"
+    leaked = eng.pool.num_usable - eng.pool.num_free
+    assert leaked == 0, f"{leaked} pages leaked"
+
+    snap = eng.metrics.snapshot()
+    hz = eng.healthz()
+    out = {
+        "serving_chaos_model": "decoderlm_L2_H2_D16_v512_page16_pool64"
+                               "_slots8_faultplan_seed0",
+        "serving_chaos_completed": snap["requests_completed"],
+        "serving_chaos_timed_out": snap["requests_timed_out"],
+        "serving_chaos_shed": snap["requests_shed"],
+        "serving_chaos_failed": snap["requests_failed"],
+        "serving_chaos_retries": snap["retries"],
+        "serving_chaos_preemptions": snap["preemptions"],
+        "serving_chaos_deadline_miss_rate": snap["deadline_miss_rate"],
+        "serving_chaos_queue_wait_ms_p95": snap["queue_wait_ms_p95"],
+        "serving_chaos_page_leaks": leaked,
+        "serving_chaos_parity_ok": parity_ok,
+        "serving_chaos_parity_checked": parity_checked,
+        "serving_chaos_healthz_ok": int(bool(hz["ok"])),
+        "serving_chaos_ticks": snap["ticks"],
+    }
+    print(json.dumps(out), flush=True)
+
+
 def worker_moe():
     """MoE transformer LM vs its dense twin on one chip: single-chip
     Switch-style MoE (top-1 routing, dense dispatch formulation) at the
@@ -1018,6 +1116,7 @@ WORKERS = {
     "scaling": worker_scaling,
     "zero1": worker_zero1,
     "serving": worker_serving,
+    "serving_chaos": worker_serving_chaos,
     "moe": worker_moe,
 }
 
@@ -1102,7 +1201,7 @@ def main():
     errors = {}
 
     # cheap + hardware-independent first: never starved by a dead tunnel
-    for cpu_worker in ("scaling", "zero1", "serving"):
+    for cpu_worker in ("scaling", "zero1", "serving", "serving_chaos"):
         out, err = _run_worker(cpu_worker, deadline, cpu=True,
                                attempt_timeout=380, max_attempts=1)
         if out:

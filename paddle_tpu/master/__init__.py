@@ -20,7 +20,7 @@ trainer reads via the recordio library).
 from .recordio import recordio_write, recordio_read_chunk, recordio_index
 from .service import Task, Service, MAX_TASK_FAILURES
 from .server import MasterServer
-from .client import MasterClient
+from .client import MasterClient, MasterRetryExhausted
 
 __all__ = [
     "recordio_write",
@@ -30,5 +30,6 @@ __all__ = [
     "Service",
     "MasterServer",
     "MasterClient",
+    "MasterRetryExhausted",
     "MAX_TASK_FAILURES",
 ]

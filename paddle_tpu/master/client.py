@@ -8,17 +8,71 @@ inmem_store analog used throughout the reference's tests); passing an
 ``next_record()`` drives the task lifecycle: fetch a task, stream its
 chunks from local recordio files, report task_finished, and return None
 at end of pass.
+
+Transient failures (dropped connections, a master mid-restart, an empty
+todo queue while peers hold leases) are retried with CAPPED EXPONENTIAL
+BACKOFF + DECORRELATED JITTER — ``sleep = min(cap, uniform(base,
+3 * prev))`` — instead of a fixed-interval poll, so a restarting master
+isn't hammered by a synchronized trainer fleet.  ``retry_budget`` bounds
+consecutive failed attempts; exhausting it raises
+:class:`MasterRetryExhausted` with the last underlying error, so a
+wedged master surfaces as a clear trainer error instead of a silent
+infinite loop.
 """
 
 from __future__ import annotations
 
+import random
 import socket
 import time
-from typing import List, Optional
+from typing import Callable, List, Optional
 
 from .recordio import recordio_read_chunk
 from .service import Service, dispatch
 from .server import send_msg, recv_msg
+
+
+class MasterRetryExhausted(ConnectionError):
+    """The client's retry budget ran out without a successful call."""
+
+
+class _Backoff:
+    """Capped exponential backoff with decorrelated jitter (the AWS
+    architecture-blog flavor: each sleep draws uniform(base, 3 * prev),
+    clamped to cap — successive clients decorrelate instead of
+    thundering back in lockstep).  ``budget`` caps consecutive sleeps;
+    ``reset()`` (on success) restores the full budget and the base
+    interval.  ``sleep_fn`` is injectable so tests drive retries without
+    wall-clock sleeping."""
+
+    def __init__(self, base_s: float, cap_s: float,
+                 budget: Optional[int] = None,
+                 seed: Optional[int] = None,
+                 sleep_fn: Callable[[float], None] = time.sleep):
+        self.base_s = max(1e-4, float(base_s))
+        self.cap_s = max(self.base_s, float(cap_s))
+        self.budget = budget
+        # seed=None -> OS entropy: every client in a fleet draws a
+        # DIFFERENT jitter sequence (a shared fixed seed would put the
+        # whole fleet back in lockstep, recreating the thundering herd
+        # the jitter exists to break). Pass a seed for replayable tests.
+        self._rng = random.Random(seed)
+        self._sleep_fn = sleep_fn
+        self.reset()
+
+    def reset(self) -> None:
+        self.attempts = 0
+        self._prev = self.base_s
+
+    def sleep(self, why: str = "") -> None:
+        self.attempts += 1
+        if self.budget is not None and self.attempts > self.budget:
+            raise MasterRetryExhausted(
+                f"master retry budget ({self.budget}) exhausted"
+                f"{': ' + why if why else ''}")
+        self._prev = min(self.cap_s,
+                         self._rng.uniform(self.base_s, 3.0 * self._prev))
+        self._sleep_fn(self._prev)
 
 
 class _InprocTransport:
@@ -30,41 +84,144 @@ class _InprocTransport:
 
 
 class _TcpTransport:
-    def __init__(self, addr: str, timeout_s: float = 30.0):
+    """TCP transport with reconnect-on-failure.  A dropped connection
+    (master restart, flaky network) triggers backoff + reconnect and a
+    re-send of the in-flight call.  At-least-once caveat: a call that
+    reached the master before the drop may execute twice — idempotent
+    methods tolerate this (set_dataset dedups, task_finished/failed on a
+    non-pending id is a no-op False).  ``get_task`` is NOT idempotent (a
+    blind re-send would lease a SECOND task while the lost response's
+    lease silently burns that task's failure budget on expiry), so a
+    lost get_task response is reported as None — "nothing available" —
+    and the caller's poll loop retries; the orphaned lease requeues via
+    the server's normal timeout path.  ``register`` is re-sent: a lost
+    response may strand one unowned slot, but the caller needs the
+    slot/token to proceed and the stray slot self-heals when its TTL
+    lease expires — the least-bad option without server-side request
+    dedup."""
+
+    _LEASING_METHODS = frozenset({"get_task"})
+
+    def __init__(self, addr: str, timeout_s: float = 30.0,
+                 backoff: Optional[_Backoff] = None):
         host, port = addr.rsplit(":", 1)
-        self._sock = socket.create_connection((host, int(port)),
-                                              timeout=timeout_s)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._addr = (host, int(port))
+        self._timeout_s = timeout_s
+        self._backoff = backoff or _Backoff(0.05, 2.0)
+        self._sock: Optional[socket.socket] = None
+        self._send_attempted = False
+        self._connect()
+
+    def _connect(self) -> None:
+        """(Re)establish the connection, backing off between attempts;
+        raises MasterRetryExhausted when the budget runs out."""
+        self.close()
+        while True:
+            try:
+                self._sock = socket.create_connection(
+                    self._addr, timeout=self._timeout_s)
+                self._sock.setsockopt(socket.IPPROTO_TCP,
+                                      socket.TCP_NODELAY, 1)
+                return
+            except OSError as e:
+                self._sock = None
+                self._backoff.sleep(f"connect to {self._addr}: {e}")
 
     def call(self, method: str, **params):
+        while True:
+            self._send_attempted = False
+            try:
+                if self._sock is None:
+                    self._connect()
+                return self.call_once(method, **params)
+            except (ConnectionError, OSError) as e:
+                self._backoff.sleep(f"call {method}: {e}")
+                self._connect()
+                # only once bytes may actually have left (the send was
+                # attempted) is a leasing call ambiguous; a connect-time
+                # failure provably never reached the master, so re-send
+                if self._send_attempted and \
+                        method in self._LEASING_METHODS:
+                    return None
+
+    def call_once(self, method: str, **params):
+        """One attempt, no backoff and no reconnect — the shutdown path
+        (a dead master must not stall ``close()`` through a retry
+        budget)."""
+        if self._sock is None:
+            raise ConnectionError("not connected")
+        self._send_attempted = True
         send_msg(self._sock, {"method": method, "params": params})
         resp = recv_msg(self._sock)
         if resp is None:
             raise ConnectionError("master connection closed")
+        self._backoff.reset()
         if not resp.get("ok"):
             raise RuntimeError(f"master error: {resp.get('error')}")
         return resp.get("result")
 
     def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+
+DEFAULT_TRANSPORT_RETRY_BUDGET = 30
 
 
 class MasterClient:
+    """``retry_budget`` semantics: when left at None, TRANSPORT failures
+    (connect / dropped call) still get a finite default budget
+    (:data:`DEFAULT_TRANSPORT_RETRY_BUDGET` — a permanently-dead master
+    must surface as :class:`MasterRetryExhausted`, not a silent forever
+    loop), while the task POLL loop stays unbounded (waiting out peers
+    that hold long-running tasks is legitimate, and the old fixed-poll
+    behavior waited forever too).  An explicit ``retry_budget`` bounds
+    both."""
+
     def __init__(self, addr: Optional[str] = None,
                  service: Optional[Service] = None,
-                 poll_interval_s: float = 0.05):
+                 poll_interval_s: float = 0.05,
+                 retry_cap_s: float = 2.0,
+                 retry_budget: Optional[int] = None,
+                 sleep_fn: Callable[[float], None] = time.sleep):
+        # two independent backoff states: transport-level reconnects and
+        # the task-poll loop each get the full budget, both using
+        # poll_interval_s as the base interval (OS-entropy jitter, so a
+        # trainer fleet decorrelates)
+        self._poll_backoff = _Backoff(poll_interval_s, retry_cap_s,
+                                      budget=retry_budget,
+                                      sleep_fn=sleep_fn)
         if addr:
-            self._t = _TcpTransport(addr)
+            transport_budget = retry_budget if retry_budget is not None \
+                else DEFAULT_TRANSPORT_RETRY_BUDGET
+            self._t = _TcpTransport(addr, backoff=_Backoff(
+                poll_interval_s, retry_cap_s, budget=transport_budget,
+                sleep_fn=sleep_fn))
         else:
             self._t = _InprocTransport(service)
-        self._poll = poll_interval_s
         self._records: List[bytes] = []
         self._task_id: Optional[int] = None
         self._slot: Optional[int] = None
         self._token: Optional[str] = None
+
+    # -- polling -------------------------------------------------------------
+
+    def poll_wait(self) -> None:
+        """Back off before re-asking for work (the master had nothing —
+        peers hold the pending tasks).  Jittered and counted against the
+        poll retry budget, exactly like ``next_record``'s internal loop;
+        callers driving ``try_next_task`` themselves (the elastic
+        trainer) use this instead of a fixed sleep."""
+        self._poll_backoff.sleep("waiting for an available task")
+
+    def poll_reset(self) -> None:
+        """Work arrived: restore the poll backoff to its base interval
+        and refund the budget."""
+        self._poll_backoff.reset()
 
     # -- dataset / records ---------------------------------------------------
 
@@ -164,11 +321,17 @@ class MasterClient:
 
     def close(self) -> None:
         # release an in-flight task immediately rather than letting its
-        # lease time out and re-serve already-consumed records
+        # lease time out and re-serve already-consumed records.  ONE
+        # attempt, no retry loop: shutdown against a dead master must
+        # fail fast, not sit out the whole transport backoff budget
         try:
-            self.task_failed()
+            if self._task_id is not None:
+                once = getattr(self._t, "call_once", self._t.call)
+                once("task_failed", task_id=self._task_id)
         except (ConnectionError, RuntimeError, OSError):
             pass
+        self._task_id = None
+        self._records = []
         if hasattr(self._t, "close"):
             self._t.close()
 
@@ -182,10 +345,13 @@ class MasterClient:
         while True:
             task = self._t.call("get_task", owner=self._slot)
             if task is not None:
+                self.poll_reset()
                 break
             if self._t.call("all_done"):
+                self.poll_reset()
                 return False
-            time.sleep(self._poll)  # other workers hold pending tasks
+            # other workers hold pending tasks: poll with backoff+jitter
+            self.poll_wait()
         recs: List[bytes] = []
         try:
             for c in task["chunks"]:

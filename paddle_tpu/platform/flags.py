@@ -139,6 +139,23 @@ FLAGS.define("serving_prefill_buckets", "32,64,128,256,512",
              "prompt is padded to the smallest bucket that holds it so "
              "the prefill jit specializes once per bucket, not once per "
              "distinct prompt length")
+FLAGS.define("serving_queue_deadline_s", 0.0,
+             "default per-request admission deadline: a request still "
+             "queued this many seconds after submit is shed as TIMED_OUT "
+             "(slot/pages were never held). 0 disables; per-request "
+             "override: ServingEngine.submit(queue_deadline_s=...).",
+             parser=float)
+FLAGS.define("serving_preempt_budget", 3,
+             "max re-prefill recomputes per request. A request preempted "
+             "this many times escalates: it requeues ahead of every "
+             "non-escalated request and is never chosen as a preemption "
+             "victim again, so youngest-first eviction cannot livelock a "
+             "long prompt. 0 = unlimited.", parser=int)
+FLAGS.define("serving_watchdog_ticks", 16,
+             "decode-progress watchdog: a RUNNING request that emits no "
+             "token for this many engine ticks (persistent device "
+             "errors, stuck slot) is FAILED and its pages freed, keeping "
+             "the rest of the fused batch alive. 0 disables.", parser=int)
 FLAGS.define("save_dir", "./output", "default checkpoint output directory")
 FLAGS.define("log_level", "INFO", "logging level")
 FLAGS.define("prealloc_mem", False, "let XLA preallocate the whole HBM arena")

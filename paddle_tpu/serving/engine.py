@@ -7,10 +7,12 @@ Usage::
     params = model.init_params(jax.random.PRNGKey(0))
     eng = ServingEngine(model, params, eos_id=1, page_size=16,
                         num_pages=96, max_pages_per_seq=8, max_slots=8)
-    rid = eng.submit([7, 12, 3], max_tokens=32,
+    rid = eng.submit([7, 12, 3], max_tokens=32, deadline_s=2.0,
                      on_token=lambda tok: print(tok))
     results = eng.run()          # {rid: [generated tokens...]}
-    eng.metrics.snapshot()       # tokens/s, TTFT, occupancy, ...
+    eng.status(rid)              # RequestStatus.COMPLETED
+    eng.metrics.snapshot()       # tokens/s, TTFT, SLO counters, ...
+    eng.healthz()                # liveness/conservation snapshot
 
 The engine owns exactly two compiled functions:
 
@@ -27,6 +29,19 @@ The engine owns exactly two compiled functions:
 Decoding is greedy (argmax) — the deterministic contract the parity
 tests pin; sampling policies layer on top later.
 
+Robustness layer (round 8): every request moves through a real
+:class:`RequestStatus` lifecycle with optional queue/total deadlines and
+``cancel(rid)``; timed-out and cancelled requests release their slot and
+pages immediately.  The decode tick carries a finite-logits guard that
+fails ONLY the poisoned slot (the rest of the fused batch keeps
+running), retries transiently-failing ticks, and a progress watchdog
+fails slots stuck past ``serving_watchdog_ticks``.  Deadlocked demand is
+shed: queued requests whose deadline is provably unmeetable are
+early-rejected instead of burning prefill work.  All failure paths are
+driven deterministically by a :class:`~paddle_tpu.serving.faults.FaultPlan`
+(injectable clock, decode-step errors, NaN logits, page pressure) and a
+free-list conservation check runs after every drain.
+
 The model plugs in through the small :class:`DecodeModel` contract
 rather than a ``Topology``: serving needs per-layer access to Q/K/V
 *before* attention runs (the cache sits between them), which the opaque
@@ -39,7 +54,8 @@ exposing its projection weights.
 from __future__ import annotations
 
 import time
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -48,13 +64,15 @@ import numpy as np
 from paddle_tpu.ops.attention import flash_attention, mha_reference
 from paddle_tpu.platform.flags import FLAGS
 from paddle_tpu.serving.decode_attention import paged_decode_attention
+from paddle_tpu.serving.faults import (FaultPlan, InjectedDeviceError,
+                                       PageLeakError)
 from paddle_tpu.serving.kv_cache import (NULL_PAGE, KVPages, PagedKVConfig,
                                          PagePool, append_token,
                                          init_kv_pages, write_prompt)
 from paddle_tpu.serving.metrics import ServingMetrics
 from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
-                                          Request, SchedulerConfig,
-                                          bucket_for)
+                                          Request, RequestStatus,
+                                          SchedulerConfig, bucket_for)
 
 __all__ = ["DecodeModel", "DecoderLM", "ServingEngine",
            "greedy_decode_reference"]
@@ -184,7 +202,15 @@ class ServingEngine:
                  buckets: Optional[Sequence[int]] = None,
                  max_queue: Optional[int] = None,
                  dtype=jnp.float32,
-                 use_kernel: Optional[bool] = None):
+                 use_kernel: Optional[bool] = None,
+                 queue_deadline_s: Optional[float] = None,
+                 preempt_budget: Optional[int] = None,
+                 watchdog_ticks: Optional[int] = None,
+                 decode_retries: int = 2,
+                 transient_errors: Tuple[type, ...] = (InjectedDeviceError,),
+                 max_retained: int = 10000,
+                 faults: Optional[FaultPlan] = None,
+                 time_fn: Optional[Callable[[], float]] = None):
         self.model = model
         self.params = params
         self.eos_id = int(eos_id)
@@ -194,6 +220,31 @@ class ServingEngine:
         if max_pages_per_seq is None:
             # default: one sequence may claim up to half the usable pool
             max_pages_per_seq = max(1, (num_pages - 1) // 2)
+        if queue_deadline_s is None:
+            queue_deadline_s = float(FLAGS.serving_queue_deadline_s)
+        if preempt_budget is None:
+            preempt_budget = int(FLAGS.serving_preempt_budget)
+        if watchdog_ticks is None:
+            watchdog_ticks = int(FLAGS.serving_watchdog_ticks)
+        self.queue_deadline_s = queue_deadline_s or None   # 0 = disabled
+        self.watchdog_ticks = int(watchdog_ticks)          # 0 = disabled
+        self.decode_retries = max(0, int(decode_retries))
+        # which exceptions the decode tick treats as transient and
+        # retries.  Default: only the fault-plan's injected error.  The
+        # retry is sound ONLY for errors raised before the decode
+        # executes (the fault plan's injection point): once the jitted
+        # step has run, the donated KV pool may already be consumed, so
+        # retrying a real mid-execution XLA failure needs KV
+        # snapshot/rebuild this engine does not do — don't widen the set
+        # to device errors without adding that.
+        self.transient_errors = tuple(transient_errors)
+        self.max_retained = max(1, int(max_retained))
+        self.faults = faults
+        # clock precedence: fault-plan clock > explicit time_fn > monotonic
+        if faults is not None and faults.clock is not None:
+            self._time = faults.clock
+        else:
+            self._time = time_fn or time.monotonic
         self.kv_cfg = PagedKVConfig(
             num_layers=model.num_layers, num_heads=model.num_heads,
             head_dim=model.head_dim, page_size=page_size,
@@ -205,7 +256,9 @@ class ServingEngine:
             self.pool, SchedulerConfig(
                 max_slots=max_slots, page_size=page_size,
                 max_pages_per_seq=int(max_pages_per_seq),
-                max_queue=max_queue))
+                max_queue=max_queue,
+                preempt_budget=preempt_budget if preempt_budget > 0
+                else None))
         self.metrics = ServingMetrics(pool_pages=self.pool.num_usable)
         self._use_kernel = use_kernel
         self._buckets = tuple(sorted(int(b) for b in buckets)) if buckets \
@@ -222,6 +275,13 @@ class ServingEngine:
         self._prefill_fns: Dict[int, Callable] = {}
         self._results: Dict[int, List[int]] = {}
         self._requests: Dict[int, Request] = {}
+        # terminal rids in retirement order; oldest evicted past
+        # max_retained so a long-running engine's memory stays bounded
+        self._retired: Deque[int] = deque()
+        self._tick = 0
+        self._last_tick_at: Optional[float] = None
+        self._prev_tick_busy = False
+        self._tick_dur_ema = 0.0      # drives the unmeetable-deadline shed
 
     # ---- compiled device functions --------------------------------------
 
@@ -293,59 +353,275 @@ class ServingEngine:
 
     def submit(self, prompt: Sequence[int], max_tokens: int,
                on_token: Optional[Callable[[int], None]] = None,
-               now: Optional[float] = None) -> Optional[int]:
-        """Queue a request.  Returns its rid, or None if rejected
-        (infeasible size, or queue backpressure)."""
+               now: Optional[float] = None,
+               queue_deadline_s: Optional[float] = None,
+               deadline_s: Optional[float] = None) -> int:
+        """Queue a request and return its rid — ALWAYS, even when the
+        request is refused (infeasible size or queue backpressure): a
+        refused rid carries status ``REJECTED``, so callers distinguish
+        "rejected at submit" from "in flight" from "unknown rid" via
+        ``status``/``result`` instead of a bare ``None`` sentinel.
+
+        ``queue_deadline_s`` bounds time waiting for admission (engine
+        default: ``FLAGS.serving_queue_deadline_s``); ``deadline_s``
+        bounds submit-to-last-token.  Either lapsing marks the request
+        ``TIMED_OUT`` and frees everything it held."""
         req = Request(prompt=list(int(t) for t in prompt),
                       max_tokens=int(max_tokens), on_token=on_token)
-        t = time.monotonic() if now is None else now
+        t = self._time() if now is None else now
+        if queue_deadline_s is None:
+            # engine-wide default; self.queue_deadline_s is None when
+            # the flag is 0 (the 0-means-off semantic lives on the FLAG,
+            # not on the per-request parameters)
+            queue_deadline_s = self.queue_deadline_s
+        if queue_deadline_s is not None:
+            req.queue_deadline_at = t + float(queue_deadline_s)
+        if deadline_s is not None:
+            req.deadline_at = t + float(deadline_s)
+        # for BOTH per-request overrides, None = no deadline and an
+        # explicit 0.0 is an already-spent budget (times out next tick)
         ok = self.scheduler.submit(req, now=t)
         self.metrics.on_submit(t, ok)
-        if not ok:
-            return None
         self._requests[req.rid] = req
+        if not ok:
+            self._retire(req)
         return req.rid
+
+    def _finish(self, req: Request, status: RequestStatus, now: float,
+                shed: bool = False) -> None:
+        """THE terminal-transition path (every non-completed exit and
+        completion itself funnel through here): return the slot and
+        pages — or leave the queue — stamp, count, retire.  One copy of
+        the invariant, so no path can forget eviction or a counter."""
+        if req.slot is not None:
+            self.scheduler.release(req, status)
+        else:
+            self.scheduler.drop_queued(req, status)
+        req.finished_at = now
+        hook = self.metrics.on_shed if shed else {
+            RequestStatus.COMPLETED: self.metrics.on_complete,
+            RequestStatus.TIMED_OUT: self.metrics.on_timeout,
+            RequestStatus.CANCELLED: self.metrics.on_cancel,
+            RequestStatus.FAILED: self.metrics.on_fail,
+        }[status]
+        hook()
+        self._retire(req)
+
+    def _retire(self, req: Request) -> None:
+        """Record a terminal transition; evict the oldest terminal
+        requests (and their results) past ``max_retained`` so request
+        history doesn't grow without bound on a long-running engine.
+        ``status``/``result`` raise KeyError for evicted rids, same as
+        never-issued ones."""
+        self._retired.append(req.rid)
+        while len(self._retired) > self.max_retained:
+            old = self._retired.popleft()
+            self._requests.pop(old, None)
+            self._results.pop(old, None)
+
+    def cancel(self, rid: int, now: Optional[float] = None) -> bool:
+        """Cancel a request.  Queued/preempted requests leave the queue;
+        a running one releases its slot and pages immediately (its page
+        writes are garbage the next owner overwrites).  Returns False if
+        the request already reached a terminal status; raises KeyError
+        for an unknown rid."""
+        req = self._requests[rid]
+        if req.finished:
+            return False
+        now = self._time() if now is None else now
+        self._finish(req, RequestStatus.CANCELLED, now)
+        return True
+
+    def status(self, rid: int) -> RequestStatus:
+        """Lifecycle status of ``rid``; raises KeyError for a rid this
+        engine never issued."""
+        return self._requests[rid].status
 
     @property
     def has_work(self) -> bool:
         return self.scheduler.has_work
 
     def step(self, now: Optional[float] = None) -> bool:
-        """One engine tick: admit + prefill, grow/preempt, one fused
-        decode over all running sequences.  Returns True if any work
-        remains."""
-        now = time.monotonic() if now is None else now
-        sched, m = self.scheduler, self.metrics
+        """One engine tick: shed expired/unmeetable work, grow/preempt,
+        admit + prefill, one fused decode over all running sequences
+        (with transient-error retry, finite-logits isolation, and the
+        progress watchdog).  Returns True if any work remains."""
+        tick, sched, m = self._tick, self.scheduler, self.metrics
+        if self.faults is not None:
+            self.faults.tick_begin(tick)
+            self.faults.apply_page_pressure(tick, self.pool)
+        now = self._time() if now is None else now
+        # the shed estimator learns tick duration only from ticks that
+        # followed a BUSY tick: in a continuous serving loop those run
+        # back-to-back so the gap is compute time, while idle gaps (a
+        # server polling step() with nothing in flight) would inflate
+        # the EMA and shed whole bursts spuriously
+        if (self._last_tick_at is not None and now > self._last_tick_at
+                and self._prev_tick_busy):
+            dur = now - self._last_tick_at
+            self._tick_dur_ema = dur if self._tick_dur_ema == 0.0 else \
+                0.5 * self._tick_dur_ema + 0.5 * dur
+        self._last_tick_at = now
+        self._enforce_deadlines(now)
         # growth/preemption BEFORE admission: a tick must not pay for a
         # new request's prefill and then immediately preempt it (the
         # youngest) to grow older sequences.  admit() reserves the first
         # decode append's page, so fresh admissions never need same-tick
         # growth either.
         m.on_preempt(len(sched.ensure_decode_pages()))
-        for req in sched.admit():
+        admitted = sched.admit()
+        for req in admitted:
+            if req.admitted_at is None:
+                # queue wait is a first-admission stat: re-admissions
+                # after preemption would fold running time into it
+                m.on_admit(now - (req.submitted_at
+                                  if req.submitted_at is not None else now))
+                req.admitted_at = now
+            req.last_progress_tick = tick
             self._do_prefill(req)
         running = [r for r in sched.running_requests()
-                   if r.status == "running"]
+                   if r.status is RequestStatus.RUNNING]
         if running:
-            self._do_decode(running)
+            self._decode_with_retry(running, tick)
+        self._prev_tick_busy = bool(running) or bool(admitted)
+        self._watchdog_sweep(tick)
         m.on_tick(sched.queue_depth, self.pool.num_in_use)
+        self._tick = tick + 1
         return self.has_work
 
     def run(self, max_ticks: Optional[int] = None) -> Dict[int, List[int]]:
         """Tick until drained (or ``max_ticks``); returns
-        {rid: generated tokens} for everything completed so far."""
+        {rid: generated tokens} for everything completed so far.  A full
+        drain releases any fault-plan page pressure and asserts free-list
+        conservation (:class:`PageLeakError` on violation)."""
         ticks = 0
         while self.has_work:
             self.step()
             ticks += 1
             if max_ticks is not None and ticks >= max_ticks:
                 break
+        if not self.has_work:
+            if self.faults is not None:
+                self.faults.release_pressure(self.pool)
+            self.check_page_conservation()
         return dict(self._results)
 
     def result(self, rid: int) -> Optional[List[int]]:
+        """Generated tokens for a COMPLETED rid; None while the request
+        is in flight or if it ended in a non-completed terminal status
+        (disambiguate via ``status``); KeyError for a rid the engine
+        never issued or already evicted past ``max_retained``."""
+        if rid not in self._requests:
+            raise KeyError(rid)
         return self._results.get(rid)
 
+    # ---- invariants / health --------------------------------------------
+
+    def check_page_conservation(self) -> None:
+        """Free-list conservation: every usable page is either free, held
+        by a running request, or held by the fault plan's pressure window
+        — anything else is a leak (raises :class:`PageLeakError`, whose
+        message carries the grep-able ``PAGE-LEAK`` token)."""
+        pool = self.pool
+        held = sum(len(r.pages) for r in self.scheduler.running.values())
+        held += sum(len(r.pages) for r in self.scheduler.queue)
+        if self.faults is not None:
+            held += len(self.faults.held_pages)
+        if pool.num_free + pool.num_in_use != pool.num_usable or \
+                held != pool.num_in_use:
+            raise PageLeakError(
+                f"PAGE-LEAK: free={pool.num_free} in_use={pool.num_in_use} "
+                f"usable={pool.num_usable} accounted={held}")
+
+    def healthz(self) -> Dict[str, object]:
+        """One-call liveness snapshot for an external prober.  O(live
+        requests), not O(history): terminal counts come from the metrics
+        counters, live states from the bounded queue/slot scans."""
+        m = self.metrics
+        counts: Dict[str, int] = {}
+        for key, val in (("completed", m.completed),
+                         ("timed_out", m.timed_out),
+                         ("cancelled", m.cancelled),
+                         ("failed", m.failed),
+                         ("rejected", m.rejected + m.shed)):
+            if val:
+                counts[key] = val
+        for req in (list(self.scheduler.queue) +
+                    list(self.scheduler.running.values())):
+            counts[req.status.value] = counts.get(req.status.value, 0) + 1
+        try:
+            self.check_page_conservation()
+            leak = False
+        except PageLeakError:
+            leak = True
+        return {
+            "ok": not leak,
+            "tick": self._tick,
+            "queue_depth": self.scheduler.queue_depth,
+            "running": len(self.scheduler.running),
+            "pages_free": self.pool.num_free,
+            "pages_in_use": self.pool.num_in_use,
+            "page_leak": leak,
+            "status_counts": counts,
+            "deadline_miss_rate": round(self.metrics.deadline_miss_rate(),
+                                        4),
+        }
+
     # ---- internals -------------------------------------------------------
+
+    def _enforce_deadlines(self, now: float) -> None:
+        sched = self.scheduler
+        # running requests past their total deadline: free immediately
+        for req in list(sched.running.values()):
+            if req.deadline_at is not None and now >= req.deadline_at:
+                self._finish(req, RequestStatus.TIMED_OUT, now)
+        for req in sched.queued_requests():
+            # the queue deadline is an ADMISSION SLO: once a request has
+            # been admitted it is satisfied forever — a preempted request
+            # back in the queue is judged only by its total deadline
+            expired = (req.deadline_at is not None and
+                       now >= req.deadline_at) or \
+                      (req.admitted_at is None and
+                       req.queue_deadline_at is not None and
+                       now >= req.queue_deadline_at)
+            if expired:
+                self._finish(req, RequestStatus.TIMED_OUT, now)
+                continue
+            # load shedding, on the WORST-CASE length assumption: at one
+            # token per tick (the engine's best rate), a request that
+            # runs to its full max_tokens cannot finish by its deadline.
+            # An early EOS could beat the estimate — callers who rely on
+            # early stopping should size max_tokens to what they
+            # actually expect, since it is the only length signal the
+            # engine has before decoding.
+            if (req.deadline_at is not None and self._tick_dur_ema > 0.0
+                    and now + req.tokens_remaining * self._tick_dur_ema
+                    > req.deadline_at):
+                self._finish(req, RequestStatus.REJECTED, now, shed=True)
+
+    def _decode_with_retry(self, running: List[Request], tick: int) -> None:
+        attempt = 0
+        while True:
+            try:
+                if self.faults is not None and \
+                        self.faults.decode_should_fail(tick, attempt):
+                    raise InjectedDeviceError(f"injected @ tick {tick} "
+                                              f"attempt {attempt}")
+                self._do_decode(running)
+                return
+            except self.transient_errors:
+                attempt += 1
+                if attempt > self.decode_retries:
+                    return   # tick lost; the watchdog counts the stall
+                self.metrics.on_retry()
+
+    def _watchdog_sweep(self, tick: int) -> None:
+        if self.watchdog_ticks <= 0:
+            return
+        sched = self.scheduler
+        for req in list(sched.running.values()):
+            if tick - req.last_progress_tick >= self.watchdog_ticks:
+                self._finish(req, RequestStatus.FAILED, self._time())
 
     def _do_prefill(self, req: Request) -> None:
         toks = req.cache_tokens
@@ -360,9 +636,13 @@ class ServingEngine:
             jnp.asarray(n, jnp.int32), jnp.asarray(row))
         req.cache_len = n
         self.metrics.on_prefill(n)
-        tok = int(np.argmax(np.asarray(logits)))  # forces device sync
+        logits = np.asarray(logits)   # forces device sync
         # stamp AFTER the sync so TTFT includes the prefill compute
-        self._emit(req, tok, time.monotonic())
+        now = self._time()
+        if not np.isfinite(logits).all():
+            self._finish(req, RequestStatus.FAILED, now)
+            return
+        self._emit(req, int(np.argmax(logits)), now)
 
     def _do_decode(self, running: List[Request]) -> None:
         b = self._max_slots
@@ -384,22 +664,39 @@ class ServingEngine:
             jnp.asarray(positions), jnp.asarray(table), jnp.asarray(lens),
             jnp.asarray(active))
         logits = np.asarray(logits)   # forces device sync
-        now = time.monotonic()        # emission time includes the compute
+        if self.faults is not None and self.faults.nan_rids:
+            poisoned = [r for r in running
+                        if r.rid in self.faults.nan_rids]
+            if poisoned:              # only then pay for a writable copy
+                logits = logits.copy()
+                for req in poisoned:
+                    logits[req.slot] = np.nan
+        now = self._time()            # emission time includes the compute
         for req in running:
+            if req.status is not RequestStatus.RUNNING:
+                continue    # cancelled from another slot's on_token
+            row = logits[req.slot]
+            if not np.isfinite(row).all():
+                # poisoned slot: fail ONLY this request — its pages go
+                # back, the fused batchmates keep decoding untouched
+                self._finish(req, RequestStatus.FAILED, now)
+                continue
             req.cache_len += 1
-            self._emit(req, int(np.argmax(logits[req.slot])), now)
+            self._emit(req, int(np.argmax(row)), now)
 
     def _emit(self, req: Request, tok: int, now: float) -> None:
         req.generated.append(tok)
+        req.last_progress_tick = self._tick
         ttft = None
         if req.first_token_at is None:
             req.first_token_at = now
-            ttft = max(0.0, now - (req.submitted_at or now))
+            ttft = max(0.0, now - (req.submitted_at
+                                   if req.submitted_at is not None else now))
         self.metrics.on_token(now, ttft)
         if req.on_token is not None:
             req.on_token(tok)
+            if req.finished:
+                return   # the callback cancelled this request: keep it
         if tok == self.eos_id or len(req.generated) >= req.max_tokens:
-            req.finished_at = now
-            self.scheduler.release(req)
             self._results[req.rid] = list(req.generated)
-            self.metrics.on_complete()
+            self._finish(req, RequestStatus.COMPLETED, now)

@@ -1,0 +1,145 @@
+"""Deterministic fault injection for the serving engine.
+
+The training side already proves its failure paths deterministically —
+``master/service.py`` takes an injectable ``time_fn`` and the elastic
+tests drive lease expiry with a fake clock instead of sleeping.  This
+module is the serving analog: a seedable :class:`FaultPlan` threaded
+through ``ServingEngine(faults=...)`` so every guardrail (deadlines,
+watchdog, tick retry, NaN isolation, load shedding) is exercised by CI
+without wall-clock dependence.
+
+Injection points (all host-side, all deterministic):
+
+- **clock** — a :class:`ManualClock` the engine reads instead of
+  ``time.monotonic``; it advances ``tick_s`` per engine tick plus any
+  extra from ``slow_ticks`` (tick -> added seconds), so deadline and
+  queue-wait paths fire on chosen ticks.
+- **decode-step exceptions** — ``decode_errors`` (tick -> number of
+  attempts that raise :class:`InjectedDeviceError`) and/or a seeded
+  ``decode_error_rate``; the engine's tick-level retry absorbs
+  transient ones, persistent ones feed the watchdog.
+- **NaN logits** — rids in ``nan_rids`` get their decode-logits row
+  overwritten with NaN *before* the engine's finite-guard runs, proving
+  the guard fails only the poisoned slot.
+- **page-pool pressure** — ``page_pressure=(start_tick, end_tick, n)``
+  steals up to ``n`` pages from the pool for the window, forcing
+  growth-time preemption and admission stalls; the pages are returned
+  at ``end_tick`` (or at drain) and counted by the leak checker while
+  held.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+__all__ = ["FaultPlan", "ManualClock", "InjectedDeviceError",
+           "PageLeakError"]
+
+
+class InjectedDeviceError(RuntimeError):
+    """A fault-plan-injected transient device failure (the test stand-in
+    for a TPU tick that dies: interconnect hiccup, preempted donation,
+    XLA runtime error)."""
+
+
+class PageLeakError(AssertionError):
+    """Free-list conservation violated.  The message always contains the
+    literal token ``PAGE-LEAK`` so CI wrappers (tools_tier1.sh) can grep
+    the test log and fail loudly."""
+
+
+class ManualClock:
+    """A monotonic clock the test (or the engine, via a FaultPlan)
+    advances by hand — the serving twin of ``time_fn`` in
+    ``master/service.py``."""
+
+    def __init__(self, start: float = 0.0, tick_s: float = 0.001):
+        self.t = float(start)
+        self.tick_s = float(tick_s)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += float(dt)
+
+
+@dataclass
+class FaultPlan:
+    """A seeded, replayable schedule of injected failures.
+
+    Mutable on purpose: rids are assigned at ``submit``, so tests poison
+    them after submission (``plan.poison_nan(rid)``).  Randomized
+    injection (``decode_error_rate``) draws from its own
+    ``RandomState(seed)``, one draw per tick, so a plan replays
+    identically across runs.
+    """
+
+    seed: int = 0
+    clock: Optional[ManualClock] = None
+    nan_rids: Set[int] = field(default_factory=set)
+    # tick -> how many decode attempts at that tick raise (1 = transient,
+    # absorbed by the engine's retry; >= retry budget = persistent)
+    decode_errors: Dict[int, int] = field(default_factory=dict)
+    decode_error_rate: float = 0.0
+    slow_ticks: Dict[int, float] = field(default_factory=dict)
+    page_pressure: Optional[Tuple[int, int, int]] = None
+    held_pages: List[int] = field(default_factory=list)
+
+    def __post_init__(self):
+        self._rng = np.random.RandomState(self.seed)
+        self._rate_fail_tick: int = -1
+
+    # ---- hooks the engine calls ------------------------------------------
+
+    def poison_nan(self, rid: int) -> "FaultPlan":
+        self.nan_rids.add(rid)
+        return self
+
+    def tick_begin(self, tick: int) -> None:
+        """Advance the injected clock for this tick (base tick_s plus any
+        scheduled slowness).  No-op without a ManualClock."""
+        if self.clock is not None:
+            self.clock.advance(self.clock.tick_s +
+                               self.slow_ticks.get(tick, 0.0))
+
+    def decode_should_fail(self, tick: int, attempt: int) -> bool:
+        budget = self.decode_errors.get(tick, 0)
+        if attempt < budget:
+            return True
+        if self.decode_error_rate > 0.0:
+            if self._rate_fail_tick < tick:
+                # one draw per tick regardless of retries, so the retry
+                # path doesn't perturb the random schedule
+                self._rate_fail_tick = tick
+                self._rate_hit = bool(self._rng.random_sample() <
+                                      self.decode_error_rate)
+            # a random hit poisons exactly the first attempt (transient)
+            return self._rate_hit and attempt == 0 and budget == 0
+        return False
+
+    def apply_page_pressure(self, tick: int, pool) -> None:
+        """Steal up to ``n`` pages across the window, return them at the
+        end.  Acquisition retries every tick of the window and
+        accumulates — a pool that is fully busy at the start tick still
+        gets squeezed as pages free up, so the pressure engages exactly
+        when contention is highest."""
+        if self.page_pressure is None:
+            return
+        start, end, n = self.page_pressure
+        if start <= tick < end:
+            want = int(n) - len(self.held_pages)
+            if want > 0 and pool.num_free > 0:
+                got = pool.alloc(min(want, pool.num_free))
+                if got:
+                    self.held_pages.extend(got)
+        elif tick >= end and self.held_pages:
+            self.release_pressure(pool)
+
+    def release_pressure(self, pool) -> None:
+        if self.held_pages:
+            pool.free(self.held_pages)
+            self.held_pages = []

@@ -2,15 +2,38 @@
 
 Kept deliberately flat — ``snapshot()`` returns one JSON-able dict so
 ``bench.py``'s one-line-of-JSON contract and an external exporter see
-the same numbers.  Time handling: the engine stamps events with
-``time.monotonic()`` and the throughput window runs from the first
-submission to the last emitted token, so idle tails (drained engine
-waiting for arrivals) don't deflate tokens/s.
+the same numbers.  Time handling: the engine stamps events with its
+clock (``time.monotonic`` or an injected fault-plan clock) and the
+throughput window runs from the first submission to the last emitted
+token, so idle tails (drained engine waiting for arrivals) don't
+deflate tokens/s.
+
+SLO counters (round 8): every terminal status is counted —
+``completed`` / ``timed_out`` / ``cancelled`` / ``failed`` /
+``rejected`` — plus ``shed`` (queued requests early-rejected because
+their deadline became unmeetable), ``retries`` (decode ticks re-run
+after a transient device error), queue-wait p95, and
+``deadline_miss_rate`` = (timed_out + shed) / (completed + timed_out +
+shed): of the demand that wanted completion, the fraction that missed.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from collections import deque
+from typing import Dict, Optional, Sequence
+
+# latency percentiles run over a bounded recent window, not full
+# history: a long-lived engine must not grow metric memory per request
+# (mirrors the engine's max_retained eviction) nor pay an ever-larger
+# sort per snapshot
+_WINDOW = 4096
+
+
+def _p95(xs: Sequence[float]) -> float:
+    if not xs:
+        return 0.0
+    s = sorted(xs)
+    return s[min(len(s) - 1, int(0.95 * len(s)))]
 
 
 class ServingMetrics:
@@ -19,6 +42,11 @@ class ServingMetrics:
         self.submitted = 0
         self.rejected = 0
         self.completed = 0
+        self.timed_out = 0
+        self.cancelled = 0
+        self.failed = 0
+        self.shed = 0                 # early-rejected: deadline unmeetable
+        self.retries = 0              # decode tick retries (transient errors)
         self.preemptions = 0
         self.ticks = 0
         self.tokens_generated = 0
@@ -26,7 +54,8 @@ class ServingMetrics:
         self.queue_depth = 0          # gauge: last tick
         self.pages_in_use = 0         # gauge: last tick
         self.peak_pages_in_use = 0
-        self.ttft_s: List[float] = []
+        self.ttft_s = deque(maxlen=_WINDOW)
+        self.queue_wait_s = deque(maxlen=_WINDOW)
         self._first_event_at: Optional[float] = None
         self._last_token_at: Optional[float] = None
 
@@ -42,6 +71,9 @@ class ServingMetrics:
     def on_prefill(self, n_tokens: int) -> None:
         self.prefill_tokens += n_tokens
 
+    def on_admit(self, queue_wait_s: float) -> None:
+        self.queue_wait_s.append(max(0.0, queue_wait_s))
+
     def on_token(self, now: float, ttft_s: Optional[float] = None) -> None:
         self.tokens_generated += 1
         self._last_token_at = now
@@ -50,6 +82,21 @@ class ServingMetrics:
 
     def on_complete(self) -> None:
         self.completed += 1
+
+    def on_timeout(self) -> None:
+        self.timed_out += 1
+
+    def on_cancel(self) -> None:
+        self.cancelled += 1
+
+    def on_fail(self) -> None:
+        self.failed += 1
+
+    def on_shed(self) -> None:
+        self.shed += 1
+
+    def on_retry(self) -> None:
+        self.retries += 1
 
     def on_preempt(self, n: int) -> None:
         self.preemptions += n
@@ -75,21 +122,34 @@ class ServingMetrics:
         return 1000.0 * sum(self.ttft_s) / len(self.ttft_s)
 
     def ttft_ms_p95(self) -> float:
-        if not self.ttft_s:
+        return 1000.0 * _p95(self.ttft_s)
+
+    def queue_wait_ms_p95(self) -> float:
+        return 1000.0 * _p95(self.queue_wait_s)
+
+    def deadline_miss_rate(self) -> float:
+        demand = self.completed + self.timed_out + self.shed
+        if demand == 0:
             return 0.0
-        s = sorted(self.ttft_s)
-        return 1000.0 * s[min(len(s) - 1, int(0.95 * len(s)))]
+        return (self.timed_out + self.shed) / demand
 
     def snapshot(self) -> Dict[str, float]:
         return {
             "tokens_per_s": round(self.tokens_per_s(), 2),
             "ttft_ms_mean": round(self.ttft_ms_mean(), 3),
             "ttft_ms_p95": round(self.ttft_ms_p95(), 3),
+            "queue_wait_ms_p95": round(self.queue_wait_ms_p95(), 3),
             "tokens_generated": self.tokens_generated,
             "prefill_tokens": self.prefill_tokens,
             "requests_submitted": self.submitted,
             "requests_rejected": self.rejected,
             "requests_completed": self.completed,
+            "requests_timed_out": self.timed_out,
+            "requests_cancelled": self.cancelled,
+            "requests_failed": self.failed,
+            "requests_shed": self.shed,
+            "deadline_miss_rate": round(self.deadline_miss_rate(), 4),
+            "retries": self.retries,
             "preemptions": self.preemptions,
             "ticks": self.ticks,
             "queue_depth": self.queue_depth,

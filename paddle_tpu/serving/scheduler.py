@@ -14,6 +14,18 @@ dry mid-decode, preempts the youngest running sequence (its pages are
 freed, its tokens re-queued for re-prefill — the recompute flavour of
 vLLM-style preemption) so the oldest requests always make progress.
 
+Robustness policy (the SLO layer the engine drives):
+
+- every request carries a terminal-status :class:`RequestStatus` and
+  optional queue/total deadlines;
+- re-prefill recomputes are CAPPED per request
+  (``SchedulerConfig.preempt_budget``): a request that has burned its
+  budget is never chosen as a preemption victim again and requeues with
+  escalated priority (ahead of every non-escalated entry), so
+  youngest-first preemption cannot livelock a long prompt;
+- ``release`` takes the terminal status, so timeout/cancel/failure all
+  share one slot-and-pages return path.
+
 This module is pure bookkeeping — no jax.  The engine owns the compiled
 prefill/decode functions and calls into the scheduler for decisions, so
 the policy is testable without a model.
@@ -25,12 +37,39 @@ import itertools
 import time
 from collections import deque
 from dataclasses import dataclass, field
+from enum import Enum
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from paddle_tpu.platform.enforce import enforce_that
 from paddle_tpu.serving.kv_cache import PagePool
 
 _rid_counter = itertools.count()
+
+
+class RequestStatus(str, Enum):
+    """Request lifecycle.  ``str``-valued so existing comparisons against
+    the literal strings keep working (``req.status == "queued"``)."""
+
+    QUEUED = "queued"
+    RUNNING = "running"
+    PREEMPTED = "preempted"      # evicted, waiting to re-prefill
+    COMPLETED = "completed"
+    TIMED_OUT = "timed_out"
+    CANCELLED = "cancelled"
+    REJECTED = "rejected"
+    FAILED = "failed"
+
+    @property
+    def terminal(self) -> bool:
+        return self in _TERMINAL
+
+    def __str__(self) -> str:  # "completed", not "RequestStatus.COMPLETED"
+        return self.value
+
+
+_TERMINAL = frozenset({RequestStatus.COMPLETED, RequestStatus.TIMED_OUT,
+                       RequestStatus.CANCELLED, RequestStatus.REJECTED,
+                       RequestStatus.FAILED})
 
 
 @dataclass
@@ -41,17 +80,23 @@ class Request:
     max_tokens: int
     on_token: Optional[Callable[[int], None]] = None
     rid: int = field(default_factory=lambda: next(_rid_counter))
+    # SLOs (absolute times on the engine's clock; None = unbounded)
+    queue_deadline_at: Optional[float] = None   # must be admitted by
+    deadline_at: Optional[float] = None         # must finish by
 
     # runtime state (owned by the scheduler/engine)
     generated: List[int] = field(default_factory=list)
     pages: List[int] = field(default_factory=list)
     slot: Optional[int] = None
     cache_len: int = 0              # tokens currently materialized in KV
-    status: str = "queued"          # queued | running | done | rejected
+    status: RequestStatus = RequestStatus.QUEUED
     submitted_at: Optional[float] = None
+    admitted_at: Optional[float] = None
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
     preemptions: int = 0
+    escalated: bool = False         # preempt budget burned: never a victim
+    last_progress_tick: int = 0     # engine tick of the last emitted token
 
     @property
     def cache_tokens(self) -> List[int]:
@@ -63,7 +108,11 @@ class Request:
 
     @property
     def finished(self) -> bool:
-        return self.status in ("done", "rejected")
+        return self.status in _TERMINAL
+
+    @property
+    def tokens_remaining(self) -> int:
+        return max(0, self.max_tokens - len(self.generated))
 
 
 @dataclass(frozen=True)
@@ -71,7 +120,8 @@ class SchedulerConfig:
     max_slots: int
     page_size: int
     max_pages_per_seq: int
-    max_queue: Optional[int] = None   # None = unbounded queueing
+    max_queue: Optional[int] = None     # None = unbounded queueing
+    preempt_budget: Optional[int] = None  # None = unlimited re-prefills
 
     @property
     def max_seq_len(self) -> int:
@@ -94,7 +144,7 @@ class ContinuousBatchingScheduler:
 
     def submit(self, req: Request, now: Optional[float] = None) -> bool:
         """Enqueue, or refuse.  Refusal (returns False, status
-        'rejected') happens for requests that could NEVER run — longer
+        ``REJECTED``) happens for requests that could NEVER run — longer
         than ``max_seq_len`` or needing more pages than the pool owns —
         and as backpressure when the queue is at ``max_queue``."""
         enforce_that(len(req.prompt) >= 1, "empty prompt", context="serving")
@@ -104,13 +154,13 @@ class ContinuousBatchingScheduler:
         total = len(req.prompt) + req.max_tokens
         if total > self.cfg.max_seq_len or \
                 self._pages_for(total) > self.pool.num_usable:
-            req.status = "rejected"
+            req.status = RequestStatus.REJECTED
             return False
         if self.cfg.max_queue is not None and \
                 len(self.queue) >= self.cfg.max_queue:
-            req.status = "rejected"
+            req.status = RequestStatus.REJECTED
             return False
-        req.status = "queued"
+        req.status = RequestStatus.QUEUED
         self.queue.append(req)
         return True
 
@@ -137,10 +187,21 @@ class ContinuousBatchingScheduler:
             self.queue.popleft()
             req.pages = pages
             req.slot = self._free_slots.pop()
-            req.status = "running"
+            req.status = RequestStatus.RUNNING
             self.running[req.slot] = req
             admitted.append(req)
         return admitted
+
+    def drop_queued(self, req: Request, status: RequestStatus) -> None:
+        """Remove a not-yet-admitted request from the queue with a
+        terminal status (deadline shed, cancellation)."""
+        enforce_that(status in _TERMINAL, "drop_queued needs a terminal "
+                     "status", context="serving")
+        try:
+            self.queue.remove(req)
+        except ValueError:
+            pass
+        req.status = status
 
     # ---- decode-time growth / preemption --------------------------------
 
@@ -148,12 +209,15 @@ class ContinuousBatchingScheduler:
         """Before a decode tick: every running sequence whose next append
         lands on a page boundary needs one more page.  Oldest requests
         are served first; when the pool is dry the YOUNGEST running
-        sequence is preempted (pages freed, tokens re-queued at the
-        front) until the growth fits.  Returns the preempted requests."""
+        sequence still under its preemption budget is preempted (pages
+        freed, tokens re-queued at the front) until the growth fits.
+        A grower with no eligible victim preempts ITSELF — correctness
+        (the append must land on an owned page) beats its budget.
+        Returns the preempted requests."""
         preempted: List[Request] = []
         for req in sorted(self.running.values(),
                           key=lambda r: (r.submitted_at, r.rid)):
-            if req.status != "running":
+            if req.status is not RequestStatus.RUNNING:
                 continue  # preempted below while an older one grew
             if req.cache_len < len(req.pages) * self.cfg.page_size:
                 continue
@@ -162,17 +226,20 @@ class ContinuousBatchingScheduler:
                 if got is not None:
                     req.pages.extend(got)
                     break
-                victim = self._youngest_running(exclude=req)
+                victim = self._youngest_victim(exclude=req)
                 if victim is None:
-                    victim = req  # alone and stuck: requeue itself
+                    victim = req  # alone (or peers exempt): requeue itself
                 self._preempt(victim)
                 preempted.append(victim)
                 if victim is req:
                     break
         return preempted
 
-    def _youngest_running(self, exclude: Request) -> Optional[Request]:
-        cands = [r for r in self.running.values() if r is not exclude]
+    def _youngest_victim(self, exclude: Request) -> Optional[Request]:
+        budget = self.cfg.preempt_budget
+        cands = [r for r in self.running.values()
+                 if r is not exclude and not r.escalated and
+                 (budget is None or r.preemptions < budget)]
         if not cands:
             return None
         return max(cands, key=lambda r: (r.submitted_at, r.rid))
@@ -180,17 +247,40 @@ class ContinuousBatchingScheduler:
     def _preempt(self, req: Request) -> None:
         self._release_slot_and_pages(req)
         req.cache_len = 0
-        req.status = "queued"
+        req.status = RequestStatus.PREEMPTED
         req.preemptions += 1
         self.preemption_count += 1
-        self.queue.appendleft(req)
+        if self.cfg.preempt_budget is not None and \
+                req.preemptions >= self.cfg.preempt_budget:
+            req.escalated = True
+        self._requeue_front(req)
+
+    def _requeue_front(self, req: Request) -> None:
+        """Preempted requests go back to the front; an escalated request
+        jumps ahead of everything, a normal one slots in after the
+        leading escalated run (escalation is a real priority, not just a
+        no-more-preemptions flag)."""
+        if req.escalated:
+            self.queue.appendleft(req)
+            return
+        i = 0
+        for r in self.queue:
+            if not r.escalated:
+                break
+            i += 1
+        self.queue.insert(i, req)
 
     # ---- completion ------------------------------------------------------
 
-    def release(self, req: Request) -> None:
-        """Return a finished sequence's slot and pages to the pool."""
+    def release(self, req: Request,
+                status: RequestStatus = RequestStatus.COMPLETED) -> None:
+        """Return a sequence's slot and pages to the pool with its
+        terminal status — completion, timeout, cancellation, and failure
+        all exit through here so none of them can leak."""
+        enforce_that(status in _TERMINAL, "release needs a terminal status",
+                     context="serving")
         self._release_slot_and_pages(req)
-        req.status = "done"
+        req.status = status
 
     def _release_slot_and_pages(self, req: Request) -> None:
         if req.pages:
@@ -205,6 +295,9 @@ class ContinuousBatchingScheduler:
 
     def running_requests(self) -> List[Request]:
         return [self.running[s] for s in sorted(self.running)]
+
+    def queued_requests(self) -> List[Request]:
+        return list(self.queue)
 
     @property
     def has_work(self) -> bool:

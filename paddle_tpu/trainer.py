@@ -530,9 +530,10 @@ class SGD:
                     if unacked:
                         flush(pass_id, epoch)
                     else:
-                        _time.sleep(master._poll)
-                    continue
+                        master.poll_wait()   # jittered backoff, not a
+                    continue                 # fixed-interval hammer
                 task_id, epoch, records = got
+                master.poll_reset()
                 if skip_set:
                     if (task_id, epoch) in skip_set:
                         # already applied inside the restored checkpoint

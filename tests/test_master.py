@@ -1,14 +1,22 @@
 """Elastic input master tests (reference:
 go/master/service_internal_test.go — task lifecycle incl. timeout and
-failure requeue; client_internal_test.go — end-to-end with in-mem store)."""
+failure requeue; client_internal_test.go — end-to-end with in-mem store),
+plus client-side retry behavior: capped exponential backoff with
+decorrelated jitter, reconnect through a flaky server, and a clear
+error when the retry budget runs out (all with an injected sleep_fn —
+no wall-clock sleeping)."""
 
 import os
+import socket
+import threading
 
 import pytest
 
-from paddle_tpu.master import (MasterClient, MasterServer, Service,
-                               recordio_index, recordio_read_chunk,
-                               recordio_write)
+from paddle_tpu.master import (MasterClient, MasterRetryExhausted,
+                               MasterServer, Service, recordio_index,
+                               recordio_read_chunk, recordio_write)
+from paddle_tpu.master.server import recv_msg, send_msg
+from paddle_tpu.master.service import dispatch
 from paddle_tpu.reader import creator
 
 
@@ -143,6 +151,230 @@ def test_cloud_reader_inproc(dataset):
     got = list(reader())
     assert sorted(got) == sorted(
         f"rec-{i}-{j}".encode() for i in range(2) for j in range(10))
+
+
+class _FlakyMaster:
+    """A TCP master that accepts-and-closes the first ``drop_first_n``
+    connections, then speaks the real protocol against a Service — the
+    crash-looping-master stand-in for the client's reconnect path."""
+
+    def __init__(self, svc: Service, drop_first_n: int):
+        self.svc = svc
+        self.drops_left = drop_first_n
+        # methods to execute server-side ONCE and then drop the
+        # connection WITHOUT replying — the lost-response case
+        self.lose_response_once = set()
+        self._lsock = socket.socket()
+        self._lsock.bind(("127.0.0.1", 0))
+        self._lsock.listen(8)
+        self.address = f"127.0.0.1:{self._lsock.getsockname()[1]}"
+        self._stop = False
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self._lsock.accept()
+            except OSError:
+                return
+            if self.drops_left > 0:
+                self.drops_left -= 1
+                conn.close()
+                continue
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        while True:
+            try:
+                req = recv_msg(conn)
+            except (ConnectionError, OSError):
+                return
+            if req is None:
+                return
+            try:
+                result = dispatch(self.svc, req.get("method"),
+                                  req.get("params"))
+                resp = {"ok": True, "result": result}
+            except Exception as e:
+                resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+            if req.get("method") in self.lose_response_once:
+                self.lose_response_once.discard(req.get("method"))
+                conn.close()           # executed, but the reply is lost
+                return
+            try:
+                send_msg(conn, resp)
+            except (ConnectionError, OSError):
+                return
+
+    def stop(self):
+        self._stop = True
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+
+
+def test_client_reconnects_through_flaky_server(dataset):
+    fm = _FlakyMaster(Service(chunks_per_task=100), drop_first_n=3)
+    sleeps = []
+    try:
+        c = MasterClient(fm.address, poll_interval_s=0.001, retry_budget=20,
+                         sleep_fn=sleeps.append)
+        c.set_dataset(dataset[:1])      # rides through the dropped conns
+        recs = []
+        while True:
+            r = c.next_record()
+            if r is None:
+                break
+            recs.append(r)
+        assert sorted(recs) == sorted(
+            f"rec-0-{j}".encode() for j in range(10))
+        assert fm.drops_left == 0       # the drops actually happened
+        assert sleeps                   # and backoff absorbed them
+        assert all(s <= 2.0 for s in sleeps)
+        c.close()
+    finally:
+        fm.stop()
+
+
+def test_retry_budget_exhausted_raises_clear_error():
+    fm = _FlakyMaster(Service(), drop_first_n=10 ** 9)   # always drops
+    sleeps = []
+    try:
+        c = MasterClient(fm.address, poll_interval_s=0.001, retry_budget=3,
+                         sleep_fn=sleeps.append)
+        with pytest.raises(MasterRetryExhausted):
+            c.set_dataset(["/nonexistent"])
+        assert len(sleeps) == 3         # the whole budget, then the error
+    finally:
+        fm.stop()
+
+
+def test_poll_backoff_budget_when_peers_hold_tasks(dataset):
+    svc = Service(chunks_per_task=100, timeout_s=1e6)
+    svc.set_dataset(dataset[:1])
+    held = svc.get_task()               # a "peer" holds the only task
+    assert held is not None
+    sleeps = []
+    c = MasterClient(service=svc, poll_interval_s=0.001, retry_budget=5,
+                     sleep_fn=sleeps.append)
+    with pytest.raises(MasterRetryExhausted):
+        c.next_record()
+    assert len(sleeps) == 5
+    # the peer crashes (task requeued): a fresh client gets the task
+    svc.task_failed(held.id)
+    c2 = MasterClient(service=svc)
+    assert c2.next_record() is not None
+
+
+def test_lost_get_task_response_is_not_blindly_resent(dataset):
+    # the master leases task A but the reply is lost in a connection
+    # drop: the client must NOT blind-resend get_task (that would lease
+    # a second task while A burns failure budget) — it reports "nothing
+    # available", and A requeues through the normal lease timeout, so
+    # every record still arrives exactly once
+    svc = Service(chunks_per_task=100, timeout_s=0.05)
+    fm = _FlakyMaster(svc, drop_first_n=0)
+    try:
+        c = MasterClient(fm.address, poll_interval_s=0.001,
+                         sleep_fn=lambda s: None)
+        c.set_dataset(dataset[:1])
+        fm.lose_response_once.add("get_task")
+        recs = []
+        while True:
+            r = c.next_record()
+            if r is None:
+                break
+            recs.append(r)
+        assert sorted(recs) == sorted(
+            f"rec-0-{j}".encode() for j in range(10))
+        assert not fm.lose_response_once      # the drop really happened
+        c.close()
+    finally:
+        fm.stop()
+
+
+def test_close_fails_fast_against_dead_master():
+    # shutdown must NOT sit out the transport retry budget: one attempt,
+    # zero backoff sleeps, then give up quietly
+    fm = _FlakyMaster(Service(), drop_first_n=10 ** 9)
+    sleeps = []
+    try:
+        c = MasterClient(fm.address, poll_interval_s=0.001,
+                         sleep_fn=sleeps.append)
+        c._task_id = 7                  # pretend a task is in flight
+        c.close()                       # swallowed single failure
+        assert sleeps == []
+        assert c._task_id is None
+    finally:
+        fm.stop()
+
+
+def test_poll_wait_public_api_for_elastic_trainer(dataset):
+    # the elastic trainer's empty-queue wait goes through poll_wait /
+    # poll_reset (it used to reach into master._poll for a fixed sleep)
+    svc = Service(chunks_per_task=100, timeout_s=1e6)
+    svc.set_dataset(dataset[:1])
+    held = svc.get_task()               # a peer holds the only task
+    assert held is not None
+    sleeps = []
+    c = MasterClient(service=svc, poll_interval_s=0.001, retry_budget=2,
+                     sleep_fn=sleeps.append)
+    status, got = c.try_next_task()
+    assert status == "empty" and got is None
+    c.poll_wait()
+    c.poll_wait()
+    with pytest.raises(MasterRetryExhausted):
+        c.poll_wait()                   # budget of 2 spent
+    c.poll_reset()
+    c.poll_wait()                       # refunded
+    assert len(sleeps) == 3
+
+
+def test_backoff_is_jittered_capped_and_resets():
+    from paddle_tpu.master.client import _Backoff
+
+    sleeps = []
+    b = _Backoff(0.01, 0.5, budget=None, seed=3, sleep_fn=sleeps.append)
+    for _ in range(50):
+        b.sleep()
+    assert 0.01 <= min(sleeps) and max(sleeps) <= 0.5
+    assert len(set(sleeps)) > 10        # decorrelated, not a fixed ladder
+    b.reset()
+    b.sleep()
+    assert sleeps[-1] <= 3 * 0.01       # reset returned to the base range
+
+
+def test_dead_master_trips_default_transport_budget():
+    # no explicit retry_budget: a master that is simply GONE must still
+    # surface as MasterRetryExhausted (finite default transport budget),
+    # not spin forever
+    from paddle_tpu.master.client import DEFAULT_TRANSPORT_RETRY_BUDGET
+
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    port = lsock.getsockname()[1]
+    lsock.close()                   # nothing listens here anymore
+    sleeps = []
+    with pytest.raises(MasterRetryExhausted):
+        MasterClient(f"127.0.0.1:{port}", poll_interval_s=0.001,
+                     sleep_fn=sleeps.append)
+    assert len(sleeps) == DEFAULT_TRANSPORT_RETRY_BUDGET
+
+
+def test_backoff_decorrelates_across_clients():
+    # unseeded clients must NOT share a jitter sequence (a fleet in
+    # lockstep would thunder back at a restarting master together)
+    from paddle_tpu.master.client import _Backoff
+
+    s1, s2 = [], []
+    b1 = _Backoff(0.01, 0.5, sleep_fn=s1.append)
+    b2 = _Backoff(0.01, 0.5, sleep_fn=s2.append)
+    for _ in range(8):
+        b1.sleep()
+        b2.sleep()
+    assert s1 != s2
 
 
 def test_concurrent_trainers_consume_each_record_once(tmp_path):

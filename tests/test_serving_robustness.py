@@ -1,0 +1,570 @@
+"""Serving SLO guardrails under deterministic fault injection.
+
+Every terminal status (TIMED_OUT / CANCELLED / REJECTED / FAILED) and
+every injected fault (slow ticks, decode-step exceptions, NaN logits,
+page-pool pressure) is reached here via a seeded
+:class:`~paddle_tpu.serving.FaultPlan` and the injectable
+:class:`~paddle_tpu.serving.ManualClock` — no sleeps, no wall-clock
+dependence, mirroring how ``tests/test_master.py`` drives lease expiry
+with a fake ``time_fn``.
+"""
+
+import numpy as np
+import jax
+import pytest
+
+from paddle_tpu.platform.flags import FLAGS
+from paddle_tpu.serving import (ContinuousBatchingScheduler, DecoderLM,
+                                FaultPlan, ManualClock, PageLeakError,
+                                PagePool, Request, RequestStatus,
+                                SchedulerConfig, ServingEngine,
+                                greedy_decode_reference)
+
+serving = pytest.mark.serving
+faults = pytest.mark.faults
+
+pytestmark = [serving, faults]
+
+
+@pytest.fixture(autouse=True)
+def f32():
+    old = FLAGS.use_bf16
+    FLAGS.use_bf16 = False
+    yield
+    FLAGS.use_bf16 = old
+
+
+def _small_model(seed=0, **kw):
+    kw.setdefault("vocab_size", 50)
+    kw.setdefault("num_layers", 1)
+    kw.setdefault("num_heads", 2)
+    kw.setdefault("head_dim", 8)
+    kw.setdefault("max_positions", 128)
+    model = DecoderLM(**kw)
+    return model, model.init_params(jax.random.PRNGKey(seed))
+
+
+def _engine(model, params, plan=None, **kw):
+    kw.setdefault("eos_id", 1)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("num_pages", 24)
+    kw.setdefault("max_pages_per_seq", 6)
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("buckets", (4, 8))
+    return ServingEngine(model, params, faults=plan, **kw)
+
+
+# ---------------------------------------------------------------------------
+# deadlines: TIMED_OUT in queue and while running, load shedding
+# ---------------------------------------------------------------------------
+
+
+def test_queue_deadline_times_out_waiting_request(rng):
+    model, params = _small_model()
+    plan = FaultPlan(clock=ManualClock(tick_s=1.0))
+    eng = _engine(model, params, plan, max_slots=1)
+    a = eng.submit(rng.randint(2, 50, size=3).tolist(), max_tokens=6)
+    b = eng.submit(rng.randint(2, 50, size=3).tolist(), max_tokens=6,
+                   queue_deadline_s=3.0)
+    res = eng.run(max_ticks=50)
+    assert eng.status(a) is RequestStatus.COMPLETED
+    assert eng.status(b) is RequestStatus.TIMED_OUT
+    assert eng.result(b) is None and a in res and b not in res
+    assert eng.metrics.timed_out == 1
+    assert eng.pool.num_free == eng.pool.num_usable
+
+
+def test_total_deadline_times_out_running_request_and_frees_pages(rng):
+    model, params = _small_model()
+    plan = FaultPlan(clock=ManualClock(tick_s=1.0))
+    eng = _engine(model, params, plan)
+    rid = eng.submit(rng.randint(2, 50, size=4).tolist(), max_tokens=20,
+                     deadline_s=3.0)
+    eng.step()                      # prefill, first token
+    assert eng.status(rid) is RequestStatus.RUNNING
+    eng.step()                      # clock 2.0: still running
+    eng.step()                      # clock 3.0 >= deadline: timed out
+    assert eng.status(rid) is RequestStatus.TIMED_OUT
+    # the slot and pages came back IMMEDIATELY, not at drain
+    assert eng.pool.num_free == eng.pool.num_usable
+    assert not eng.has_work
+    assert eng.metrics.timed_out == 1
+    eng.check_page_conservation()
+
+
+def test_zero_total_deadline_means_expired_not_unbounded(rng):
+    # deadline_s = max(0, slo - elapsed) hitting exactly 0.0 must time
+    # out immediately, not silently disable the deadline
+    model, params = _small_model()
+    plan = FaultPlan(clock=ManualClock(tick_s=1.0))
+    eng = _engine(model, params, plan)
+    rid = eng.submit(rng.randint(2, 50, size=3).tolist(), max_tokens=8,
+                     deadline_s=0.0)
+    qrid = eng.submit(rng.randint(2, 50, size=3).tolist(), max_tokens=8,
+                      queue_deadline_s=0.0)     # same semantic per-request
+    eng.run(max_ticks=10)
+    assert eng.status(rid) is RequestStatus.TIMED_OUT
+    assert eng.status(qrid) is RequestStatus.TIMED_OUT
+    assert eng.metrics.prefill_tokens == 0      # never even prefilled
+
+
+def test_unmeetable_deadline_is_shed_not_prefilled(rng):
+    model, params = _small_model()
+    plan = FaultPlan(clock=ManualClock(tick_s=1.0))
+    eng = _engine(model, params, plan, max_slots=1)
+    a = eng.submit(rng.randint(2, 50, size=3).tolist(), max_tokens=8)
+    # needs 20 decode ticks but the deadline allows ~5 at the observed
+    # 1s/tick rate -> shed as REJECTED before any prefill work
+    b = eng.submit(rng.randint(2, 50, size=3).tolist(), max_tokens=20,
+                   deadline_s=5.0)
+    eng.run(max_ticks=50)
+    assert eng.status(a) is RequestStatus.COMPLETED
+    assert eng.status(b) is RequestStatus.REJECTED
+    assert eng.metrics.shed == 1 and eng.metrics.timed_out == 0
+    assert eng.metrics.prefill_tokens == 3      # only a's prompt
+    snap = eng.metrics.snapshot()
+    assert snap["requests_shed"] == 1
+    assert snap["deadline_miss_rate"] == 0.5    # 1 shed / (1 done + 1 shed)
+
+
+def test_queue_deadline_is_admission_only_preemption_does_not_retrigger(rng):
+    # a queue deadline is satisfied at admission: a request preempted
+    # long after must NOT be timed out against it on requeue
+    model, params = _small_model()
+    plan = FaultPlan(clock=ManualClock(tick_s=1.0))
+    eng = _engine(model, params, plan, queue_deadline_s=2.0)
+    p = rng.randint(2, 50, size=3).tolist()
+    rid = eng.submit(p, max_tokens=8)
+    eng.step()                      # admitted at clock 1.0, within SLO
+    eng.step()
+    eng.step()                      # clock 3.0: queue deadline long past
+    req = eng.scheduler.running_requests()[0]
+    assert req.rid == rid
+    eng.scheduler._preempt(req)     # evicted for pages, requeued
+    res = eng.run(max_ticks=60)
+    assert eng.status(rid) is RequestStatus.COMPLETED
+    assert res[rid] == greedy_decode_reference(model, params, p, 8, 1)
+    assert eng.metrics.timed_out == 0
+    # queue wait is a first-admission stat: the re-admission after the
+    # preemption must not record a second (running-time-inflated) sample
+    assert len(eng.metrics.queue_wait_s) == 1
+    # submitted_at == 0.0 (clock origin) is a real timestamp, not a
+    # missing one: wait and TTFT are the true 1.0s, not zeroed
+    assert eng.metrics.queue_wait_s[0] == pytest.approx(1.0)
+    assert eng.metrics.ttft_s[0] == pytest.approx(1.0)
+
+
+def test_idle_ticks_do_not_inflate_shed_estimator(rng):
+    model, params = _small_model()
+    plan = FaultPlan(clock=ManualClock(tick_s=1.0))
+    eng = _engine(model, params, plan)
+    rid = eng.submit(rng.randint(2, 50, size=3).tolist(), max_tokens=4)
+    eng.run(max_ticks=20)
+    assert eng.status(rid) is RequestStatus.COMPLETED
+    busy_ema = eng._tick_dur_ema
+    assert busy_ema > 0.0
+    for _ in range(10):                 # a server polling an idle engine
+        eng.step()
+    assert eng._tick_dur_ema == busy_ema    # idle gaps learned nothing
+    # so a burst arriving after the idle stretch is NOT spuriously shed
+    rid2 = eng.submit(rng.randint(2, 50, size=3).tolist(), max_tokens=4,
+                      deadline_s=30.0)
+    eng.run(max_ticks=20)
+    assert eng.status(rid2) is RequestStatus.COMPLETED
+    assert eng.metrics.shed == 0
+
+
+# ---------------------------------------------------------------------------
+# cancellation
+# ---------------------------------------------------------------------------
+
+
+def test_cancel_running_and_queued(rng):
+    model, params = _small_model()
+    plan = FaultPlan(clock=ManualClock(tick_s=0.01))
+    eng = _engine(model, params, plan, max_slots=1)
+    a = eng.submit(rng.randint(2, 50, size=3).tolist(), max_tokens=8)
+    b = eng.submit(rng.randint(2, 50, size=3).tolist(), max_tokens=8)
+    eng.step()
+    assert eng.status(a) is RequestStatus.RUNNING
+    assert eng.status(b) is RequestStatus.QUEUED
+    assert eng.cancel(b)            # queued: leaves the queue
+    assert eng.cancel(a)            # running: slot + pages freed now
+    assert eng.pool.num_free == eng.pool.num_usable
+    assert not eng.cancel(a)        # already terminal
+    assert eng.status(a) is RequestStatus.CANCELLED
+    assert eng.status(b) is RequestStatus.CANCELLED
+    assert eng.metrics.cancelled == 2
+    assert not eng.has_work
+    eng.check_page_conservation()
+
+
+def test_cancel_from_own_on_token_wins_over_completion(rng):
+    # a streaming consumer cancelling from its own callback — even on
+    # the token that would have completed the request — sticks
+    model, params = _small_model()
+    plan = FaultPlan(clock=ManualClock(tick_s=0.01))
+    eng = _engine(model, params, plan)
+    box = {}
+    toks = []
+
+    def cb(tok):
+        toks.append(tok)
+        if len(toks) == 3:          # 3 == max_tokens: the final emit
+            eng.cancel(box["rid"])
+
+    box["rid"] = eng.submit(rng.randint(2, 50, size=3).tolist(),
+                            max_tokens=3, on_token=cb)
+    res = eng.run(max_ticks=50)
+    assert eng.status(box["rid"]) is RequestStatus.CANCELLED
+    assert box["rid"] not in res and eng.result(box["rid"]) is None
+    assert eng.metrics.cancelled == 1 and eng.metrics.completed == 0
+    assert eng.pool.num_free == eng.pool.num_usable
+    eng.check_page_conservation()
+
+
+# ---------------------------------------------------------------------------
+# submit/result/status disambiguation (satellite regression)
+# ---------------------------------------------------------------------------
+
+
+def test_submit_returns_rejected_rid_and_result_disambiguates(rng):
+    model, params = _small_model()
+    eng = _engine(model, params, max_slots=1, max_queue=1)
+    # infeasible: longer than max_seq_len -> rid with REJECTED status,
+    # not a bare None sentinel
+    huge = eng.submit(rng.randint(2, 50, size=30).tolist(), max_tokens=30)
+    assert isinstance(huge, int)
+    assert eng.status(huge) is RequestStatus.REJECTED
+    assert eng.result(huge) is None
+    # in flight: result None but status says QUEUED
+    a = eng.submit(rng.randint(2, 50, size=3).tolist(), max_tokens=4)
+    assert eng.result(a) is None
+    assert eng.status(a) is RequestStatus.QUEUED
+    # backpressure rejection also gets a rid
+    eng.submit(rng.randint(2, 50, size=3).tolist(), max_tokens=4)
+    bp = eng.submit(rng.randint(2, 50, size=3).tolist(), max_tokens=4)
+    assert eng.status(bp) is RequestStatus.REJECTED
+    # unknown rid: KeyError from all three, never a silent None
+    with pytest.raises(KeyError):
+        eng.status(10 ** 9)
+    with pytest.raises(KeyError):
+        eng.result(10 ** 9)
+    with pytest.raises(KeyError):
+        eng.cancel(10 ** 9)
+    res = eng.run(max_ticks=100)
+    assert eng.status(a) is RequestStatus.COMPLETED
+    assert res[a] == eng.result(a)
+
+
+# ---------------------------------------------------------------------------
+# failure isolation: NaN guard, transient retry, watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_nan_guard_fails_only_poisoned_slot_batchmates_keep_parity(rng):
+    model, params = _small_model()
+    plan = FaultPlan(clock=ManualClock(tick_s=0.01))
+    eng = _engine(model, params, plan)
+    p_ok = rng.randint(2, 50, size=5).tolist()
+    p_bad = rng.randint(2, 50, size=4).tolist()
+    ok = eng.submit(p_ok, max_tokens=8)
+    bad = eng.submit(p_bad, max_tokens=8)
+    plan.poison_nan(bad)
+    res = eng.run(max_ticks=100)
+    assert eng.status(bad) is RequestStatus.FAILED
+    assert bad not in res
+    # the fused batchmate decoded through the poisoned tick untouched
+    assert res[ok] == greedy_decode_reference(model, params, p_ok, 8, 1)
+    assert eng.metrics.failed == 1
+    assert eng.pool.num_free == eng.pool.num_usable
+
+
+def test_transient_error_set_is_configurable(rng):
+    # an empty transient set means injected errors are NOT absorbed:
+    # they propagate like any real unlisted device failure would
+    from paddle_tpu.serving import InjectedDeviceError
+
+    model, params = _small_model()
+    plan = FaultPlan(clock=ManualClock(tick_s=0.01),
+                     decode_errors={0: 1})
+    eng = _engine(model, params, plan, transient_errors=())
+    eng.submit(rng.randint(2, 50, size=3).tolist(), max_tokens=4)
+    with pytest.raises(InjectedDeviceError):
+        eng.step()
+
+
+def test_terminal_requests_evicted_past_retention_bound(rng):
+    model, params = _small_model()
+    plan = FaultPlan(clock=ManualClock(tick_s=0.01))
+    eng = _engine(model, params, plan, max_retained=2)
+    rids = [eng.submit(rng.randint(2, 50, size=3).tolist(), max_tokens=2)
+            for _ in range(4)]
+    eng.run(max_ticks=100)
+    # only the 2 most recently retired survive; older rids are evicted
+    with pytest.raises(KeyError):
+        eng.status(rids[0])
+    with pytest.raises(KeyError):
+        eng.result(rids[1])
+    assert eng.status(rids[3]) is RequestStatus.COMPLETED
+    assert eng.result(rids[3]) is not None
+    assert len(eng._requests) == 2
+
+
+def test_transient_decode_error_is_retried_same_tick(rng):
+    model, params = _small_model()
+    plan = FaultPlan(clock=ManualClock(tick_s=0.01),
+                     decode_errors={1: 1, 3: 1})   # one failing attempt each
+    eng = _engine(model, params, plan)
+    p = rng.randint(2, 50, size=4).tolist()
+    rid = eng.submit(p, max_tokens=8)
+    res = eng.run(max_ticks=100)
+    # retries absorbed the injected errors: full parity, no failure
+    assert res[rid] == greedy_decode_reference(model, params, p, 8, 1)
+    assert eng.metrics.retries == 2
+    assert eng.metrics.failed == 0
+
+
+def test_persistent_decode_errors_trip_watchdog(rng):
+    model, params = _small_model()
+    plan = FaultPlan(clock=ManualClock(tick_s=0.01),
+                     decode_errors={t: 99 for t in range(1, 40)})
+    eng = _engine(model, params, plan, watchdog_ticks=5, decode_retries=2)
+    rid = eng.submit(rng.randint(2, 50, size=4).tolist(), max_tokens=8)
+    eng.run(max_ticks=60)
+    assert eng.status(rid) is RequestStatus.FAILED
+    assert eng.metrics.failed == 1
+    assert eng.metrics.retries > 0          # it did try before giving up
+    assert not eng.has_work
+    assert eng.pool.num_free == eng.pool.num_usable
+
+
+def test_page_pressure_forces_preemption_but_everyone_finishes(rng):
+    # the known-thrashing geometry (7 usable pages, 3 requests growing to
+    # 4 pages each) with a fault-plan pressure window squeezing it harder
+    model, params = _small_model()
+    plan = FaultPlan(clock=ManualClock(tick_s=0.01),
+                     page_pressure=(2, 10, 2))
+    eng = _engine(model, params, plan, num_pages=8, max_pages_per_seq=4,
+                  max_slots=3)
+    prompts = [rng.randint(2, 50, size=4).tolist() for _ in range(3)]
+    rids = [eng.submit(p, max_tokens=12) for p in prompts]
+    pressure_seen = 0
+    while eng.has_work:
+        eng.step()
+        pressure_seen = max(pressure_seen, len(plan.held_pages))
+        assert eng.metrics.ticks < 500
+    res = eng.run(max_ticks=10)             # drained: runs the leak check
+    for p, rid in zip(prompts, rids):
+        assert res[rid] == greedy_decode_reference(model, params, p, 12, 1)
+    assert eng.metrics.preemptions > 0      # the pool really thrashed
+    assert pressure_seen > 0                # the pressure window engaged
+    assert plan.held_pages == []            # pressure pages returned
+    assert eng.pool.num_free == eng.pool.num_usable
+
+
+def test_page_pressure_engages_late_when_pool_busy_at_window_start():
+    # a fully-busy pool at the start tick must still get squeezed as
+    # pages free up inside the window (unit-level, no engine)
+    pool = PagePool(5)              # 4 usable
+    busy = pool.alloc(4)
+    plan = FaultPlan(page_pressure=(0, 5, 2))
+    plan.apply_page_pressure(0, pool)
+    assert plan.held_pages == []    # nothing free yet
+    pool.free(busy[:1])
+    plan.apply_page_pressure(1, pool)
+    assert len(plan.held_pages) == 1
+    pool.free(busy[1:])
+    plan.apply_page_pressure(2, pool)
+    assert len(plan.held_pages) == 2        # accumulates up to n, no more
+    plan.apply_page_pressure(5, pool)       # window over: all returned
+    assert plan.held_pages == []
+    assert pool.num_free == pool.num_usable
+
+
+# ---------------------------------------------------------------------------
+# preemption budget + escalation (scheduler-level, no jax)
+# ---------------------------------------------------------------------------
+
+
+def _sched_request(prompt_len, max_tokens, now, sched):
+    req = Request(prompt=list(range(2, 2 + prompt_len)),
+                  max_tokens=max_tokens)
+    assert sched.submit(req, now=now)
+    return req
+
+
+def test_victim_selection_skips_budget_exhausted_requests():
+    pool = PagePool(13)   # 12 usable
+    sched = ContinuousBatchingScheduler(pool, SchedulerConfig(
+        max_slots=3, page_size=2, max_pages_per_seq=6, preempt_budget=2))
+    a = _sched_request(2, 4, 0.0, sched)
+    b = _sched_request(2, 4, 1.0, sched)
+    c = _sched_request(2, 4, 2.0, sched)
+    assert len(sched.admit()) == 3
+    # c is the youngest but has burned its budget: it must never be the
+    # victim again
+    c.preemptions, c.escalated = 2, True
+    pressure = pool.alloc(pool.num_free)    # dry pool
+    a.cache_len = len(a.pages) * 2          # a's next append needs a page
+    preempted = sched.ensure_decode_pages()
+    assert preempted == [b]                 # b evicted, c protected
+    assert b.status is RequestStatus.PREEMPTED
+    assert c.status is RequestStatus.RUNNING
+    pool.free(pressure)
+
+
+def test_escalated_request_requeues_ahead_and_grower_self_preempts():
+    pool = PagePool(9)    # 8 usable
+    sched = ContinuousBatchingScheduler(pool, SchedulerConfig(
+        max_slots=2, page_size=2, max_pages_per_seq=4, preempt_budget=1))
+    a = _sched_request(2, 4, 0.0, sched)
+    b = _sched_request(2, 4, 1.0, sched)
+    assert len(sched.admit()) == 2
+    pressure = pool.alloc(pool.num_free)
+    # first eviction: b pays, burns its whole budget (1), escalates
+    a.cache_len = len(a.pages) * 2
+    assert sched.ensure_decode_pages() == [b]
+    assert b.escalated and b.preemptions == 1
+    # b jumped the queue ahead of a later normal requeue
+    queued_later = _sched_request(2, 4, 2.0, sched)
+    assert list(sched.queue)[0] is b and list(sched.queue)[1] is queued_later
+    # second growth with nobody eligible: the grower preempts ITSELF
+    # rather than evicting the protected b
+    leftover = pool.alloc(pool.num_free)    # re-dry the pool
+    a.cache_len = len(a.pages) * 2
+    assert sched.ensure_decode_pages() == [a]
+    assert a.status is RequestStatus.PREEMPTED
+    # a was preempted past its budget too -> escalated, queue head
+    assert list(sched.queue)[0] is a
+    pool.free(pressure)
+    pool.free(leftover)
+    assert pool.num_free + pool.num_in_use == pool.num_usable
+
+
+# ---------------------------------------------------------------------------
+# PagePool free-list conservation: randomized stress (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_page_pool_conservation_randomized_stress():
+    rng = np.random.RandomState(7)
+    pool = PagePool(17)   # 16 usable
+    cfg = SchedulerConfig(max_slots=4, page_size=4, max_pages_per_seq=4,
+                          max_queue=32, preempt_budget=3)
+    sched = ContinuousBatchingScheduler(pool, cfg)
+
+    def conserve():
+        assert pool.num_free + pool.num_in_use == pool.num_usable
+        held = sum(len(r.pages) for r in sched.running.values())
+        held += sum(len(r.pages) for r in sched.queue)
+        assert held == pool.num_in_use, "orphaned pages"
+
+    n_ops = 600
+    for i in range(n_ops):
+        op = rng.randint(5)
+        if op == 0:       # submit (sometimes infeasible -> rejected)
+            sched.submit(Request(
+                prompt=list(rng.randint(2, 50, size=rng.randint(1, 12))),
+                max_tokens=int(rng.randint(1, 8))), now=float(i))
+        elif op == 1:     # admit
+            sched.admit()
+        elif op == 2:     # grow a running request at a page boundary
+            running = sched.running_requests()
+            if running:
+                r = running[rng.randint(len(running))]
+                if len(r.pages) < cfg.max_pages_per_seq:
+                    r.cache_len = len(r.pages) * cfg.page_size
+                    sched.ensure_decode_pages()
+        elif op == 3:     # complete a random running request
+            running = sched.running_requests()
+            if running:
+                sched.release(running[rng.randint(len(running))],
+                              RequestStatus.COMPLETED)
+        elif op == 4:     # cancel a random queued request
+            if sched.queue:
+                sched.drop_queued(
+                    sched.queue[rng.randint(len(sched.queue))],
+                    RequestStatus.CANCELLED)
+        conserve()
+    # drain everything: the free list must reassemble exactly
+    for r in list(sched.running.values()):
+        sched.release(r, RequestStatus.COMPLETED)
+    while sched.queue:
+        sched.drop_queued(sched.queue[0], RequestStatus.CANCELLED)
+    conserve()
+    assert pool.num_free == pool.num_usable
+
+
+# ---------------------------------------------------------------------------
+# leak checker + healthz
+# ---------------------------------------------------------------------------
+
+
+def test_leak_checker_raises_on_orphaned_pages(rng):
+    model, params = _small_model()
+    eng = _engine(model, params)
+    eng.check_page_conservation()           # clean engine passes
+    orphan = eng.pool.alloc(2)              # pages nobody accounts for
+    with pytest.raises(PageLeakError):
+        eng.check_page_conservation()
+    assert eng.healthz()["page_leak"] is True
+    eng.pool.free(orphan)
+    eng.check_page_conservation()
+
+
+def test_healthz_snapshot_fields(rng):
+    model, params = _small_model()
+    plan = FaultPlan(clock=ManualClock(tick_s=0.01))
+    eng = _engine(model, params, plan)
+    rid = eng.submit(rng.randint(2, 50, size=3).tolist(), max_tokens=4)
+    bad = eng.submit(rng.randint(2, 50, size=3).tolist(), max_tokens=4)
+    plan.poison_nan(bad)
+    eng.run(max_ticks=50)
+    hz = eng.healthz()
+    assert hz["ok"] is True and hz["page_leak"] is False
+    assert hz["queue_depth"] == 0 and hz["running"] == 0
+    assert hz["pages_in_use"] == 0 and hz["pages_free"] > 0
+    assert hz["tick"] > 0
+    assert hz["status_counts"] == {"completed": 1, "failed": 1}
+    assert eng.status(rid) is RequestStatus.COMPLETED
+
+
+# ---------------------------------------------------------------------------
+# all four terminal statuses in ONE engine, fault injection only
+# ---------------------------------------------------------------------------
+
+
+def test_every_terminal_status_reachable_in_one_run(rng):
+    model, params = _small_model()
+    plan = FaultPlan(clock=ManualClock(tick_s=1.0))
+    eng = _engine(model, params, plan, max_slots=2, num_pages=24,
+                  max_pages_per_seq=6)
+    done = eng.submit(rng.randint(2, 50, size=3).tolist(), max_tokens=4)
+    poisoned = eng.submit(rng.randint(2, 50, size=3).tolist(), max_tokens=4)
+    plan.poison_nan(poisoned)
+    rejected = eng.submit(rng.randint(2, 50, size=40).tolist(),
+                          max_tokens=40)          # infeasible
+    late = eng.submit(rng.randint(2, 50, size=3).tolist(), max_tokens=4,
+                      queue_deadline_s=1.0)       # slots busy, will lapse
+    cancelled = eng.submit(rng.randint(2, 50, size=3).tolist(),
+                           max_tokens=4)
+    eng.cancel(cancelled)
+    eng.run(max_ticks=100)
+    got = {s: eng.status(r) for s, r in [
+        ("completed", done), ("failed", poisoned), ("rejected", rejected),
+        ("timed_out", late), ("cancelled", cancelled)]}
+    assert got == {
+        "completed": RequestStatus.COMPLETED,
+        "failed": RequestStatus.FAILED,
+        "rejected": RequestStatus.REJECTED,
+        "timed_out": RequestStatus.TIMED_OUT,
+        "cancelled": RequestStatus.CANCELLED,
+    }
+    assert eng.pool.num_free == eng.pool.num_usable
+    eng.check_page_conservation()
+    snap = eng.metrics.snapshot()
+    for key in ("requests_timed_out", "requests_cancelled",
+                "requests_failed", "requests_shed", "retries",
+                "deadline_miss_rate", "queue_wait_ms_p95"):
+        assert key in snap

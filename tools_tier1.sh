@@ -12,4 +12,11 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
     -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+# the serving page-leak invariant checker stamps PAGE-LEAK into any
+# failure it raises: a leak anywhere in the suite is a loud, distinct
+# failure (exit 3), not one more red test to skim past
+if grep -aq 'PAGE-LEAK' /tmp/_t1.log; then
+    echo 'PAGE-LEAK: serving free-list conservation violated (see log above)'
+    exit 3
+fi
 exit $rc
