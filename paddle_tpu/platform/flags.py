@@ -136,9 +136,31 @@ FLAGS.define("serving_max_slots", 8,
              "tick (the static batch dimension of the fused decode step)")
 FLAGS.define("serving_prefill_buckets", "32,64,128,256,512",
              "comma ladder of padded prefill lengths: each admitted "
-             "prompt is padded to the smallest bucket that holds it so "
-             "the prefill jit specializes once per bucket, not once per "
-             "distinct prompt length")
+             "prompt — or, under chunked prefill, each chunk of at most "
+             "serving_prefill_chunk tokens — is padded to the smallest "
+             "bucket that holds it so the prefill jit specializes once "
+             "per bucket, not once per distinct length")
+FLAGS.define("serving_prefix_cache", True,
+             "automatic prefix caching: full KV pages are indexed by "
+             "chained token-block hashes and refcount-shared, so a "
+             "prompt whose prefix is cached skips re-forwarding it "
+             "(admission charges only the NEW pages; a full-cover hit "
+             "copy-on-write-forks the last shared page and recomputes "
+             "only the final token). Cached pages at refcount 0 stay "
+             "reclaimable and are LRU-evicted under pool pressure. "
+             "Hits are token-verified, so hash collisions degrade to "
+             "misses, never to corruption.")
+FLAGS.define("serving_prefill_chunk", 256,
+             "chunked prefill: a prompt (or cache-miss tail) longer "
+             "than this many tokens is prefilled in chunks of at most "
+             "this size, ONE chunk per engine tick, interleaved with "
+             "the fused decode step so a long prefill stops stalling "
+             "running slots' inter-token latency. Each chunk is padded "
+             "to the serving_prefill_buckets ladder, so the chunk size "
+             "should be a ladder value (a chunk of C pads to the "
+             "smallest bucket >= C; a chunk above the top bucket rounds "
+             "up and wastes the excess). 0 disables chunking "
+             "(whole-prompt single-shot prefill).", parser=int)
 FLAGS.define("serving_queue_deadline_s", 0.0,
              "default per-request admission deadline: a request still "
              "queued this many seconds after submit is shed as TIMED_OUT "

@@ -14,8 +14,9 @@ from paddle_tpu.serving.engine import (DecodeModel, DecoderLM, ServingEngine,
 from paddle_tpu.serving.faults import (FaultPlan, InjectedDeviceError,
                                        ManualClock, PageLeakError)
 from paddle_tpu.serving.kv_cache import (NULL_PAGE, KVPages, PagedKVConfig,
-                                         PagePool, append_token, gather_kv,
-                                         init_kv_pages, write_prompt)
+                                         PagePool, PrefixCache, append_token,
+                                         fork_page, gather_kv, init_kv_pages,
+                                         write_prompt)
 from paddle_tpu.serving.metrics import ServingMetrics
 from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
                                           Request, RequestStatus,
@@ -24,8 +25,9 @@ from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
 __all__ = [
     "ServingEngine", "DecodeModel", "DecoderLM", "greedy_decode_reference",
     "paged_decode_attention", "paged_decode_attention_reference",
-    "PagedKVConfig", "KVPages", "PagePool", "NULL_PAGE",
+    "PagedKVConfig", "KVPages", "PagePool", "PrefixCache", "NULL_PAGE",
     "init_kv_pages", "append_token", "write_prompt", "gather_kv",
+    "fork_page",
     "ContinuousBatchingScheduler", "Request", "RequestStatus",
     "SchedulerConfig", "bucket_for", "ServingMetrics",
     "FaultPlan", "ManualClock", "InjectedDeviceError", "PageLeakError",

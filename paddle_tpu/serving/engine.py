@@ -42,6 +42,17 @@ driven deterministically by a :class:`~paddle_tpu.serving.faults.FaultPlan`
 (injectable clock, decode-step errors, NaN logits, page pressure) and a
 free-list conservation check runs after every drain.
 
+Prefix caching + chunked prefill (round 9): with
+``FLAGS.serving_prefix_cache`` on (the default), admission splits every
+prompt into ``cached_prefix_pages + tail`` against a chained-hash
+:class:`~paddle_tpu.serving.kv_cache.PrefixCache` — the prefix pages are
+refcount-shared (charged zero new pages), the tail prefills with its
+positions offset by the cached length, and a full-cover hit
+copy-on-write-forks the last shared page and recomputes only the final
+token.  Prompts longer than ``FLAGS.serving_prefill_chunk`` prefill one
+chunk per tick, interleaved with the fused decode step, so a long
+prompt in the queue no longer degrades running slots' latency.
+
 The model plugs in through the small :class:`DecodeModel` contract
 rather than a ``Topology``: serving needs per-layer access to Q/K/V
 *before* attention runs (the cache sits between them), which the opaque
@@ -61,14 +72,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from paddle_tpu.ops.attention import flash_attention, mha_reference
+from paddle_tpu.ops.attention import (DEFAULT_MASK_VALUE, flash_attention,
+                                      mha_reference)
 from paddle_tpu.platform.flags import FLAGS
 from paddle_tpu.serving.decode_attention import paged_decode_attention
 from paddle_tpu.serving.faults import (FaultPlan, InjectedDeviceError,
                                        PageLeakError)
 from paddle_tpu.serving.kv_cache import (NULL_PAGE, KVPages, PagedKVConfig,
-                                         PagePool, append_token,
-                                         init_kv_pages, write_prompt)
+                                         PagePool, PrefixCache, append_token,
+                                         fork_page, gather_kv, init_kv_pages,
+                                         write_prompt, zero_pages)
 from paddle_tpu.serving.metrics import ServingMetrics
 from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
                                           Request, RequestStatus,
@@ -209,6 +222,8 @@ class ServingEngine:
                  decode_retries: int = 2,
                  transient_errors: Tuple[type, ...] = (InjectedDeviceError,),
                  max_retained: int = 10000,
+                 prefix_cache: Optional[bool] = None,
+                 prefill_chunk: Optional[int] = None,
                  faults: Optional[FaultPlan] = None,
                  time_fn: Optional[Callable[[], float]] = None):
         self.model = model
@@ -252,13 +267,23 @@ class ServingEngine:
             dtype=dtype)
         self._kv: KVPages = init_kv_pages(self.kv_cfg)
         self.pool = PagePool(num_pages)
+        if prefix_cache is None:
+            prefix_cache = bool(FLAGS.serving_prefix_cache)
+        if prefill_chunk is None:
+            prefill_chunk = int(FLAGS.serving_prefill_chunk)
+        self._prefill_chunk = max(0, int(prefill_chunk))
+        self.cache: Optional[PrefixCache] = None
+        if prefix_cache:
+            hash_fn = faults.cache_hash_fn() if faults is not None else None
+            self.cache = PrefixCache(self.pool, page_size, hash_fn=hash_fn)
         self.scheduler = ContinuousBatchingScheduler(
             self.pool, SchedulerConfig(
                 max_slots=max_slots, page_size=page_size,
                 max_pages_per_seq=int(max_pages_per_seq),
                 max_queue=max_queue,
                 preempt_budget=preempt_budget if preempt_budget > 0
-                else None))
+                else None),
+            cache=self.cache)
         self.metrics = ServingMetrics(pool_pages=self.pool.num_usable)
         self._use_kernel = use_kernel
         self._buckets = tuple(sorted(int(b) for b in buckets)) if buckets \
@@ -272,7 +297,14 @@ class ServingEngine:
         self._donate_kv = (1,) if jax.default_backend() != "cpu" else ()
         self._decode_fn = jax.jit(self._build_decode_fn(),
                                   donate_argnums=self._donate_kv)
+        # COW fork + failure scrub: kv is argument 0 in both (same
+        # donation gate as above)
+        self._fork_fn = jax.jit(
+            fork_page, donate_argnums=(0,) if self._donate_kv else ())
+        self._zero_fn = jax.jit(
+            zero_pages, donate_argnums=(0,) if self._donate_kv else ())
         self._prefill_fns: Dict[int, Callable] = {}
+        self._chunk_fns: Dict[int, Callable] = {}
         self._results: Dict[int, List[int]] = {}
         self._requests: Dict[int, Request] = {}
         # terminal rids in retirement order; oldest evicted past
@@ -349,6 +381,64 @@ class ServingEngine:
         self._prefill_fns[bucket] = fn
         return fn
 
+    def _chunk_fn(self, bucket: int):
+        """Prefill one CHUNK of a prompt whose earlier tokens are already
+        materialized in pages (a cached prefix, a COW-forked page, or
+        previous chunks).  The chunk's K/V is scattered into its pages
+        first, then attention runs over the request's whole gathered page
+        row with an offset-causal mask — kv position ``t`` is visible to
+        the query at absolute position ``start + i`` iff ``t <= start+i``
+        — so prior context and in-chunk causality come from ONE masked
+        attention, with no separate cross/self paths to keep in sync."""
+        fn = self._chunk_fns.get(bucket)
+        if fn is not None:
+            return fn
+        model, cfg = self.model, self.kv_cfg
+        page, pm = cfg.page_size, cfg.max_pages_per_seq
+        scale = float(cfg.head_dim) ** -0.5
+
+        def raw(params, kv: KVPages, tokens, n, start, page_row):
+            # tokens: [bucket] i32 (padded chunk); n: scalar i32 true
+            # chunk length; start: scalar i32 absolute position of
+            # tokens[0]; page_row: [Pm] i32 — this request's page table.
+            pos = jnp.arange(bucket, dtype=jnp.int32)
+            abs_pos = start + pos
+            x = model.embed(params, tokens[None], abs_pos[None])  # [1,T,E]
+            tmask = pos < n
+            dest = jnp.where(tmask, page_row[abs_pos // page], NULL_PAGE)
+            offs = abs_pos % page
+            kv_pos = jnp.arange(pm * page, dtype=jnp.int32)
+            mask = kv_pos[None, :] <= abs_pos[:, None]       # [T, Pm*page]
+            # positions beyond this chunk's end hold garbage (stale page
+            # contents, the null page): zero their gathered K/V rather
+            # than trusting the mask alone — softmax gives them weight
+            # exactly 0, but 0 * inf in the PV product would still be NaN
+            valid = (kv_pos < start + n)[None, :, None, None]
+            wmask = tmask[:, None, None]
+            for l in range(cfg.num_layers):
+                q, k, v = model.qkv(params, l, x)            # [1, T, H, D]
+                # padded rows attend over REAL keys (no segment split
+                # here), so their values can be junk: write zeros to the
+                # shared null page, never computed junk
+                kv = write_prompt(kv, l, jnp.where(wmask, k[0], 0.0),
+                                  jnp.where(wmask, v[0], 0.0), dest, offs)
+                kg, vg = gather_kv(kv, l, page_row[None])    # [1,Pm*pg,H,D]
+                kg = jnp.where(valid, kg, 0.0)
+                vg = jnp.where(valid, vg, 0.0)
+                s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                               kg.astype(jnp.float32)) * scale
+                s = jnp.where(mask[None, None], s, DEFAULT_MASK_VALUE)
+                p = jax.nn.softmax(s, axis=-1)
+                ctx = jnp.einsum("bhqk,bkhd->bqhd", p,
+                                 vg.astype(jnp.float32)).astype(q.dtype)
+                x = model.attn_out(params, l, ctx, x)
+            last = jnp.take(x[0], jnp.maximum(n - 1, 0), axis=0)
+            return model.logits(params, last), kv
+
+        fn = jax.jit(raw, donate_argnums=self._donate_kv)
+        self._chunk_fns[bucket] = fn
+        return fn
+
     # ---- user surface ----------------------------------------------------
 
     def submit(self, prompt: Sequence[int], max_tokens: int,
@@ -393,6 +483,18 @@ class ServingEngine:
         completion itself funnel through here): return the slot and
         pages — or leave the queue — stamp, count, retire.  One copy of
         the invariant, so no path can forget eviction or a counter."""
+        if status is RequestStatus.FAILED and req.pages:
+            # a FAILED request may have written non-finite K/V; scrub
+            # the suspect pages so re-granted ones can't leak inf into
+            # the next owner's masked attention reads.  Suspect = the
+            # request's UNCACHED pages: cached pages were finite-vouched
+            # at insertion (a failing chunk's were just forgotten) and
+            # may be shared right now — decode appends and failing
+            # chunks only ever write uncached ones.
+            suspect = [p for p in req.pages if not self.pool.is_cached(p)]
+            if suspect:
+                self._kv = self._zero_fn(self._kv,
+                                         jnp.asarray(suspect, jnp.int32))
         if req.slot is not None:
             self.scheduler.release(req, status)
         else:
@@ -450,6 +552,7 @@ class ServingEngine:
         if self.faults is not None:
             self.faults.tick_begin(tick)
             self.faults.apply_page_pressure(tick, self.pool)
+            self.faults.apply_cache_storm(tick, self.cache)
         now = self._time() if now is None else now
         # the shed estimator learns tick duration only from ticks that
         # followed a BUSY tick: in a continuous serving loop those run
@@ -478,14 +581,26 @@ class ServingEngine:
                                   if req.submitted_at is not None else now))
                 req.admitted_at = now
             req.last_progress_tick = tick
-            self._do_prefill(req)
+            self._begin_prefill(req)
+        # ONE chunk per prefilling request per tick: a freshly-admitted
+        # request takes its first chunk now, earlier admissions resume —
+        # and the fused decode below still runs every tick, so a long
+        # prefill no longer stalls running slots' inter-token latency
+        prefilling = [r for r in sched.running_requests()
+                      if r.status is RequestStatus.RUNNING and r.prefilling]
+        for req in prefilling:
+            self._prefill_step(req)
         running = [r for r in sched.running_requests()
-                   if r.status is RequestStatus.RUNNING]
+                   if r.status is RequestStatus.RUNNING
+                   and not r.prefilling and r.generated]
         if running:
             self._decode_with_retry(running, tick)
-        self._prev_tick_busy = bool(running) or bool(admitted)
+        self._prev_tick_busy = (bool(running) or bool(admitted) or
+                                bool(prefilling))
         self._watchdog_sweep(tick)
-        m.on_tick(sched.queue_depth, self.pool.num_in_use)
+        m.on_tick(sched.queue_depth, self.pool.num_live,
+                  self.pool.num_cached,
+                  self.cache.evictions if self.cache is not None else 0)
         self._tick = tick + 1
         return self.has_work
 
@@ -518,20 +633,34 @@ class ServingEngine:
     # ---- invariants / health --------------------------------------------
 
     def check_page_conservation(self) -> None:
-        """Free-list conservation: every usable page is either free, held
-        by a running request, or held by the fault plan's pressure window
-        — anything else is a leak (raises :class:`PageLeakError`, whose
-        message carries the grep-able ``PAGE-LEAK`` token)."""
+        """Two-part conservation (raises :class:`PageLeakError`, whose
+        message carries a grep-able token either way):
+
+        - ``PAGE-LEAK`` — every usable page is either on the free list
+          or tracked in use (live or cached-reclaimable);
+        - ``REF-LEAK`` — the pool's total refcount equals the references
+          actually held: one per page-table entry of every running or
+          queued request, one per fault-plan pressure page.  Cached
+          pages parked at refcount 0 hold none, so sharing, COW forks,
+          preemption-unref and eviction all have to balance exactly."""
         pool = self.pool
-        held = sum(len(r.pages) for r in self.scheduler.running.values())
-        held += sum(len(r.pages) for r in self.scheduler.queue)
-        if self.faults is not None:
-            held += len(self.faults.held_pages)
-        if pool.num_free + pool.num_in_use != pool.num_usable or \
-                held != pool.num_in_use:
+        if pool.num_free + pool.num_in_use != pool.num_usable:
             raise PageLeakError(
                 f"PAGE-LEAK: free={pool.num_free} in_use={pool.num_in_use} "
-                f"usable={pool.num_usable} accounted={held}")
+                f"usable={pool.num_usable}")
+        live = (list(self.scheduler.running.values()) +
+                list(self.scheduler.queue))
+        held = sum(len(r.pages) for r in live)
+        # an admission-time COW pin (fork source awaiting the copy) is a
+        # held reference too, until the engine's fork consumes it
+        held += sum(1 for r in live if r.cow_src is not None)
+        if self.faults is not None:
+            held += len(self.faults.held_pages)
+        if held != pool.total_refs:
+            raise PageLeakError(
+                f"REF-LEAK: held={held} refs={pool.total_refs} "
+                f"cached={pool.num_cached} free={pool.num_free} "
+                f"usable={pool.num_usable}")
 
     def healthz(self) -> Dict[str, object]:
         """One-call liveness snapshot for an external prober.  O(live
@@ -560,7 +689,17 @@ class ServingEngine:
             "queue_depth": self.scheduler.queue_depth,
             "running": len(self.scheduler.running),
             "pages_free": self.pool.num_free,
-            "pages_in_use": self.pool.num_in_use,
+            # in_use = live sequence holders; cached/reclaimable pages
+            # are reported separately so a prober can assert the cache
+            # drains to steady state (live 0, cached >= 0 all evictable)
+            "pages_in_use": self.pool.num_live,
+            "pages_cached": self.pool.num_cached,
+            "pages_reclaimable": self.pool.num_reclaimable,
+            # `is not None`, not truthiness: PrefixCache defines __len__,
+            # so an empty-but-active cache is falsy
+            "cache_hits": self.cache.hits if self.cache is not None else 0,
+            "cache_misses": (self.cache.misses
+                             if self.cache is not None else 0),
             "page_leak": leak,
             "status_counts": counts,
             "deadline_miss_rate": round(self.metrics.deadline_miss_rate(),
@@ -623,25 +762,99 @@ class ServingEngine:
             if tick - req.last_progress_tick >= self.watchdog_ticks:
                 self._finish(req, RequestStatus.FAILED, self._time())
 
-    def _do_prefill(self, req: Request) -> None:
+    def _begin_prefill(self, req: Request) -> None:
+        """Stitch-time work for a newly (re-)admitted request: record
+        the prefix-cache outcome, run the COW fork, and arm the chunked
+        prefill (its first chunk runs this same tick)."""
         toks = req.cache_tokens
-        n = len(toks)
-        bucket = bucket_for(n, self._buckets, self.kv_cfg.max_seq_len)
-        padded = np.zeros((bucket,), np.int32)
-        padded[:n] = toks
-        row = np.full((self.kv_cfg.max_pages_per_seq,), NULL_PAGE, np.int32)
+        req.prefilling = True
+        req.chain_hash, req.chain_blocks = None, 0   # fresh insert cursor
+        self.metrics.on_prefix(len(toks), req.cached_len)
+        if req.cow_src is not None:
+            # full-cover hit: the tail's only token rewrites a position
+            # INSIDE the last shared page, so fork it into the request's
+            # first private page before anything is written
+            dst = req.pages[req.cache_len // self.kv_cfg.page_size]
+            self._kv = self._fork_fn(self._kv,
+                                     jnp.asarray(req.cow_src, jnp.int32),
+                                     jnp.asarray(dst, jnp.int32))
+            # the fork consumed the source: drop the admission-time pin
+            # that kept it from being evicted before the copy ran
+            self.pool.free([req.cow_src])
+            req.cow_src = None
+            self.metrics.on_cow()
+
+    def _prefill_step(self, req: Request) -> None:
+        """Advance one prefill chunk — or the whole prompt on the
+        single-shot fast path (no cached prefix, fits in one chunk).  On
+        the final chunk the last position's logits emit the first token
+        and the request joins the fused decode batch.
+
+        Every chunk's logits go through the finite guard BEFORE its full
+        pages are indexed (a chunk's last-position logits attend over
+        every K/V written so far, so finiteness transitively vouches for
+        the whole chain): without the per-chunk check, suspect K/V from
+        an overflowing prompt would be hittable for the whole multi-tick
+        prefill window, and a sharer admitted in that window would
+        stitch it before the final-chunk rollback ran.  The sync this
+        costs is one host readback per chunk — the tick already pays one
+        for decode."""
+        toks = req.cache_tokens
+        total = len(toks)
+        start = req.cache_len
+        chunk = self._prefill_chunk
+        cfg = self.kv_cfg
+        row = np.full((cfg.max_pages_per_seq,), NULL_PAGE, np.int32)
         row[:len(req.pages)] = req.pages
-        logits, self._kv = self._prefill_fn(bucket)(
-            self.params, self._kv, jnp.asarray(padded),
-            jnp.asarray(n, jnp.int32), jnp.asarray(row))
-        req.cache_len = n
-        self.metrics.on_prefill(n)
+        if start == 0 and (chunk <= 0 or total <= chunk):
+            # fast path: one-shot bucketed prefill (flash when shaped)
+            bucket = bucket_for(total, self._buckets, cfg.max_seq_len)
+            padded = np.zeros((bucket,), np.int32)
+            padded[:total] = toks
+            logits, self._kv = self._prefill_fn(bucket)(
+                self.params, self._kv, jnp.asarray(padded),
+                jnp.asarray(total, jnp.int32), jnp.asarray(row))
+            req.cache_len = total
+            self.metrics.on_prefill(total)
+        else:
+            end = total if chunk <= 0 else min(total, start + chunk)
+            n = end - start
+            bucket = bucket_for(n, self._buckets, cfg.max_seq_len)
+            padded = np.zeros((bucket,), np.int32)
+            padded[:n] = toks[start:end]
+            logits, self._kv = self._chunk_fn(bucket)(
+                self.params, self._kv, jnp.asarray(padded),
+                jnp.asarray(n, jnp.int32), jnp.asarray(start, jnp.int32),
+                jnp.asarray(row))
+            req.cache_len = end
+            self.metrics.on_prefill(n)
+        req.last_progress_tick = self._tick   # chunks are progress too
         logits = np.asarray(logits)   # forces device sync
         # stamp AFTER the sync so TTFT includes the prefill compute
         now = self._time()
         if not np.isfinite(logits).all():
+            if self.cache is not None:
+                # roll back entries ONLY for pages the FAILING chunk
+                # wrote (from the pre-chunk position onward): earlier
+                # chunks passed their own finite guard and their cached
+                # pages may already be stitched by a concurrent sharer —
+                # forgetting them would route them into the FAILED scrub
+                # below and zero-wipe K/V the sharer is reading
+                self.cache.forget(req.pages[start // cfg.page_size:])
+            req.prefilling = False
             self._finish(req, RequestStatus.FAILED, now)
             return
+        if self.cache is not None:
+            # newly-completed FULL pages — now finite-vouched — become
+            # hittable immediately, so even a preempted or mid-prefill
+            # prompt re-prefills cheaply.  The chain cursor makes each
+            # chunk's insert O(chunk), not O(prefix-so-far).
+            req.chain_hash, req.chain_blocks = self.cache.insert(
+                toks, req.pages, req.cache_len,
+                from_block=req.chain_blocks, prev_hash=req.chain_hash)
+        if req.cache_len < total:
+            return                            # more chunks, later ticks
+        req.prefilling = False
         self._emit(req, int(np.argmax(logits)), now)
 
     def _do_decode(self, running: List[Request]) -> None:

@@ -26,6 +26,14 @@ Injection points (all host-side, all deterministic):
   growth-time preemption and admission stalls; the pages are returned
   at ``end_tick`` (or at drain) and counted by the leak checker while
   held.
+- **prefix-cache hash collisions** — ``hash_collisions=True`` replaces
+  the cache's chained block hash with a constant, so EVERY block keys
+  identically; the cache's token verification must turn the collisions
+  into misses, proving a hash break degrades throughput, never
+  correctness.
+- **cache eviction storm** — ``cache_storm=(start_tick, end_tick)``
+  flushes every refcount-0 cached page each tick of the window,
+  exercising eviction/re-insert churn and the REF-LEAK invariant.
 """
 
 from __future__ import annotations
@@ -88,6 +96,9 @@ class FaultPlan:
     slow_ticks: Dict[int, float] = field(default_factory=dict)
     page_pressure: Optional[Tuple[int, int, int]] = None
     held_pages: List[int] = field(default_factory=list)
+    # prefix-cache faults (round 9)
+    hash_collisions: bool = False
+    cache_storm: Optional[Tuple[int, int]] = None
 
     def __post_init__(self):
         self._rng = np.random.RandomState(self.seed)
@@ -143,3 +154,21 @@ class FaultPlan:
         if self.held_pages:
             pool.free(self.held_pages)
             self.held_pages = []
+
+    def cache_hash_fn(self):
+        """The prefix cache's hash override: a constant under
+        ``hash_collisions`` (every block collides; token verification
+        must carry correctness alone), else None (default hash)."""
+        if self.hash_collisions:
+            return lambda prev, block: 0xC0111DE
+        return None
+
+    def apply_cache_storm(self, tick: int, cache) -> int:
+        """Inside the ``cache_storm`` window, flush every reclaimable
+        cached page this tick; returns how many were evicted."""
+        if cache is None or self.cache_storm is None:
+            return 0
+        start, end = self.cache_storm
+        if start <= tick < end:
+            return cache.flush()
+        return 0

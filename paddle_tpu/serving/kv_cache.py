@@ -25,12 +25,23 @@ Page 0 is **reserved as the null page**: masked writes (prompt padding,
 inactive decode slots) are steered to it instead of being predicated
 out, which keeps every scatter dense and shape-stable under jit.  No
 live sequence is ever granted page 0.
+
+Automatic prefix caching (round 9): pages are **refcounted** — a page
+shared by N sequences is freed only when the last holder unrefs it —
+and a host-side :class:`PrefixCache` indexes *full* pages by chained
+token-block hashes, so a new prompt can be split into
+``cached_prefix_pages + tail`` and skip re-forwarding the prefix
+entirely (arXiv 2603.09555: the cache, not the kernel, is where serving
+latency is won).  Cached pages at refcount 0 stay out of the free list
+as a reclaimable pool; LRU eviction returns them under pressure.
 """
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import List, NamedTuple, Optional
+from typing import (Callable, Dict, List, NamedTuple, Optional, Sequence,
+                    Set, Tuple)
 
 import jax
 import jax.numpy as jnp
@@ -113,6 +124,31 @@ def write_prompt(kv: KVPages, layer: int, k_seq: jax.Array, v_seq: jax.Array,
     return KVPages(k, v)
 
 
+def zero_pages(kv: KVPages, page_ids: jax.Array) -> KVPages:
+    """Zero whole pages across every layer (failed-request scrub).
+
+    page_ids: [n] int32.  A prompt that overflows to non-finite values
+    leaves inf/NaN K/V in the pages it wrote; freed and re-granted,
+    those stale values would poison the NEXT owner through masked
+    attention reads (softmax weight 0 times inf is NaN).  Scrubbing on
+    the failure path keeps the pool finite-by-construction."""
+    k = kv.k.at[:, page_ids].set(0.0)
+    v = kv.v.at[:, page_ids].set(0.0)
+    return KVPages(k, v)
+
+
+def fork_page(kv: KVPages, src: jax.Array, dst: jax.Array) -> KVPages:
+    """Copy one page's K/V across every layer (the copy-on-write fork).
+
+    src/dst: scalar int32 page ids.  The forked page becomes a private
+    replica of a shared cached page, so a sequence whose tail must write
+    into the last shared page of its prefix does so without corrupting
+    the other holders.  Pure; returns the updated pool."""
+    k = kv.k.at[:, dst].set(kv.k[:, src])
+    v = kv.v.at[:, dst].set(kv.v[:, src])
+    return KVPages(k, v)
+
+
 def gather_kv(kv: KVPages, layer: int, page_table: jax.Array):
     """Linearize page tables into contiguous K/V.
 
@@ -130,19 +166,35 @@ def gather_kv(kv: KVPages, layer: int, page_table: jax.Array):
 
 @dataclass
 class PagePool:
-    """Host-side free list over page ids 1..num_pages-1 (0 is the null
-    page).  Allocation is all-or-nothing so admission control can't
-    partially strand a request."""
+    """Host-side refcounted allocator over page ids 1..num_pages-1 (0 is
+    the null page).  Allocation is all-or-nothing so admission control
+    can't partially strand a request.
+
+    Every non-free page carries a refcount: ``alloc`` grants pages at
+    refcount 1, ``ref`` adds a holder (prefix sharing), ``free`` drops
+    one — the page returns to the free list only at refcount 0, and not
+    even then if a :class:`PrefixCache` has registered it (``mark_cached``):
+    cached pages at refcount 0 are *reclaimable*, parked for future
+    prefix hits until ``release_cached`` (LRU eviction) returns them.
+
+    The free list is LIFO over ascending ids (recently-freed pages are
+    re-granted first, keeping the working set compact) and mirrored by a
+    set, so the double-free guard is O(1) instead of an O(pages) list
+    scan on every free."""
 
     num_pages: int
     _free: List[int] = field(default_factory=list)
+    _free_set: Set[int] = field(default_factory=set)
+    _refs: Dict[int, int] = field(default_factory=dict)
+    _cached: Set[int] = field(default_factory=set)
 
     def __post_init__(self):
         enforce_that(self.num_pages >= 2, "pool needs >= 2 pages",
                      context="serving")
-        # LIFO over ascending ids: recently-freed pages are re-granted
-        # first, keeping the working set compact
         self._free = list(range(self.num_pages - 1, NULL_PAGE, -1))
+        self._free_set = set(self._free)
+        self._refs = {}
+        self._cached = set()
 
     @property
     def num_free(self) -> int:
@@ -154,22 +206,270 @@ class PagePool:
 
     @property
     def num_in_use(self) -> int:
-        return self.num_usable - self.num_free
+        """Pages not on the free list: live (refcount > 0) plus cached
+        pages parked at refcount 0."""
+        return len(self._refs)
+
+    @property
+    def num_live(self) -> int:
+        """Pages held by at least one sequence (or the fault plan)."""
+        return sum(1 for c in self._refs.values() if c > 0)
+
+    @property
+    def num_cached(self) -> int:
+        """Pages registered by a PrefixCache (any refcount)."""
+        return len(self._cached)
+
+    @property
+    def num_reclaimable(self) -> int:
+        """Cached pages at refcount 0 — evictable under pressure."""
+        return sum(1 for p in self._cached if self._refs[p] == 0)
+
+    @property
+    def total_refs(self) -> int:
+        """Sum of all refcounts — must equal the holders' page-list
+        lengths summed (the REF-LEAK conservation invariant)."""
+        return sum(self._refs.values())
 
     def occupancy(self) -> float:
         return self.num_in_use / max(1, self.num_usable)
 
+    def refcount(self, p: int) -> int:
+        return self._refs.get(p, 0)
+
+    def is_cached(self, p: int) -> bool:
+        return p in self._cached
+
     def alloc(self, n: int) -> Optional[List[int]]:
-        """Grant ``n`` pages, or None (and no change) if fewer are free."""
+        """Grant ``n`` pages at refcount 1 each, or None (and no change)
+        if fewer are free.  Reclaimable cached pages are NOT granted
+        here — evict them first (``PrefixCache.evict``)."""
         if n < 0 or n > len(self._free):
             return None
         got = [self._free.pop() for _ in range(n)]
+        for p in got:
+            self._free_set.discard(p)
+            self._refs[p] = 1
         return got
 
-    def free(self, pages: List[int]) -> None:
+    def ref(self, pages: Sequence[int]) -> None:
+        """Add one holder to each page (a prefix-cache hit sharing them
+        with a new sequence).  Pages must be in use or cached."""
+        for p in pages:
+            enforce_that(p in self._refs, f"ref of free page {p}",
+                         context="serving")
+            self._refs[p] += 1
+
+    def free(self, pages: Sequence[int]) -> None:
+        """Drop one holder per page (unref).  A page reaches the free
+        list only at refcount 0, and stays parked (reclaimable) instead
+        if a PrefixCache holds it."""
         for p in pages:
             enforce_that(p != NULL_PAGE, "cannot free the null page",
                          context="serving")
-            enforce_that(p not in self._free, f"double free of page {p}",
-                         context="serving")
+            enforce_that(p not in self._free_set,
+                         f"double free of page {p}", context="serving")
+            enforce_that(self._refs.get(p, 0) > 0,
+                         f"free of unreferenced page {p}", context="serving")
+            self._refs[p] -= 1
+            if self._refs[p] == 0 and p not in self._cached:
+                del self._refs[p]
+                self._free.append(p)
+                self._free_set.add(p)
+
+    def mark_cached(self, p: int) -> None:
+        """Register a (non-free) page as prefix-cache-held: at refcount
+        0 it parks as reclaimable instead of returning to the free
+        list."""
+        enforce_that(p in self._refs, f"cannot cache free page {p}",
+                     context="serving")
+        self._cached.add(p)
+
+    def unmark_cached(self, p: int) -> None:
+        """Withdraw a page's cache registration (failed-prefill
+        rollback).  A page already parked at refcount 0 is freed on the
+        spot — nothing holds it and nothing can hit it anymore."""
+        if p not in self._cached:
+            return
+        self._cached.discard(p)
+        if self._refs.get(p, 0) == 0:
+            del self._refs[p]
             self._free.append(p)
+            self._free_set.add(p)
+
+    def release_cached(self, p: int) -> None:
+        """Eviction: return a refcount-0 cached page to the free list."""
+        enforce_that(p in self._cached, f"page {p} is not cached",
+                     context="serving")
+        enforce_that(self._refs.get(p, 0) == 0,
+                     f"evicting page {p} with live holders",
+                     context="serving")
+        self._cached.discard(p)
+        del self._refs[p]
+        self._free.append(p)
+        self._free_set.add(p)
+
+
+# ---------------------------------------------------------------------------
+# Automatic prefix caching: host-side index over full pages
+# ---------------------------------------------------------------------------
+
+_CHAIN_SEED = 0x9E3779B9   # any fixed non-zero start for the hash chain
+
+
+def _chain_hash(prev: int, block: Tuple[int, ...]) -> int:
+    """Default chained block hash: each full page's key commits to every
+    token before it via the previous link.  Python's tuple hash over
+    ints is deterministic within and across processes (int hashing is
+    not seed-randomized), which is all the index needs — collisions are
+    verified away by token comparison, never trusted."""
+    return hash((prev, block))
+
+
+@dataclass
+class _CacheEntry:
+    page: int                 # the page holding this block's K/V
+    tokens: Tuple[int, ...]   # the block itself (collision verification)
+    prev: int                 # parent link hash (chain verification)
+
+
+class PrefixCache:
+    """Hash-chained index over *full* KV pages for automatic prefix
+    caching.
+
+    Key design points:
+
+    - only FULL pages are indexed: a partial page is still being
+      appended to by its owner, so it can never be safely shared;
+    - keys are chained (``h_j = hash(h_{j-1}, block_j)``), so a hit on
+      page j implies the whole prefix up to j matched — the index acts
+      as a radix tree flattened into a hash map;
+    - every hit is VERIFIED by comparing the stored block tokens and
+      parent link, so a hash collision (including fault-injected
+      degenerate hashes) degrades to a miss, never to corruption;
+    - entries are LRU-ordered; :meth:`evict` frees refcount-0 pages
+      oldest-first under pool pressure.  Evicting a mid-chain entry
+      orphans its descendants (unreachable, evicted later by the same
+      LRU sweep) — safe, just conservative.
+
+    The cache does NOT hold refcounts of its own: a cached page with no
+    sequence holders parks at refcount 0 inside the :class:`PagePool`
+    (reclaimable) rather than returning to the free list."""
+
+    def __init__(self, pool: PagePool, page_size: int,
+                 hash_fn: Optional[Callable[[int, Tuple[int, ...]], int]]
+                 = None):
+        enforce_that(page_size >= 1, "page_size must be positive",
+                     context="serving")
+        self.pool = pool
+        self.page_size = int(page_size)
+        self._hash = hash_fn or _chain_hash
+        self._index: "OrderedDict[int, _CacheEntry]" = OrderedDict()
+        self.hits = 0          # lookups that matched >= 1 page (healthz)
+        self.misses = 0        # lookups that matched none (healthz)
+        self.evictions = 0     # pages evicted (LRU or storm)
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    def lookup(self, tokens: Sequence[int],
+               touch: bool = False) -> Tuple[List[int], int]:
+        """Longest verified cached prefix of ``tokens`` in full pages.
+
+        Returns ``(pages, hit_len)`` with ``hit_len = len(pages) *
+        page_size``.  Does NOT take references — the caller refs the
+        pages it actually stitches (all-or-nothing with its allocation),
+        so a failed admission leaves no state behind.
+
+        ``touch=False`` (the default) is a PURE read: no LRU reorder, no
+        hit/miss counting.  The scheduler probes every admission attempt
+        — a head-of-line request blocked on pages re-probes every tick,
+        and counting those would inflate the stats and churn eviction
+        order for zero actual stitches.  It re-calls with ``touch=True``
+        exactly once, when the admission commits."""
+        page = self.page_size
+        pages: List[int] = []
+        h = _CHAIN_SEED
+        for j in range(len(tokens) // page):
+            block = tuple(tokens[j * page:(j + 1) * page])
+            key = self._hash(h, block)
+            e = self._index.get(key)
+            if e is None or e.tokens != block or e.prev != h:
+                break          # miss or verified-away collision
+            if touch:
+                self._index.move_to_end(key)
+            pages.append(e.page)
+            h = key
+        if touch:
+            if pages:
+                self.hits += 1
+            else:
+                self.misses += 1
+        return pages, len(pages) * page
+
+    def insert(self, tokens: Sequence[int], pages: Sequence[int],
+               upto: int, from_block: int = 0,
+               prev_hash: Optional[int] = None) -> Tuple[int, int]:
+        """Index the full pages covering ``tokens[:upto]`` (page j of
+        the sequence lives in ``pages[j]``).  Idempotent — re-inserting
+        a chunk already indexed is a no-op, and an existing entry always
+        wins (a concurrent identical prefill keeps its private copy).
+
+        ``from_block``/``prev_hash`` resume the hash chain at a block
+        boundary, so a chunked prefill indexes each chunk in O(chunk)
+        instead of re-hashing the whole prefix per chunk (quadratic in
+        prompt length on the tick hot path).  Returns ``(chain_hash,
+        blocks_done)`` for the caller to pass back on its next chunk."""
+        page = self.page_size
+        h = _CHAIN_SEED if prev_hash is None else prev_hash
+        nblocks = min(upto, len(tokens)) // page
+        for j in range(from_block, nblocks):
+            block = tuple(tokens[j * page:(j + 1) * page])
+            key = self._hash(h, block)
+            e = self._index.get(key)
+            if e is None:
+                self._index[key] = _CacheEntry(page=int(pages[j]),
+                                               tokens=block, prev=h)
+                self.pool.mark_cached(int(pages[j]))
+            h = key
+        return h, max(from_block, nblocks)
+
+    def forget(self, pages: Sequence[int]) -> int:
+        """Drop every index entry whose page is in ``pages`` (they stay
+        with their holder; once unref'd they go straight to the free
+        list instead of parking).  A prefill that fails the finite-
+        logits guard calls this so its (possibly NaN-laden) K/V can
+        never be stitched into a later request — without it, one
+        overflowing prompt would poison every future request sharing
+        the prefix."""
+        ps = {int(p) for p in pages}
+        dropped = 0
+        for key in [k for k, e in self._index.items() if e.page in ps]:
+            e = self._index.pop(key)
+            self.pool.unmark_cached(e.page)
+            dropped += 1
+        return dropped
+
+    def evict(self, n: int) -> int:
+        """Evict up to ``n`` refcount-0 cached pages, LRU first; returns
+        how many were actually freed.  Pages with live holders are
+        skipped (their entries stay — they are still hittable)."""
+        if n <= 0:
+            return 0
+        freed = 0
+        for key in list(self._index):
+            if freed >= n:
+                break
+            e = self._index[key]
+            if self.pool.refcount(e.page) == 0:
+                del self._index[key]
+                self.pool.release_cached(e.page)
+                self.evictions += 1
+                freed += 1
+        return freed
+
+    def flush(self) -> int:
+        """Evict every reclaimable page (the fault plan's eviction
+        storm; also useful for tests).  Entries with live holders
+        survive."""
+        return self.evict(len(self._index))

@@ -50,9 +50,15 @@ class ServingMetrics:
         self.preemptions = 0
         self.ticks = 0
         self.tokens_generated = 0
-        self.prefill_tokens = 0
+        self.prefill_tokens = 0       # tokens actually forwarded at prefill
+        # prefix caching (round 9)
+        self.prefix_requested_tokens = 0  # cache_tokens summed at admission
+        self.prefill_tokens_saved = 0     # of those, served from the cache
+        self.cow_forks = 0            # copy-on-write page forks
+        self.cache_evictions = 0      # gauge: cache's cumulative evictions
         self.queue_depth = 0          # gauge: last tick
-        self.pages_in_use = 0         # gauge: last tick
+        self.pages_in_use = 0         # gauge: last tick, LIVE holders only
+        self.pages_cached = 0         # gauge: last tick, prefix-cache pages
         self.peak_pages_in_use = 0
         self.ttft_s = deque(maxlen=_WINDOW)
         self.queue_wait_s = deque(maxlen=_WINDOW)
@@ -70,6 +76,17 @@ class ServingMetrics:
 
     def on_prefill(self, n_tokens: int) -> None:
         self.prefill_tokens += n_tokens
+
+    def on_prefix(self, requested: int, saved: int) -> None:
+        """One admission's prefix-cache outcome: ``requested`` tokens
+        wanted materializing, ``saved`` of them came stitched from the
+        cache (0 on a miss or with caching off).  Re-admissions after
+        preemption count again — saved recompute is still saved work."""
+        self.prefix_requested_tokens += requested
+        self.prefill_tokens_saved += saved
+
+    def on_cow(self) -> None:
+        self.cow_forks += 1
 
     def on_admit(self, queue_wait_s: float) -> None:
         self.queue_wait_s.append(max(0.0, queue_wait_s))
@@ -101,10 +118,13 @@ class ServingMetrics:
     def on_preempt(self, n: int) -> None:
         self.preemptions += n
 
-    def on_tick(self, queue_depth: int, pages_in_use: int) -> None:
+    def on_tick(self, queue_depth: int, pages_in_use: int,
+                pages_cached: int = 0, cache_evictions: int = 0) -> None:
         self.ticks += 1
         self.queue_depth = queue_depth
         self.pages_in_use = pages_in_use
+        self.pages_cached = pages_cached
+        self.cache_evictions = cache_evictions
         self.peak_pages_in_use = max(self.peak_pages_in_use, pages_in_use)
 
     # ---- scrape ----------------------------------------------------------
@@ -133,6 +153,13 @@ class ServingMetrics:
             return 0.0
         return (self.timed_out + self.shed) / demand
 
+    def prefix_hit_rate(self) -> float:
+        """Token-level hit rate: of all the prefill tokens admissions
+        asked for, the fraction served from the prefix cache."""
+        if self.prefix_requested_tokens == 0:
+            return 0.0
+        return self.prefill_tokens_saved / self.prefix_requested_tokens
+
     def snapshot(self) -> Dict[str, float]:
         return {
             "tokens_per_s": round(self.tokens_per_s(), 2),
@@ -141,6 +168,11 @@ class ServingMetrics:
             "queue_wait_ms_p95": round(self.queue_wait_ms_p95(), 3),
             "tokens_generated": self.tokens_generated,
             "prefill_tokens": self.prefill_tokens,
+            "prefix_hit_rate": round(self.prefix_hit_rate(), 4),
+            "prefill_tokens_saved": self.prefill_tokens_saved,
+            "cow_forks": self.cow_forks,
+            "cache_evictions": self.cache_evictions,
+            "pages_cached": self.pages_cached,
             "requests_submitted": self.submitted,
             "requests_rejected": self.rejected,
             "requests_completed": self.completed,

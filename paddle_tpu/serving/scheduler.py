@@ -41,7 +41,7 @@ from enum import Enum
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from paddle_tpu.platform.enforce import enforce_that
-from paddle_tpu.serving.kv_cache import PagePool
+from paddle_tpu.serving.kv_cache import PagePool, PrefixCache
 
 _rid_counter = itertools.count()
 
@@ -97,6 +97,15 @@ class Request:
     preemptions: int = 0
     escalated: bool = False         # preempt budget burned: never a victim
     last_progress_tick: int = 0     # engine tick of the last emitted token
+    # prefix caching + chunked prefill (round 9)
+    cached_len: int = 0             # prefix tokens stitched from the cache
+    cow_src: Optional[int] = None   # shared page to COW-fork before prefill
+    prefilling: bool = False        # admitted but chunks still
+    #                                 materializing; False once decoding
+    # cache-insert chain cursor (engine-owned, reset per admission):
+    # chunk j's insert resumes hashing where chunk j-1 stopped
+    chain_hash: Optional[int] = None
+    chain_blocks: int = 0
 
     @property
     def cache_tokens(self) -> List[int]:
@@ -132,9 +141,11 @@ class ContinuousBatchingScheduler:
     """Queue + slot + page bookkeeping.  All methods are host-side and
     cheap; device work happens in the engine between calls."""
 
-    def __init__(self, pool: PagePool, cfg: SchedulerConfig):
+    def __init__(self, pool: PagePool, cfg: SchedulerConfig,
+                 cache: Optional[PrefixCache] = None):
         self.pool = pool
         self.cfg = cfg
+        self.cache = cache          # prefix cache; None = caching off
         self.queue: Deque[Request] = deque()
         self.running: Dict[int, Request] = {}       # slot -> request
         self._free_slots: List[int] = list(range(cfg.max_slots - 1, -1, -1))
@@ -167,6 +178,15 @@ class ContinuousBatchingScheduler:
     def _pages_for(self, n_tokens: int) -> int:
         return -(-n_tokens // self.cfg.page_size)  # ceil
 
+    def _alloc(self, n: int) -> Optional[List[int]]:
+        """Allocate with cache pressure relief: when the free list is
+        short, evict LRU refcount-0 cached pages to cover the shortfall
+        before giving up — cached pages are an opportunistic reserve,
+        never a reason to refuse admission or trigger preemption."""
+        if self.cache is not None and n > self.pool.num_free:
+            self.cache.evict(n - self.pool.num_free)
+        return self.pool.alloc(n)
+
     def admit(self) -> List[Request]:
         """Move queued requests into slots while a slot AND the pages for
         their (re-)prefill are available.  FIFO with head-of-line
@@ -176,16 +196,61 @@ class ContinuousBatchingScheduler:
         The allocation covers ``cache_tokens + 1`` — the prefill plus
         the first decode append — so a freshly-admitted request can
         never be the growth victim of the very tick that paid for its
-        prefill (the engine runs growth/preemption BEFORE admission)."""
+        prefill (the engine runs growth/preemption BEFORE admission).
+
+        With a prefix cache, the request is charged only its NEW pages:
+        the longest verified cached prefix is stitched in as shared
+        pages (ref'd, not copied) and the prefill starts at
+        ``cached_len``.  A full-cover hit (every page of ``cache_tokens``
+        cached) marks the last shared page for a copy-on-write fork —
+        the tail must recompute the final position's logits, and its KV
+        write may not land in a page other sequences read."""
         admitted: List[Request] = []
+        page = self.cfg.page_size
         while self.queue and self._free_slots:
             req = self.queue[0]
-            pages = self.pool.alloc(
-                self._pages_for(len(req.cache_tokens) + 1))
-            if pages is None:
+            toks = req.cache_tokens
+            total = self._pages_for(len(toks) + 1)
+            shared: List[int] = []
+            stitched = 0
+            cow_src = None
+            if self.cache is not None:
+                hit_pages, hit_len = self.cache.lookup(toks)
+                if hit_pages and hit_len >= len(toks):
+                    # full cover: fork the last shared page, recompute
+                    # only the final token (its logits seed decoding)
+                    cow_src = hit_pages[-1]
+                    shared = hit_pages[:-1]
+                    stitched = len(toks) - 1
+                else:
+                    shared = hit_pages
+                    stitched = hit_len
+            # pin the stitched pages (and the COW fork source — it is
+            # read by the engine's fork, after this call returns) BEFORE
+            # allocating: _alloc may evict refcount-0 cached pages, and
+            # without the pin it could evict and re-grant the very pages
+            # this hit is about to share.  On refusal the pins are
+            # dropped, restoring the exact prior state (all-or-nothing).
+            self.pool.ref(shared)
+            if cow_src is not None:
+                self.pool.ref([cow_src])
+            new = self._alloc(total - len(shared))
+            if new is None:
+                self.pool.free(shared)
+                if cow_src is not None:
+                    self.pool.free([cow_src])
                 break
             self.queue.popleft()
-            req.pages = pages
+            if self.cache is not None:
+                # admission committed: NOW touch the LRU order and the
+                # hit/miss counters, exactly once per stitch (the probe
+                # above was a pure read; the pins above guarantee the
+                # re-walk sees the same entries)
+                self.cache.lookup(toks, touch=True)
+            req.pages = shared + new     # page j holds tokens [jP, jP+P)
+            req.cached_len = stitched
+            req.cache_len = stitched     # engine prefills from here on
+            req.cow_src = cow_src        # fork target is new[0] (engine)
             req.slot = self._free_slots.pop()
             req.status = RequestStatus.RUNNING
             self.running[req.slot] = req
@@ -208,9 +273,10 @@ class ContinuousBatchingScheduler:
     def ensure_decode_pages(self) -> List[Request]:
         """Before a decode tick: every running sequence whose next append
         lands on a page boundary needs one more page.  Oldest requests
-        are served first; when the pool is dry the YOUNGEST running
-        sequence still under its preemption budget is preempted (pages
-        freed, tokens re-queued at the front) until the growth fits.
+        are served first; when the pool is dry, refcount-0 cached pages
+        are LRU-evicted first, and only then is the YOUNGEST running
+        sequence still under its preemption budget preempted (pages
+        unref'd, tokens re-queued at the front) until the growth fits.
         A grower with no eligible victim preempts ITSELF — correctness
         (the append must land on an owned page) beats its budget.
         Returns the preempted requests."""
@@ -222,7 +288,7 @@ class ContinuousBatchingScheduler:
             if req.cache_len < len(req.pages) * self.cfg.page_size:
                 continue
             while True:
-                got = self.pool.alloc(1)
+                got = self._alloc(1)
                 if got is not None:
                     req.pages.extend(got)
                     break
@@ -247,6 +313,9 @@ class ContinuousBatchingScheduler:
     def _preempt(self, req: Request) -> None:
         self._release_slot_and_pages(req)
         req.cache_len = 0
+        req.cached_len = 0
+        req.cow_src = None
+        req.prefilling = False       # re-stitched at re-admission
         req.status = RequestStatus.PREEMPTED
         req.preemptions += 1
         self.preemption_count += 1
@@ -283,6 +352,11 @@ class ContinuousBatchingScheduler:
         req.status = status
 
     def _release_slot_and_pages(self, req: Request) -> None:
+        if req.cow_src is not None:
+            # admission pinned the fork source; if the request exits
+            # before the engine ran the fork, drop the pin here
+            self.pool.free([req.cow_src])
+            req.cow_src = None
         if req.pages:
             self.pool.free(req.pages)
             req.pages = []

@@ -98,3 +98,15 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if item.name in _SLOW_TESTS:
             item.add_marker(pytest.mark.slow)
+
+
+def assert_serving_drained(eng):
+    """Shared post-drain pool invariant for the serving suites: zero
+    live refs — every usable page is either free or parked reclaimable
+    (refcount 0) in the prefix cache — and the REF-LEAK/PAGE-LEAK
+    conservation checks pass.  Lives here so the three serving test
+    files assert ONE definition of "nothing leaked"."""
+    assert eng.pool.total_refs == 0
+    assert eng.pool.num_free + eng.pool.num_reclaimable == \
+        eng.pool.num_usable
+    eng.check_page_conservation()

@@ -27,6 +27,8 @@ from paddle_tpu.serving import (DecoderLM, PagePool, PagedKVConfig, Request,
 from paddle_tpu.serving.decode_attention import _paged_decode_pallas
 from paddle_tpu.topology import LayerOutput, ParamSpec
 
+from conftest import assert_serving_drained as assert_drained  # noqa: E402
+
 serving = pytest.mark.serving
 
 
@@ -217,8 +219,8 @@ def test_engine_parity_vs_nonpaged_oracle(rng):
     for p, rid in zip(prompts, rids):
         assert res[rid] == greedy_decode_reference(model, params, p, 10, 1)
     assert streamed["toks"] == res[rids[0]]
-    # invariant: every page returned after completion
-    assert eng.pool.num_free == eng.pool.num_usable
+    # invariant: every page back (free or cached-reclaimable), no refs
+    assert_drained(eng)
     snap = eng.metrics.snapshot()
     assert snap["requests_completed"] == len(prompts) + 1
     assert snap["tokens_generated"] >= len(prompts) + 1
@@ -251,7 +253,7 @@ def test_engine_preemption_recovers_and_frees_pages(rng):
     for p, rid in zip(prompts, rids):
         assert res[rid] == greedy_decode_reference(model, params, p, 12, 1)
     assert eng.metrics.preemptions > 0          # the pool actually thrashed
-    assert eng.pool.num_free == eng.pool.num_usable   # nothing leaked
+    assert_drained(eng)                         # nothing leaked
 
 
 # ---------------------------------------------------------------------------

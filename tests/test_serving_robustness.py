@@ -20,6 +20,8 @@ from paddle_tpu.serving import (ContinuousBatchingScheduler, DecoderLM,
                                 SchedulerConfig, ServingEngine,
                                 greedy_decode_reference)
 
+from conftest import assert_serving_drained as assert_drained  # noqa: E402
+
 serving = pytest.mark.serving
 faults = pytest.mark.faults
 
@@ -71,7 +73,7 @@ def test_queue_deadline_times_out_waiting_request(rng):
     assert eng.status(b) is RequestStatus.TIMED_OUT
     assert eng.result(b) is None and a in res and b not in res
     assert eng.metrics.timed_out == 1
-    assert eng.pool.num_free == eng.pool.num_usable
+    assert_drained(eng)
 
 
 def test_total_deadline_times_out_running_request_and_frees_pages(rng):
@@ -86,7 +88,7 @@ def test_total_deadline_times_out_running_request_and_frees_pages(rng):
     eng.step()                      # clock 3.0 >= deadline: timed out
     assert eng.status(rid) is RequestStatus.TIMED_OUT
     # the slot and pages came back IMMEDIATELY, not at drain
-    assert eng.pool.num_free == eng.pool.num_usable
+    assert_drained(eng)
     assert not eng.has_work
     assert eng.metrics.timed_out == 1
     eng.check_page_conservation()
@@ -190,7 +192,7 @@ def test_cancel_running_and_queued(rng):
     assert eng.status(b) is RequestStatus.QUEUED
     assert eng.cancel(b)            # queued: leaves the queue
     assert eng.cancel(a)            # running: slot + pages freed now
-    assert eng.pool.num_free == eng.pool.num_usable
+    assert_drained(eng)
     assert not eng.cancel(a)        # already terminal
     assert eng.status(a) is RequestStatus.CANCELLED
     assert eng.status(b) is RequestStatus.CANCELLED
@@ -219,7 +221,7 @@ def test_cancel_from_own_on_token_wins_over_completion(rng):
     assert eng.status(box["rid"]) is RequestStatus.CANCELLED
     assert box["rid"] not in res and eng.result(box["rid"]) is None
     assert eng.metrics.cancelled == 1 and eng.metrics.completed == 0
-    assert eng.pool.num_free == eng.pool.num_usable
+    assert_drained(eng)
     eng.check_page_conservation()
 
 
@@ -277,7 +279,7 @@ def test_nan_guard_fails_only_poisoned_slot_batchmates_keep_parity(rng):
     # the fused batchmate decoded through the poisoned tick untouched
     assert res[ok] == greedy_decode_reference(model, params, p_ok, 8, 1)
     assert eng.metrics.failed == 1
-    assert eng.pool.num_free == eng.pool.num_usable
+    assert_drained(eng)
 
 
 def test_transient_error_set_is_configurable(rng):
@@ -336,7 +338,7 @@ def test_persistent_decode_errors_trip_watchdog(rng):
     assert eng.metrics.failed == 1
     assert eng.metrics.retries > 0          # it did try before giving up
     assert not eng.has_work
-    assert eng.pool.num_free == eng.pool.num_usable
+    assert_drained(eng)
 
 
 def test_page_pressure_forces_preemption_but_everyone_finishes(rng):
@@ -360,7 +362,7 @@ def test_page_pressure_forces_preemption_but_everyone_finishes(rng):
     assert eng.metrics.preemptions > 0      # the pool really thrashed
     assert pressure_seen > 0                # the pressure window engaged
     assert plan.held_pages == []            # pressure pages returned
-    assert eng.pool.num_free == eng.pool.num_usable
+    assert_drained(eng)
 
 
 def test_page_pressure_engages_late_when_pool_busy_at_window_start():
@@ -448,26 +450,42 @@ def test_escalated_request_requeues_ahead_and_grower_self_preempts():
 
 
 def test_page_pool_conservation_randomized_stress():
+    # round 9: the scheduler runs WITH a prefix cache, prompts draw from
+    # a tiny alphabet so hits/stitching/COW actually occur, and two new
+    # ops exercise cache insertion and LRU eviction.  Every op asserts
+    # refcount conservation AND free-list/set agreement.
+    from paddle_tpu.serving import PrefixCache
+
     rng = np.random.RandomState(7)
     pool = PagePool(17)   # 16 usable
     cfg = SchedulerConfig(max_slots=4, page_size=4, max_pages_per_seq=4,
                           max_queue=32, preempt_budget=3)
-    sched = ContinuousBatchingScheduler(pool, cfg)
+    cache = PrefixCache(pool, page_size=cfg.page_size)
+    sched = ContinuousBatchingScheduler(pool, cfg, cache=cache)
 
     def conserve():
         assert pool.num_free + pool.num_in_use == pool.num_usable
-        held = sum(len(r.pages) for r in sched.running.values())
-        held += sum(len(r.pages) for r in sched.queue)
-        assert held == pool.num_in_use, "orphaned pages"
+        # the double-free guard's set mirror never drifts from the list
+        assert set(pool._free) == pool._free_set
+        assert len(pool._free) == len(pool._free_set)
+        live = list(sched.running.values()) + list(sched.queue)
+        held = sum(len(r.pages) for r in live)
+        held += sum(1 for r in live if r.cow_src is not None)
+        assert held == pool.total_refs, "REF-LEAK: orphaned references"
 
     n_ops = 600
     for i in range(n_ops):
-        op = rng.randint(5)
-        if op == 0:       # submit (sometimes infeasible -> rejected)
+        op = rng.randint(7)
+        if op == 0:       # submit (sometimes infeasible -> rejected);
+            # 4-token alphabet, page-multiple lengths (prefix hits,
+            # full-cover COW stitches) MIXED with unaligned tails
+            # (partial last page never indexed, no COW) so both
+            # accounting paths stay exercised
+            size = 4 * rng.randint(1, 4) + rng.randint(0, 4)
             sched.submit(Request(
-                prompt=list(rng.randint(2, 50, size=rng.randint(1, 12))),
+                prompt=list(rng.randint(2, 6, size=size)),
                 max_tokens=int(rng.randint(1, 8))), now=float(i))
-        elif op == 1:     # admit
+        elif op == 1:     # admit (stitches cached prefixes, pins COW src)
             sched.admit()
         elif op == 2:     # grow a running request at a page boundary
             running = sched.running_requests()
@@ -486,13 +504,24 @@ def test_page_pool_conservation_randomized_stress():
                 sched.drop_queued(
                     sched.queue[rng.randint(len(sched.queue))],
                     RequestStatus.CANCELLED)
+        elif op == 5:     # a "prefill" indexes a request's full pages
+            running = sched.running_requests()
+            if running:
+                r = running[rng.randint(len(running))]
+                upto = min(len(r.prompt), len(r.pages) * cfg.page_size)
+                cache.insert(r.prompt, r.pages, upto)
+        elif op == 6:     # pressure: evict some reclaimable pages
+            cache.evict(int(rng.randint(1, 4)))
         conserve()
-    # drain everything: the free list must reassemble exactly
+    # drain everything: zero refs, free + reclaimable covers the pool
     for r in list(sched.running.values()):
         sched.release(r, RequestStatus.COMPLETED)
     while sched.queue:
         sched.drop_queued(sched.queue[0], RequestStatus.CANCELLED)
     conserve()
+    assert pool.total_refs == 0
+    assert pool.num_free + pool.num_reclaimable == pool.num_usable
+    cache.flush()
     assert pool.num_free == pool.num_usable
 
 
@@ -561,7 +590,7 @@ def test_every_terminal_status_reachable_in_one_run(rng):
         "timed_out": RequestStatus.TIMED_OUT,
         "cancelled": RequestStatus.CANCELLED,
     }
-    assert eng.pool.num_free == eng.pool.num_usable
+    assert_drained(eng)
     eng.check_page_conservation()
     snap = eng.metrics.snapshot()
     for key in ("requests_timed_out", "requests_cancelled",

@@ -19,4 +19,11 @@ if grep -aq 'PAGE-LEAK' /tmp/_t1.log; then
     echo 'PAGE-LEAK: serving free-list conservation violated (see log above)'
     exit 3
 fi
+# same contract for the refcount invariant: a page reference that no
+# running/queued request (or fault-plan pressure window) accounts for —
+# prefix sharing, COW forks, preemption-unref or eviction went unbalanced
+if grep -aq 'REF-LEAK' /tmp/_t1.log; then
+    echo 'REF-LEAK: serving page-refcount conservation violated (see log above)'
+    exit 4
+fi
 exit $rc
