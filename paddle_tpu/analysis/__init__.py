@@ -1,0 +1,33 @@
+"""paddle_tpu.analysis — static program verifier, jit retrace auditor,
+and repo-invariant linter.
+
+Three cooperating passes (the compile-first contract a TPU stack needs:
+"does this program compile once, and is it well-formed before it runs"):
+
+- :mod:`paddle_tpu.analysis.program_check` — abstract interpretation over
+  ``fluid.Program`` graphs (and the layer-DSL ``Topology``): def-before-use,
+  dangling fetches, dead variables, duplicate writers, shape/dtype
+  conflicts.  Runs standalone (``python -m paddle_tpu.analysis program
+  <script>``) and inline before ``Executor.run`` behind
+  ``FLAGS.fluid_verify``.
+- :mod:`paddle_tpu.analysis.retrace` — opt-in (``FLAGS.jit_audit``)
+  instrumentation around the repo's jit call sites that records
+  abstract-signature → compile events and flags any compile after a site
+  is sealed (or for an already-seen signature) as a ``RETRACE``
+  diagnostic.
+- :mod:`paddle_tpu.analysis.lint` — AST-based repo-invariant rules
+  (wall-clock in serving/master code, unseeded global RNG, host syncs in
+  per-tick serving loops, mutable default args, import-time FLAGS reads),
+  allowlistable via inline ``# lint: allow(<rule>)`` and runnable as
+  ``python -m paddle_tpu.analysis lint``.
+
+This ``__init__`` stays import-light on purpose: the serving engine and
+trainer import :func:`audit_jit` from here on their hot construction
+paths, so pulling in the whole fluid verifier here would tax every
+import of the package.
+"""
+
+from paddle_tpu.analysis.diagnostics import Diagnostic, Severity
+from paddle_tpu.analysis.retrace import audit_jit, auditor
+
+__all__ = ["Diagnostic", "Severity", "audit_jit", "auditor"]

@@ -1,0 +1,3 @@
+from paddle_tpu.analysis.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,111 @@
+"""``python -m paddle_tpu.analysis`` — the two analysis CLIs.
+
+``program <script.py> [--fetch NAME ...] [--feed NAME ...] [--strict]``
+    Execute the script (a fluid graph-building file) under a fresh
+    default program and verify every ``fluid.Program`` it leaves behind:
+    the default program plus any Program bound to a module-level name.
+    Exit 1 when any ERROR diagnostic fires (``--strict``: any finding).
+
+``lint [paths...] [--rule NAME ...]``
+    Run the repo-invariant linter (default: the whole ``paddle_tpu``
+    package).  Findings print one per line; a nonzero count ends with a
+    ``LINT-FAIL`` tagged line and exit 1 — ``tools_tier1.sh`` greps the
+    tag and turns it into exit code 5.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+
+def cmd_program(args) -> int:
+    import runpy
+
+    from paddle_tpu.analysis.diagnostics import Severity, format_report
+    from paddle_tpu.analysis.program_check import verify_program
+    from paddle_tpu.fluid.framework import (Program, default_main_program,
+                                            reset_default_program)
+
+    reset_default_program()
+    mod = runpy.run_path(args.script)
+    programs = {"<default program>": default_main_program()}
+    for name, val in mod.items():
+        if isinstance(val, Program):
+            programs[name] = val
+    # an untouched default program is noise when the script builds its
+    # own Programs explicitly
+    if len(programs) > 1 and not default_main_program().global_block().ops:
+        programs.pop("<default program>")
+
+    worst = 0
+    for name, prog in programs.items():
+        # --fetch/--feed describe ONE run contract; applying them to
+        # every module-level Program would fabricate dangling-fetch
+        # errors on programs (pruned test graphs, sub-builds) they never
+        # belonged to — so they bind to the default program only, unless
+        # the script builds exactly one Program
+        scoped = name == "<default program>" or len(programs) == 1
+        fetch = (args.fetch or None) if scoped else None
+        feed = (args.feed or None) if scoped else None
+        diags = verify_program(prog, fetch_names=fetch, feed_names=feed)
+        print(format_report(
+            diags, title=f"== {args.script} :: {name} "
+                         f"({len(prog.global_block().ops)} ops)"))
+        errs = [d for d in diags if d.severity is Severity.ERROR]
+        if errs or (args.strict and diags):
+            worst = 1
+    return worst
+
+
+def cmd_lint(args) -> int:
+    from paddle_tpu.analysis.lint import RULES, run_lint
+
+    unknown = [r for r in (args.rule or []) if r not in RULES]
+    if unknown:
+        print(f"unknown rule(s) {unknown}; known: {sorted(RULES)}",
+              file=sys.stderr)
+        return 2
+    findings = run_lint(paths=args.paths or None, rules=args.rule or None)
+    for d in findings:
+        print(f"{d.message}  [{d.code}]")
+    if findings:
+        print(f"LINT-FAIL: {len(findings)} finding(s) — fix, or annotate "
+              "a justified exception with `# lint: allow(<rule>)`")
+        return 1
+    print("lint ok: 0 findings")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="static program verifier + repo-invariant linter")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("program",
+                       help="verify fluid Programs built by a script")
+    p.add_argument("script", help="python file that builds the program(s)")
+    p.add_argument("--fetch", action="append", default=[],
+                   help="fetch target name (enables dangling-fetch and "
+                        "dead-var checks); repeatable.  Binds to the "
+                        "default program (or the script's single "
+                        "Program) — other module-level Programs get the "
+                        "structural checks only")
+    p.add_argument("--feed", action="append", default=[],
+                   help="feed name the run will provide; repeatable "
+                        "(same scoping as --fetch)")
+    p.add_argument("--strict", action="store_true",
+                   help="exit 1 on ANY diagnostic, not just ERRORs")
+    p.set_defaults(fn=cmd_program)
+
+    p = sub.add_parser("lint", help="run the repo-invariant linter")
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to lint (default: paddle_tpu/)")
+    p.add_argument("--rule", action="append", default=[],
+                   help="restrict to the named rule(s); repeatable")
+    p.set_defaults(fn=cmd_lint)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)

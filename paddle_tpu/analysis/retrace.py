@@ -1,0 +1,247 @@
+"""Jit retrace auditor: catch silent XLA recompilation on hot paths.
+
+A TPU serving/training step that quietly retraces erases the wins the
+fused step bought — the failure is invisible (everything still returns
+the right numbers) and shows up only as mystery latency.  This module
+wraps the repo's jit call sites (:func:`audit_jit` replaces a bare
+``jax.jit``) and records, per named *site*:
+
+- every **call** with its abstract signature (shape/dtype/weak-type of
+  each array leaf; python scalars by type+value, since jax specializes
+  on them via weak types or static closure);
+- every **compile** — detected exactly, by counting executions of the
+  wrapped python body, which jax only runs when tracing.
+
+Two things are flagged as ``RETRACE`` diagnostics:
+
+- a compile for a signature this site has ALREADY compiled (the classic
+  silent retrace: weak-type flips, a dropped compilation cache, a new
+  wrapper identity for the same computation);
+- any compile after the site was **sealed** (``auditor().seal()`` after
+  warmup): steady state must not compile at all.
+
+The whole thing is gated on ``FLAGS.jit_audit`` *at wrap time*: with the
+flag off (the default) ``audit_jit`` returns a bare ``jax.jit`` and
+costs nothing.  Turn the flag on BEFORE constructing the engine/trainer
+whose sites you want audited.
+
+Budget assertions for tests::
+
+    FLAGS.jit_audit = True
+    eng = ServingEngine(...)
+    ... run warmup traffic ...
+    auditor().seal()                      # steady state begins
+    ... run steady-state traffic ...
+    auditor().assert_budget("serving.decode", 1)   # one compile, ever
+    auditor().assert_no_retraces()
+
+Assertion failures carry the literal token ``RETRACE`` so CI wrappers
+can grep for it, same as the PAGE-LEAK / REF-LEAK contracts.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from paddle_tpu.analysis.diagnostics import Diagnostic, Severity
+
+__all__ = ["audit_jit", "auditor", "RetraceAuditor", "RetraceError",
+           "abstract_signature"]
+
+
+class RetraceError(AssertionError):
+    """A compile-budget or no-retrace assertion failed.  The message
+    always contains the literal token ``RETRACE``."""
+
+
+def abstract_signature(args: Tuple, kwargs: Dict) -> Tuple:
+    """Hashable abstract signature of a call: array leaves collapse to
+    (shape, dtype, weak_type); non-array leaves keep type+repr (they are
+    trace-time constants, so a changed value IS a changed program)."""
+    import jax
+
+    def leaf(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            weak = bool(getattr(x, "weak_type", False))
+            return ("arr", tuple(x.shape), str(x.dtype), weak)
+        return ("const", type(x).__name__, repr(x))
+
+    leaves, treedef = jax.tree.flatten((args, kwargs))
+    return (str(treedef),) + tuple(leaf(x) for x in leaves)
+
+
+@dataclass
+class SiteRecord:
+    """Per-site call/compile history."""
+
+    name: str
+    calls: int = 0
+    compiles: int = 0
+    sealed: bool = False
+    # signature -> number of compiles it triggered (>=2 means a retrace
+    # happened even without sealing)
+    compiled_sigs: Dict[Tuple, int] = field(default_factory=dict)
+    _pending_sig: Optional[Tuple] = None
+
+
+class RetraceAuditor:
+    """Registry of audited sites + the RETRACE diagnostics they raised.
+
+    Thread-safe enough for the repo's usage (sites are created at
+    construction time; counters mutate under one lock)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.sites: Dict[str, SiteRecord] = {}
+        self.diagnostics: List[Diagnostic] = []
+        self._sealed_all = False
+
+    # ---- bookkeeping (called by audit_jit wrappers) ----------------------
+
+    def site(self, name: str) -> SiteRecord:
+        with self._lock:
+            rec = self.sites.get(name)
+            if rec is None:
+                # a site first seen AFTER a global seal() is born sealed:
+                # "steady state must not compile" has to cover lazily
+                # created jits (per-bucket prefill/chunk wrappers) too
+                rec = self.sites[name] = SiteRecord(
+                    name, sealed=self._sealed_all)
+            return rec
+
+    def _on_call(self, rec: SiteRecord, sig: Tuple) -> None:
+        with self._lock:
+            rec.calls += 1
+            rec._pending_sig = sig
+
+    def _on_compile(self, rec: SiteRecord) -> None:
+        with self._lock:
+            rec.compiles += 1
+            sig = rec._pending_sig
+            seen = sig is not None and sig in rec.compiled_sigs
+            if sig is not None:
+                rec.compiled_sigs[sig] = rec.compiled_sigs.get(sig, 0) + 1
+            if rec.sealed:
+                self.diagnostics.append(Diagnostic(
+                    Severity.ERROR, "RETRACE",
+                    f"site {rec.name!r} compiled after seal "
+                    f"(compile #{rec.compiles}, call #{rec.calls})",
+                    vars=(rec.name,)))
+            elif seen:
+                self.diagnostics.append(Diagnostic(
+                    Severity.ERROR, "RETRACE",
+                    f"site {rec.name!r} recompiled an already-compiled "
+                    f"signature (compile #{rec.compiles}) — weak-type "
+                    "flip, dropped cache, or a fresh jit wrapper for the "
+                    "same computation", vars=(rec.name,)))
+
+    # ---- test / operator surface ----------------------------------------
+
+    def seal(self, name: Optional[str] = None) -> None:
+        """Declare warmup over: any later compile at ``name`` — or, when
+        None, at every site including ones first created AFTER the seal
+        (lazily built per-bucket jits) — is a RETRACE."""
+        with self._lock:
+            if name is not None:
+                rec = self.sites.get(name)
+                if rec is None:
+                    rec = self.sites[name] = SiteRecord(name)
+                rec.sealed = True
+                return
+            self._sealed_all = True
+            for rec in self.sites.values():
+                rec.sealed = True
+
+    def compile_count(self, name: str) -> int:
+        rec = self.sites.get(name)
+        return rec.compiles if rec is not None else 0
+
+    def call_count(self, name: str) -> int:
+        rec = self.sites.get(name)
+        return rec.calls if rec is not None else 0
+
+    def assert_budget(self, name: str, max_compiles: int) -> None:
+        """Raise :class:`RetraceError` if ``name`` compiled more than
+        ``max_compiles`` times (a site that never ran counts 0)."""
+        got = self.compile_count(name)
+        if got > max_compiles:
+            raise RetraceError(
+                f"RETRACE: site {name!r} compiled {got} times, budget "
+                f"{max_compiles} ({self.call_count(name)} calls)")
+
+    def assert_no_retraces(self) -> None:
+        retraces = [d for d in self.diagnostics if d.code == "RETRACE"]
+        if retraces:
+            raise RetraceError(
+                "RETRACE: " + "; ".join(d.message for d in retraces))
+
+    def reset(self) -> None:
+        """Zero every counter and unseal.  Records are reset IN PLACE —
+        live ``audit_jit`` wrappers hold references to their SiteRecord,
+        so replacing the dict would orphan them and every later count
+        would silently read 0 while the wrappers kept incrementing the
+        discarded records."""
+        with self._lock:
+            self._sealed_all = False
+            for rec in self.sites.values():
+                rec.calls = 0
+                rec.compiles = 0
+                rec.sealed = False
+                rec.compiled_sigs.clear()
+                rec._pending_sig = None
+            self.diagnostics.clear()
+
+    def snapshot(self) -> Dict[str, Dict[str, int]]:
+        """{site: {calls, compiles, distinct_signatures}} — one dict an
+        operator can dump next to serving metrics."""
+        with self._lock:
+            return {
+                name: {"calls": rec.calls, "compiles": rec.compiles,
+                       "distinct_signatures": len(rec.compiled_sigs),
+                       "sealed": int(rec.sealed)}
+                for name, rec in self.sites.items()}
+
+
+_AUDITOR = RetraceAuditor()
+
+
+def auditor() -> RetraceAuditor:
+    """The process-global auditor all ``audit_jit`` sites report to."""
+    return _AUDITOR
+
+
+def audit_jit(fn, *, site: str, **jit_kwargs):
+    """``jax.jit`` with retrace accounting under ``FLAGS.jit_audit``.
+
+    With the flag off this IS ``jax.jit(fn, **jit_kwargs)`` — zero
+    overhead, zero behavior change.  With it on, every call records its
+    abstract signature and every actual trace of ``fn`` counts as a
+    compile at ``site`` (jax only executes the python body when
+    tracing, so the count is exact, not inferred from signatures).
+    """
+    import jax
+
+    from paddle_tpu.platform.flags import FLAGS
+
+    if not getattr(FLAGS, "jit_audit", False):
+        return jax.jit(fn, **jit_kwargs)
+
+    rec = _AUDITOR.site(site)
+
+    @functools.wraps(fn)
+    def traced(*args, **kwargs):
+        _AUDITOR._on_compile(rec)
+        return fn(*args, **kwargs)
+
+    jitted = jax.jit(traced, **jit_kwargs)
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        _AUDITOR._on_call(rec, abstract_signature(args, kwargs))
+        return jitted(*args, **kwargs)
+
+    wrapper._audit_site = site
+    return wrapper

@@ -146,6 +146,11 @@ class Executor:
                tuple(sorted((k, _abstract(v)) for k, v in feed.items())))
         fn = self._cache.get(key)
         if fn is None:
+            # any new feed/fetch-name combination is a cache miss, so
+            # validating (and statically verifying) only here still
+            # covers every first use while steady state pays nothing
+            self._validate_feed_fetch(program, feed, fetch_names)
+            self._static_verify(program, feed, fetch_names)
             fn = self._compile(program, fetch_names, is_test, persist_names)
             self._cache[key] = fn
 
@@ -158,6 +163,62 @@ class Executor:
             fetches = [np.asarray(f.data) if isinstance(f, LoDArray)
                        else np.asarray(f) for f in fetches]
         return fetches
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _validate_feed_fetch(program: Program, feed: Dict,
+                             fetch_names: Sequence[str]) -> None:
+        """Up-front feed/fetch validation: one clear diagnostic-style
+        error naming every bad name at once, instead of a bare KeyError
+        from deep inside the jit trace (fetch) or a silently-ignored
+        feed (the old behavior for a mistyped feed name).  The validity
+        definition itself lives in ONE place —
+        ``analysis.program_check.feed_fetch_problems`` — shared with
+        the verifier and the CLI (lazy import, like _static_verify)."""
+        from paddle_tpu.analysis.program_check import feed_fetch_problems
+
+        problems = feed_fetch_problems(program, tuple(feed),
+                                       tuple(fetch_names))
+        gb = program.global_block()
+        enforce_that(not problems,
+                     "invalid feed/fetch for this program:\n  "
+                     + "\n  ".join(msg for _, msg in problems)
+                     + f"\n(program has {len(gb.ops)} ops)",
+                     context="fluid")
+
+    def _static_verify(self, program: Program, feed: Dict,
+                       fetch_names: Sequence[str]) -> None:
+        """Static verification gate (FLAGS.fluid_verify): 'warn' logs
+        the verifier's findings, 'strict' raises on ERRORs, 'off'
+        skips.  Import is lazy so fluid does not depend on the analysis
+        package at import time."""
+        from paddle_tpu.platform.flags import FLAGS
+
+        mode = str(getattr(FLAGS, "fluid_verify", "off")).lower()
+        if mode in ("off", "0", "false", ""):
+            return
+        from paddle_tpu.analysis.diagnostics import Severity, format_report
+        from paddle_tpu.analysis.program_check import verify_program
+
+        # fetch_names=None on purpose: a per-run fetch list is NOT the
+        # program's full sink set (another run may fetch the metric ops
+        # this one skips), so inline dead-var analysis would cry wolf —
+        # it stays a CLI concern where the fetch list is the user's
+        # declared contract.  Dangling fetches are already rejected by
+        # _validate_feed_fetch above.
+        diags = verify_program(program, fetch_names=None,
+                               feed_names=list(feed))
+        if not diags:
+            return
+        errs = [d for d in diags if d.severity is Severity.ERROR]
+        report = format_report(diags, title="fluid_verify:")
+        if errs and mode == "strict":
+            raise EnforceError(
+                f"program verification failed ({len(errs)} error(s)):\n"
+                + report, context="fluid")
+        from paddle_tpu.platform import plog
+
+        plog.warning("%s", report)
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -265,7 +326,9 @@ class Executor:
             updates = {n: values[n] for n in written_persist}
             return fetches, updates
 
-        return jax.jit(run_program)
+        from paddle_tpu.analysis.retrace import audit_jit
+
+        return audit_jit(run_program, site="fluid.executor")
 
     # ------------------------------------------------------------------
     @staticmethod

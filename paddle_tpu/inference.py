@@ -32,7 +32,9 @@ class Inference:
                 if ns in model_state:
                     init[ns] = {**init[ns], **model_state[ns]}
         self.model_state = init
-        self._fn = jax.jit(self._forward)
+        from paddle_tpu.analysis.retrace import audit_jit
+
+        self._fn = audit_jit(self._forward, site="inference.forward")
 
     def _forward(self, params, state, feeds):
         outs, _ = self.topology.forward(params, state, feeds, train=False)

@@ -29,6 +29,7 @@ changes, so sharding it would buy nothing and cost a per-step gather).
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 from typing import Any, Dict, Optional, Tuple
 
@@ -124,8 +125,8 @@ class ZeroPlan:
         import jax
 
         if isinstance(v, jax.Array) and not v.is_fully_addressable:
-            v = jax.jit(lambda x: x,
-                        out_shardings=self.replicated_sharding())(v)
+            v = _identity_jit(self.replicated_sharding(),
+                              "zero.replicate")(v)
             return np.asarray(v.addressable_data(0))
         return np.asarray(v)
 
@@ -250,6 +251,16 @@ def opt_state_bytes_per_device(tree) -> int:
     return total
 
 
+@functools.lru_cache(maxsize=None)
+def _identity_jit(sharding, site: str):
+    """One compiled identity per (sharding, site) — per-call wrappers
+    would re-trace an identical signature every call (a real retrace the
+    audit sites would rightly flag)."""
+    from paddle_tpu.analysis.retrace import audit_jit
+
+    return audit_jit(lambda a: a, site=site, out_shardings=sharding)
+
+
 def _constrain(x, sharding):
     """Sharding constraint that works both under trace (the in-step
     reduce-scatter / all-gather) and eagerly (placement — multi-process
@@ -261,7 +272,7 @@ def _constrain(x, sharding):
     if isinstance(x, jax.Array) and not x.is_fully_addressable:
         # already-committed global array (multi-host init): reshard with a
         # compiled identity — put_global's host round trip can't read it
-        return jax.jit(lambda a: a, out_shardings=sharding)(x)
+        return _identity_jit(sharding, "zero.reshard")(x)
     return _put_global(x, sharding)
 
 

@@ -178,6 +178,24 @@ FLAGS.define("serving_watchdog_ticks", 16,
              "token for this many engine ticks (persistent device "
              "errors, stuck slot) is FAILED and its pages freed, keeping "
              "the rest of the fused batch alive. 0 disables.", parser=int)
+FLAGS.define("fluid_verify", "warn",
+             "static program verification before Executor.run compiles "
+             "a fluid Program: 'warn' (default) logs every diagnostic "
+             "the paddle_tpu.analysis verifier finds, 'strict' raises "
+             "on ERROR diagnostics (shape/dtype conflicts, "
+             "def-before-use, dangling fetches, duplicate writers), "
+             "'off' disables.  Runs once per compiled (program, "
+             "feed-shape) specialization, so steady state pays nothing.")
+FLAGS.define("jit_audit", False,
+             "retrace auditing: when on, audit_jit-instrumented call "
+             "sites (serving decode/prefill, trainer steps, inference, "
+             "ZeRO placement, fluid executor) record abstract-signature "
+             "-> compile events in paddle_tpu.analysis.retrace.auditor() "
+             "and flag compiles after seal() — or recompiles of an "
+             "already-compiled signature — as RETRACE diagnostics.  "
+             "Checked at wrap time: set it BEFORE constructing the "
+             "engine/trainer being audited.  Off = bare jax.jit, zero "
+             "overhead.")
 FLAGS.define("save_dir", "./output", "default checkpoint output directory")
 FLAGS.define("log_level", "INFO", "logging level")
 FLAGS.define("prealloc_mem", False, "let XLA preallocate the whole HBM arena")

@@ -72,6 +72,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from paddle_tpu.analysis.retrace import audit_jit
 from paddle_tpu.ops.attention import (DEFAULT_MASK_VALUE, flash_attention,
                                       mha_reference)
 from paddle_tpu.platform.flags import FLAGS
@@ -185,14 +186,16 @@ def greedy_decode_reference(model: DecodeModel, params, prompt: List[int],
     tokens = list(prompt)
     out: List[int] = []
     for _ in range(max_tokens):
-        t = jnp.asarray(tokens, jnp.int32)[None]          # [1, T]
+        # per-step host syncs are the POINT of this oracle: it trades
+        # throughput for an unarguable reference trajectory
+        t = jnp.asarray(tokens, jnp.int32)[None]   # lint: allow(host-sync)
         pos = jnp.arange(len(tokens), dtype=jnp.int32)[None]
         x = model.embed(params, t, pos)
         for l in range(model.num_layers):
             q, k, v = model.qkv(params, l, x)
             ctx = mha_reference(q, k, v, causal=True)
             x = model.attn_out(params, l, ctx, x)
-        nxt = int(jnp.argmax(model.logits(params, x[0, -1])))
+        nxt = int(jnp.argmax(model.logits(params, x[0, -1])))  # lint: allow(host-sync)
         out.append(nxt)
         tokens.append(nxt)
         if nxt == eos_id:
@@ -283,7 +286,7 @@ class ServingEngine:
                 max_queue=max_queue,
                 preempt_budget=preempt_budget if preempt_budget > 0
                 else None),
-            cache=self.cache)
+            cache=self.cache, time_fn=self._time)
         self.metrics = ServingMetrics(pool_pages=self.pool.num_usable)
         self._use_kernel = use_kernel
         self._buckets = tuple(sorted(int(b) for b in buckets)) if buckets \
@@ -295,14 +298,21 @@ class ServingEngine:
         # HBM doubles the documented cost.  CPU doesn't support donation
         # (it would just warn), hence the gate.
         self._donate_kv = (1,) if jax.default_backend() != "cpu" else ()
-        self._decode_fn = jax.jit(self._build_decode_fn(),
-                                  donate_argnums=self._donate_kv)
+        # audit_jit == jax.jit unless FLAGS.jit_audit is on, in which
+        # case each named site's compiles are counted by the retrace
+        # auditor (paddle_tpu.analysis.retrace): the fused decode step
+        # must compile exactly once, prefill once per bucket shape
+        self._decode_fn = audit_jit(self._build_decode_fn(),
+                                    site="serving.decode",
+                                    donate_argnums=self._donate_kv)
         # COW fork + failure scrub: kv is argument 0 in both (same
         # donation gate as above)
-        self._fork_fn = jax.jit(
-            fork_page, donate_argnums=(0,) if self._donate_kv else ())
-        self._zero_fn = jax.jit(
-            zero_pages, donate_argnums=(0,) if self._donate_kv else ())
+        self._fork_fn = audit_jit(
+            fork_page, site="serving.fork_page",
+            donate_argnums=(0,) if self._donate_kv else ())
+        self._zero_fn = audit_jit(
+            zero_pages, site="serving.zero_pages",
+            donate_argnums=(0,) if self._donate_kv else ())
         self._prefill_fns: Dict[int, Callable] = {}
         self._chunk_fns: Dict[int, Callable] = {}
         self._results: Dict[int, List[int]] = {}
@@ -377,7 +387,8 @@ class ServingEngine:
             last = jnp.take(x[0], jnp.maximum(n - 1, 0), axis=0)
             return model.logits(params, last), kv
 
-        fn = jax.jit(raw, donate_argnums=self._donate_kv)
+        fn = audit_jit(raw, site="serving.prefill",
+                       donate_argnums=self._donate_kv)
         self._prefill_fns[bucket] = fn
         return fn
 
@@ -435,7 +446,8 @@ class ServingEngine:
             last = jnp.take(x[0], jnp.maximum(n - 1, 0), axis=0)
             return model.logits(params, last), kv
 
-        fn = jax.jit(raw, donate_argnums=self._donate_kv)
+        fn = audit_jit(raw, site="serving.chunk_prefill",
+                       donate_argnums=self._donate_kv)
         self._chunk_fns[bucket] = fn
         return fn
 

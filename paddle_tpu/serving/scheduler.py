@@ -142,10 +142,14 @@ class ContinuousBatchingScheduler:
     cheap; device work happens in the engine between calls."""
 
     def __init__(self, pool: PagePool, cfg: SchedulerConfig,
-                 cache: Optional[PrefixCache] = None):
+                 cache: Optional[PrefixCache] = None,
+                 time_fn: Callable[[], float] = time.monotonic):
         self.pool = pool
         self.cfg = cfg
         self.cache = cache          # prefix cache; None = caching off
+        # injectable clock (engine passes its own — possibly a fault
+        # plan's ManualClock); only the submit(now=None) fallback reads it
+        self._time = time_fn
         self.queue: Deque[Request] = deque()
         self.running: Dict[int, Request] = {}       # slot -> request
         self._free_slots: List[int] = list(range(cfg.max_slots - 1, -1, -1))
@@ -161,7 +165,7 @@ class ContinuousBatchingScheduler:
         enforce_that(len(req.prompt) >= 1, "empty prompt", context="serving")
         enforce_that(req.max_tokens >= 1, "max_tokens must be >= 1",
                      context="serving")
-        req.submitted_at = time.monotonic() if now is None else now
+        req.submitted_at = self._time() if now is None else now
         total = len(req.prompt) + req.max_tokens
         if total > self.cfg.max_seq_len or \
                 self._pages_for(total) > self.pool.num_usable:

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu import event as v2_event
+from paddle_tpu.analysis.retrace import audit_jit
 from paddle_tpu.data_feeder import DataFeeder
 from paddle_tpu.optimizer import Optimizer
 from paddle_tpu.parameters import Parameters
@@ -165,7 +166,8 @@ class SGD:
         # With mesh-sharded (NamedSharding) inputs, jit partitions the whole
         # step SPMD automatically — XLA inserts the grad psum (the
         # MultiGradientMachine ring / pserver addGradient analog).
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return audit_jit(step, site="trainer.train_step",
+                         donate_argnums=(0, 1, 2))
 
     def _build_test(self):
         topo = self.topology
@@ -182,7 +184,7 @@ class SGD:
                            zip(metric_names, outs[n_costs:])}
             return total, metric_vals
 
-        return jax.jit(test_step)
+        return audit_jit(test_step, site="trainer.test_step")
 
     def _place_on_mesh(self, slots_too: bool = True) -> None:
         """(Re)commit params — and optimizer state mirroring them — to
@@ -743,7 +745,8 @@ class MultiTaskTrainer:
             new_params.update(new_sub)
             return loss, new_params, new_opt, new_mstate
 
-        return jax.jit(step, donate_argnums=(1,))
+        return audit_jit(step, site=f"trainer.task.{name}",
+                         donate_argnums=(1,))
 
     def step(self, name: str, feeds: Dict[str, Any]) -> float:
         """Run one optimization step of the named task; other tasks'

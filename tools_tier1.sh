@@ -26,4 +26,22 @@ if grep -aq 'REF-LEAK' /tmp/_t1.log; then
     echo 'REF-LEAK: serving page-refcount conservation violated (see log above)'
     exit 4
 fi
+# repo-invariant linter (paddle_tpu.analysis.lint): wall-clock in
+# serving/master, unseeded global RNG, per-tick host syncs, mutable
+# defaults, import-time FLAGS reads.  Findings print a LINT-FAIL tag;
+# exit 5 keeps the loud-failure ladder (PAGE-LEAK=3, REF-LEAK=4).
+# The linter's own exit status is checked too: a crash (import error,
+# unknown rule) must fail the gate loudly, not fall through as green.
+# branch on the linter's OWN exit status, not a grep of the shared log:
+# a failing pytest whose captured output happens to contain the literal
+# tag must not masquerade as a lint failure
+env JAX_PLATFORMS=cpu python -m paddle_tpu.analysis lint 2>&1 | tee -a /tmp/_t1.log
+lint_rc=${PIPESTATUS[0]}
+if [ "$lint_rc" -eq 1 ]; then
+    echo 'LINT-FAIL: repo-invariant lint findings (see log above)'
+    exit 5
+elif [ "$lint_rc" -ne 0 ]; then
+    echo "LINT-FAIL: linter itself exited $lint_rc without running to completion"
+    exit 5
+fi
 exit $rc
