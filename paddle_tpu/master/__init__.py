@@ -18,7 +18,7 @@ trainer reads via the recordio library).
 """
 
 from .recordio import recordio_write, recordio_read_chunk, recordio_index
-from .service import Task, Service, MAX_TASK_FAILURES
+from .service import Task, Service, LeaseTable, MAX_TASK_FAILURES
 from .server import MasterServer
 from .client import MasterClient, MasterRetryExhausted
 
@@ -28,6 +28,7 @@ __all__ = [
     "recordio_index",
     "Task",
     "Service",
+    "LeaseTable",
     "MasterServer",
     "MasterClient",
     "MasterRetryExhausted",

@@ -24,6 +24,102 @@ from .recordio import recordio_index
 MAX_TASK_FAILURES = 3
 
 
+class LeaseTable:
+    """Slot + token TTL leases — the etcd lease-id analog, factored out
+    of :class:`Service` so the serving fleet's replica lifecycle
+    (``paddle_tpu/serving/fleet.py``) and the training master's trainer
+    membership run the SAME state machine.
+
+    Semantics (go/pserver/etcd_client.go:67-166):
+
+    - ``register`` claims the smallest free slot and mints a fresh
+      token; slots are REUSED after expiry, so the token is what makes
+      an owner unique across reclamations;
+    - ``heartbeat`` renews only when the presented token matches the
+      slot's CURRENT token AND the lease is still live — a zombie
+      renewing by slot number alone (its lease lapsed, possibly
+      reclaimed by a new owner) gets False and must re-register.  The
+      deadline is re-checked directly in ``heartbeat`` (not only via the
+      ``expire`` sweep), so a renewal racing slot reclamation can never
+      resurrect an expired lease;
+    - ``expire`` sweeps lapsed leases and returns the freed slots so the
+      owner (task queue, fleet router) can requeue that member's
+      in-flight work.  ``register``/``heartbeat``/``members`` sweep
+      internally too, and those calls discard the return value — an
+      owner that must never miss a freed slot (the master requeues the
+      dead trainer's tasks) passes ``on_expire``, which fires for every
+      freed slot on EVERY sweep, internal ones included.
+
+    Not thread-safe by itself: :class:`Service` calls it under its own
+    lock; the serving fleet is single-threaded on the engine tick loop.
+    """
+
+    def __init__(self, ttl_s: float, time_fn=time.time, on_expire=None):
+        self.ttl_s = float(ttl_s)
+        self._time = time_fn
+        self._on_expire = on_expire
+        # slot -> (lease deadline, lease token)
+        self._members: Dict[int, Tuple[float, str]] = {}
+
+    def register(self, ttl_s: Optional[float] = None) -> Tuple[int, str]:
+        import secrets
+
+        self.expire()
+        slot = 0
+        while slot in self._members:
+            slot += 1
+        token = secrets.token_hex(8)
+        self._members[slot] = (self._time() + float(ttl_s or self.ttl_s),
+                               token)
+        return slot, token
+
+    def heartbeat(self, slot: int, token: str,
+                  ttl_s: Optional[float] = None) -> bool:
+        """Renew a lease.  False = the lease is gone: expired, or the
+        slot was reclaimed by a new owner whose token doesn't match."""
+        self.expire()
+        now = self._time()
+        ent = self._members.get(slot)
+        if ent is None or ent[1] != token or ent[0] <= now:
+            return False
+        self._members[slot] = (now + float(ttl_s or self.ttl_s), token)
+        return True
+
+    def alive(self, slot: int, token: str) -> bool:
+        """Liveness probe without renewal (the fleet's per-tick death
+        sweep reads this; only heartbeats extend the deadline)."""
+        self.expire()
+        ent = self._members.get(slot)
+        return ent is not None and ent[1] == token
+
+    def drop(self, slot: int, token: str) -> bool:
+        """Explicitly release a lease (clean drain / fleet fencing of a
+        killed replica).  Token-checked like heartbeat, so a zombie
+        can't evict the slot's new owner."""
+        ent = self._members.get(slot)
+        if ent is None or ent[1] != token:
+            return False
+        del self._members[slot]
+        return True
+
+    def members(self) -> List[int]:
+        self.expire()
+        return sorted(self._members)
+
+    def expire(self) -> List[int]:
+        """Sweep lapsed leases; returns the slots freed this call (and
+        reports each to ``on_expire`` after the table is consistent, so
+        the hook can re-register without racing the sweep)."""
+        now = self._time()
+        dead = [s for s, (dl, _) in self._members.items() if dl <= now]
+        for slot in dead:
+            del self._members[slot]
+        if self._on_expire is not None:
+            for slot in dead:
+                self._on_expire(slot)
+        return dead
+
+
 @dataclass
 class Chunk:
     path: str
@@ -72,11 +168,12 @@ class Service:
         # slot under a TTL lease; a missed heartbeat frees the slot and
         # requeues the trainer's in-flight tasks)
         self.lease_ttl_s = 3 * self.timeout_s if self.timeout_s else 180.0
-        # slot -> (lease deadline, lease token). The token is the etcd
-        # lease-id analog: slots are REUSED after expiry, so a zombie
-        # trainer renewing by slot number alone could hijack the slot's
-        # new owner — heartbeats must present the token they registered with
-        self._members: Dict[int, Tuple[float, str]] = {}
+        # the etcd Register/lease analog, shared with the serving fleet:
+        # slots are REUSED after expiry, so a zombie trainer renewing by
+        # slot number alone could hijack the slot's new owner —
+        # heartbeats must present the token they registered with
+        self._leases = LeaseTable(self.lease_ttl_s, time_fn=time_fn,
+                                  on_expire=self._requeue_dead_member)
         # task id -> owner slot (for prompt requeue on lease expiry)
         self._owners: Dict[int, Optional[int]] = {}
 
@@ -123,56 +220,48 @@ class Service:
         (slot, lease_token); heartbeats must present both. Re-registering
         after a crash gets a fresh slot+token; the dead slot's lease
         expires on its own and its tasks requeue."""
-        import secrets
-
         with self._lock:
-            self._expire_members()
-            slot = 0
-            while slot in self._members:
-                slot += 1
-            token = secrets.token_hex(8)
-            self._members[slot] = (self._time() + float(
-                ttl_s or self.lease_ttl_s), token)
-            return slot, token
+            # LeaseTable.register sweeps internally; the on_expire hook
+            # requeues any freed member's tasks, so no extra sweep here
+            return self._leases.register(ttl_s)
 
     def heartbeat(self, slot: int, token: str,
                   ttl_s: Optional[float] = None) -> bool:
         """Renew a lease. False = this trainer's lease is gone (expired, or
-        the slot was reclaimed by a new owner) — it was declared dead and
-        must re-register and resume from checkpoint."""
+        the slot was reclaimed by a new owner — the token mismatch rejects
+        the zombie even when the slot number is live again) — it was
+        declared dead and must re-register and resume from checkpoint."""
         with self._lock:
-            self._expire_members()
-            ent = self._members.get(slot)
-            if ent is None or ent[1] != token:
-                return False
-            self._members[slot] = (self._time() + float(
-                ttl_s or self.lease_ttl_s), token)
-            return True
+            return self._leases.heartbeat(slot, token, ttl_s)
 
     def members(self) -> List[int]:
         with self._lock:
-            self._expire_members()
-            return sorted(self._members)
+            return self._leases.members()
 
     def _expire_members(self) -> None:
-        now = self._time()
-        dead = [s for s, (dl, _) in self._members.items() if dl <= now]
-        for slot in dead:
-            del self._members[slot]
-            # a dead trainer's tasks go back to the FRONT of todo: the
-            # pass re-runs them promptly, preserving task order for the
-            # surviving trainers (crash-resume determinism)
-            held = [tid for tid, owner in self._owners.items()
-                    if owner == slot and tid in self._pending]
-            for tid in sorted(held, reverse=True):
-                task, _ = self._pending.pop(tid)
-                task.num_failures += 1
-                if task.num_failures >= self.max_failures:
-                    self._done.append(task)
-                    self._maybe_new_pass()
-                else:
-                    self._todo.insert(0, task)
-        if dead:
+        self._leases.expire()
+
+    def _requeue_dead_member(self, slot: int) -> None:
+        """on_expire hook: runs for every freed slot on EVERY lease
+        sweep — including the ones LeaseTable does internally inside
+        register/heartbeat/members, so a lease that lapses between our
+        own sweep and the inner one still requeues promptly instead of
+        waiting for the slow per-task timeout path.  Always called
+        under self._lock (every LeaseTable call site holds it)."""
+        # a dead trainer's tasks go back to the FRONT of todo: the
+        # pass re-runs them promptly, preserving task order for the
+        # surviving trainers (crash-resume determinism)
+        held = [tid for tid, owner in self._owners.items()
+                if owner == slot and tid in self._pending]
+        for tid in sorted(held, reverse=True):
+            task, _ = self._pending.pop(tid)
+            task.num_failures += 1
+            if task.num_failures >= self.max_failures:
+                self._done.append(task)
+                self._maybe_new_pass()
+            else:
+                self._todo.insert(0, task)
+        if held:
             self._snapshot()
 
     # ---- task lifecycle ----------------------------------------------------

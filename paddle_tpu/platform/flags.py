@@ -178,6 +178,31 @@ FLAGS.define("serving_watchdog_ticks", 16,
              "token for this many engine ticks (persistent device "
              "errors, stuck slot) is FAILED and its pages freed, keeping "
              "the rest of the fused batch alive. 0 disables.", parser=int)
+FLAGS.define("serving_fleet_replicas", 4,
+             "default replica count for FleetRouter: N ServingEngine "
+             "replicas behind one prefix-affinity front-door. Traffic "
+             "routes by chained prompt-block hash (the PrefixCache key "
+             "chain) with healthz-driven load balancing as tiebreak and "
+             "overflow; a dead replica's in-flight requests resubmit to "
+             "survivors.", parser=int)
+FLAGS.define("serving_fleet_heartbeat_s", 1.0,
+             "fleet replica lease scale on the fleet's (possibly "
+             "injected) clock: the lease TTL is 3x this. Leases renew "
+             "every fleet tick (renewal is a cheap host op; only a "
+             "heartbeat-partition fault blocks it), so a replica dies "
+             "when its renewals stop for the TTL — then its token is "
+             "dropped (a zombie can never ack after its slot is "
+             "reclaimed) and its in-flight requests resubmit. On a "
+             "wall clock set this above the worst-case single tick "
+             "(first-compile spikes), since a tick longer than the TTL "
+             "lapses every lease mid-tick.", parser=float)
+FLAGS.define("serving_fleet_resubmit_budget", 2,
+             "max death-driven resubmits per fleet request. A request "
+             "whose replica dies is resubmitted to a survivor with its "
+             "ORIGINAL absolute deadline at most this many times, then "
+             "FAILED — bounded recovery, never an infinite "
+             "kill->resubmit loop. 0 = fail on the first death.",
+             parser=int)
 FLAGS.define("fluid_verify", "warn",
              "static program verification before Executor.run compiles "
              "a fluid Program: 'warn' (default) logs every diagnostic "

@@ -11,13 +11,15 @@ from paddle_tpu.serving.decode_attention import (
     paged_decode_attention, paged_decode_attention_reference)
 from paddle_tpu.serving.engine import (DecodeModel, DecoderLM, ServingEngine,
                                        greedy_decode_reference)
-from paddle_tpu.serving.faults import (FaultPlan, InjectedDeviceError,
-                                       ManualClock, PageLeakError)
+from paddle_tpu.serving.faults import (FaultPlan, FleetFaultPlan,
+                                       InjectedDeviceError, ManualClock,
+                                       PageLeakError)
+from paddle_tpu.serving.fleet import FleetRouter, Replica, ReplicaState
 from paddle_tpu.serving.kv_cache import (NULL_PAGE, KVPages, PagedKVConfig,
                                          PagePool, PrefixCache, append_token,
                                          fork_page, gather_kv, init_kv_pages,
-                                         write_prompt)
-from paddle_tpu.serving.metrics import ServingMetrics
+                                         prefix_chain_hashes, write_prompt)
+from paddle_tpu.serving.metrics import FleetMetrics, ServingMetrics
 from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
                                           Request, RequestStatus,
                                           SchedulerConfig, bucket_for)
@@ -27,8 +29,10 @@ __all__ = [
     "paged_decode_attention", "paged_decode_attention_reference",
     "PagedKVConfig", "KVPages", "PagePool", "PrefixCache", "NULL_PAGE",
     "init_kv_pages", "append_token", "write_prompt", "gather_kv",
-    "fork_page",
+    "fork_page", "prefix_chain_hashes",
     "ContinuousBatchingScheduler", "Request", "RequestStatus",
-    "SchedulerConfig", "bucket_for", "ServingMetrics",
-    "FaultPlan", "ManualClock", "InjectedDeviceError", "PageLeakError",
+    "SchedulerConfig", "bucket_for", "ServingMetrics", "FleetMetrics",
+    "FaultPlan", "FleetFaultPlan", "ManualClock", "InjectedDeviceError",
+    "PageLeakError",
+    "FleetRouter", "Replica", "ReplicaState",
 ]

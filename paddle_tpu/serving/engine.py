@@ -324,6 +324,7 @@ class ServingEngine:
         self._last_tick_at: Optional[float] = None
         self._prev_tick_busy = False
         self._tick_dur_ema = 0.0      # drives the unmeetable-deadline shed
+        self._draining = False        # drain(): REJECT new submits
 
     # ---- compiled device functions --------------------------------------
 
@@ -482,7 +483,16 @@ class ServingEngine:
             req.deadline_at = t + float(deadline_s)
         # for BOTH per-request overrides, None = no deadline and an
         # explicit 0.0 is an already-spent budget (times out next tick)
-        ok = self.scheduler.submit(req, now=t)
+        if self._draining:
+            # drain mode: admission is closed.  The request is REJECTED
+            # up front — queued and running work keeps going, but no new
+            # demand enters (the fleet router reads this as "route
+            # elsewhere").
+            req.submitted_at = t
+            req.status = RequestStatus.REJECTED
+            ok = False
+        else:
+            ok = self.scheduler.submit(req, now=t)
         self.metrics.on_submit(t, ok)
         self._requests[req.rid] = req
         if not ok:
@@ -550,6 +560,18 @@ class ServingEngine:
         """Lifecycle status of ``rid``; raises KeyError for a rid this
         engine never issued."""
         return self._requests[rid].status
+
+    def drain(self, on: bool = True) -> None:
+        """Toggle drain mode: while draining, every new ``submit`` is
+        REJECTED immediately, but requests already queued or running
+        finish normally (admission from the existing queue continues —
+        the drain stops new DEMAND, not accepted work).  ``drain(False)``
+        reopens admission (a replica rejoining a fleet)."""
+        self._draining = bool(on)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
 
     @property
     def has_work(self) -> bool:
@@ -674,6 +696,18 @@ class ServingEngine:
                 f"cached={pool.num_cached} free={pool.num_free} "
                 f"usable={pool.num_usable}")
 
+    def load(self) -> Dict[str, object]:
+        """Cheap load probe: the same queue_depth / running /
+        free_pages numbers ``healthz`` reports, WITHOUT the
+        conservation scan healthz pays for its ``ok`` bit.  The fleet
+        router reads this once per candidate replica per submit, so it
+        must stay O(1); ``healthz`` remains the full diagnostic for
+        external probers."""
+        return {"queue_depth": self.scheduler.queue_depth,
+                "running": len(self.scheduler.running),
+                "free_pages": self.pool.num_free,
+                "draining": self._draining}
+
     def healthz(self) -> Dict[str, object]:
         """One-call liveness snapshot for an external prober.  O(live
         requests), not O(history): terminal counts come from the metrics
@@ -700,6 +734,12 @@ class ServingEngine:
             "tick": self._tick,
             "queue_depth": self.scheduler.queue_depth,
             "running": len(self.scheduler.running),
+            "draining": self._draining,
+            # first-class load signals for a fleet router's balancing /
+            # overflow decision (queue_depth above + free_pages here):
+            # admission headroom without reaching into pool internals.
+            # pages_free stays as the historical alias.
+            "free_pages": self.pool.num_free,
             "pages_free": self.pool.num_free,
             # in_use = live sequence holders; cached/reclaimable pages
             # are reported separately so a prober can assert the cache

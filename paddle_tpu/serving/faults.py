@@ -43,8 +43,8 @@ from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
-__all__ = ["FaultPlan", "ManualClock", "InjectedDeviceError",
-           "PageLeakError"]
+__all__ = ["FaultPlan", "FleetFaultPlan", "ManualClock",
+           "InjectedDeviceError", "PageLeakError"]
 
 
 class InjectedDeviceError(RuntimeError):
@@ -172,3 +172,70 @@ class FaultPlan:
         if start <= tick < end:
             return cache.flush()
         return 0
+
+
+@dataclass
+class FleetFaultPlan:
+    """Fleet-level injected failures (``FleetRouter(faults=...)``): the
+    per-engine :class:`FaultPlan` kills ticks and slots; this one kills
+    REPLICAS.  Same determinism contract — one injected clock the fleet
+    advances per tick, scheduled faults keyed by fleet tick, and a
+    seeded RNG for the randomized flavor — so a chaos trace replays
+    bit-identically.
+
+    Injection points (all host-side):
+
+    - **replica kill** — ``kill_at`` (fleet tick -> replica index)
+      marks the replica DEAD at the top of that tick, before it steps:
+      its in-flight requests resubmit to survivors.  ``kill_rate`` draws
+      once per tick from ``RandomState(seed)`` and kills one seeded-
+      random READY replica on a hit.
+    - **slow replica** — ``slow_replicas`` (replica index -> period):
+      the replica only steps every ``period`` fleet ticks, so its queue
+      backs up and healthz-driven balancing must route around it.
+    - **heartbeat partition** — ``partitions`` (replica index ->
+      (start_tick, end_tick)): the replica's heartbeats are suppressed
+      for the window.  Longer than the lease TTL, the fleet declares it
+      DEAD; when the partition heals, its stale lease token can no
+      longer ack (the zombie-fencing contract from master/service.py).
+    """
+
+    seed: int = 0
+    clock: Optional[ManualClock] = None
+    kill_at: Dict[int, int] = field(default_factory=dict)
+    kill_rate: float = 0.0
+    slow_replicas: Dict[int, int] = field(default_factory=dict)
+    partitions: Dict[int, Tuple[int, int]] = field(default_factory=dict)
+
+    def __post_init__(self):
+        self._rng = np.random.RandomState(self.seed)
+
+    def tick_begin(self, tick: int) -> None:
+        """Advance the injected clock for this fleet tick (all replicas
+        share it).  No-op without a ManualClock."""
+        if self.clock is not None:
+            self.clock.advance(self.clock.tick_s)
+
+    def kills(self, tick: int, ready: List[int]) -> List[int]:
+        """Replica indices to kill at this tick: the scheduled one plus
+        at most one seeded-random victim from ``ready``."""
+        out: List[int] = []
+        if tick in self.kill_at:
+            out.append(self.kill_at[tick])
+        if self.kill_rate > 0.0 and ready:
+            # one draw per tick whether or not it hits, so the schedule
+            # is independent of fleet state
+            hit = bool(self._rng.random_sample() < self.kill_rate)
+            pick = int(self._rng.randint(len(ready)))
+            if hit:
+                out.append(ready[pick])
+        return out
+
+    def replica_steps(self, idx: int, tick: int) -> bool:
+        """False when a slow replica skips this fleet tick."""
+        period = self.slow_replicas.get(idx, 1)
+        return period <= 1 or tick % period == 0
+
+    def heartbeat_blocked(self, idx: int, tick: int) -> bool:
+        win = self.partitions.get(idx)
+        return win is not None and win[0] <= tick < win[1]

@@ -326,6 +326,27 @@ def _chain_hash(prev: int, block: Tuple[int, ...]) -> int:
     return hash((prev, block))
 
 
+def prefix_chain_hashes(tokens: Sequence[int], page_size: int,
+                        hash_fn: Optional[Callable[[int, Tuple[int, ...]],
+                                                   int]] = None) -> List[int]:
+    """The :class:`PrefixCache` key chain of ``tokens``: one chained
+    hash per FULL page block, ``h_j = hash(h_{j-1}, block_j)`` from
+    :data:`_CHAIN_SEED` — exactly the keys ``lookup``/``insert`` walk.
+    Exposed so the fleet router (``serving/fleet.py``) routes by the
+    SAME function the cache indexes with: two prompts that would share
+    cached pages produce a common chain prefix by construction, so
+    affinity routing and cache hits can never disagree on what "same
+    prefix" means."""
+    hf = hash_fn or _chain_hash
+    page = int(page_size)
+    h = _CHAIN_SEED
+    out: List[int] = []
+    for j in range(len(tokens) // page):
+        h = hf(h, tuple(tokens[j * page:(j + 1) * page]))
+        out.append(h)
+    return out
+
+
 @dataclass
 class _CacheEntry:
     page: int                 # the page holding this block's K/V

@@ -189,3 +189,110 @@ class ServingMetrics:
             "page_occupancy_peak": round(
                 self.peak_pages_in_use / self.pool_pages, 4),
         }
+
+
+class FleetMetrics:
+    """Fleet-level counters (round 11): what the fleet bench and an
+    external scraper read about the WHOLE deployment, as opposed to the
+    per-replica :class:`ServingMetrics` each engine keeps.
+
+    The load-bearing invariants live here as plain counters so the
+    conservation check can assert them:
+
+    - ``duplicate_completions`` MUST stay 0 — one fleet rid completes at
+      most once, no matter how many replicas died under it;
+    - ``resubmits`` counts death-driven re-dispatches (budgeted by the
+      router; exhaustion ends in FAILED, never an infinite loop);
+    - ``fleet_tokens_per_s`` runs over EMITTED tokens — the exactly-once
+      stream the router forwards — so a request replayed on a survivor
+      after a kill counts each token once, not once per attempt.
+    """
+
+    def __init__(self):
+        self.submitted = 0
+        self.completed = 0
+        self.timed_out = 0
+        self.cancelled = 0
+        self.failed = 0
+        self.rejected = 0            # refused at (re-)dispatch: no capacity
+        self.shed = 0                # engine-judged unmeetable deadline
+        self.resubmits = 0           # death-driven re-dispatches
+        self.duplicate_completions = 0   # idempotence violation: MUST be 0
+        self.routed = 0              # successful dispatches (incl. resubmit)
+        self.affinity_hits = 0       # of those, routed to the prefix owner
+        self.tokens_emitted = 0      # exactly-once stream, all requests
+        self.replicas_joined = 0
+        self.replicas_dead = 0       # killed / lease-expired
+        self.replicas_drained = 0    # clean DRAINING -> DEAD retirements
+        self._first_event_at: Optional[float] = None
+        self._last_token_at: Optional[float] = None
+
+    # ---- event hooks (called by the FleetRouter) --------------------------
+
+    def on_submit(self, now: float) -> None:
+        self.submitted += 1
+        if self._first_event_at is None:
+            self._first_event_at = now
+
+    def on_route(self, affinity: bool) -> None:
+        self.routed += 1
+        if affinity:
+            self.affinity_hits += 1
+
+    def on_resubmit(self) -> None:
+        self.resubmits += 1
+
+    def on_token(self, now: float) -> None:
+        self.tokens_emitted += 1
+        self._last_token_at = now
+
+    def on_terminal(self, status, shed: bool = False) -> None:
+        if shed:
+            self.shed += 1
+            return
+        key = {"completed": "completed", "timed_out": "timed_out",
+               "cancelled": "cancelled", "failed": "failed",
+               "rejected": "rejected"}[str(status)]
+        setattr(self, key, getattr(self, key) + 1)
+
+    # ---- scrape ----------------------------------------------------------
+
+    def fleet_tokens_per_s(self) -> float:
+        if (self._first_event_at is None or self._last_token_at is None or
+                self._last_token_at <= self._first_event_at):
+            return 0.0
+        return self.tokens_emitted / (self._last_token_at -
+                                      self._first_event_at)
+
+    def deadline_miss_rate(self) -> float:
+        """Of the demand that wanted completion, the fraction that
+        missed — same definition as the per-engine metric, but over
+        fleet terminal statuses.  An engine-side TIMED_OUT is harvested
+        as fleet-terminal even on a dying replica (deadlines carry over
+        as absolute times, so the resubmit could never make it): it
+        counts as a miss, never as timeout-then-recover."""
+        demand = self.completed + self.timed_out + self.shed
+        if demand == 0:
+            return 0.0
+        return (self.timed_out + self.shed) / demand
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "fleet_tokens_per_s": round(self.fleet_tokens_per_s(), 2),
+            "fleet_tokens_emitted": self.tokens_emitted,
+            "fleet_submitted": self.submitted,
+            "fleet_completed": self.completed,
+            "fleet_timed_out": self.timed_out,
+            "fleet_cancelled": self.cancelled,
+            "fleet_failed": self.failed,
+            "fleet_rejected": self.rejected,
+            "fleet_shed": self.shed,
+            "fleet_deadline_miss_rate": round(self.deadline_miss_rate(), 4),
+            "fleet_resubmits": self.resubmits,
+            "fleet_duplicate_completions": self.duplicate_completions,
+            "fleet_routed": self.routed,
+            "fleet_affinity_hits": self.affinity_hits,
+            "fleet_replicas_joined": self.replicas_joined,
+            "fleet_replicas_dead": self.replicas_dead,
+            "fleet_replicas_drained": self.replicas_drained,
+        }

@@ -432,3 +432,122 @@ def test_concurrent_trainers_consume_each_record_once(tmp_path):
             "(duplicates or losses under concurrency)")
     finally:
         srv.stop()
+
+
+def test_zombie_token_heartbeat_rejected_end_to_end(dataset):
+    """The lease-token fence, end-to-end through MasterClient.heartbeat:
+    trainer A's lease lapses, trainer B reclaims the SAME slot number,
+    and A's renewal — racing the reclamation with a stale token — must
+    return False (A is a zombie: it re-registers, its in-flight task
+    already requeued).  B's own heartbeat keeps working."""
+    clk = FakeClock()
+    svc = Service(chunks_per_task=1, timeout_s=10.0, time_fn=clk)
+    a = MasterClient(None, service=svc)
+    a.set_dataset(dataset)
+    slot_a = a.register(ttl_s=5.0)
+    task = svc.get_task(owner=slot_a)       # A holds a task lease too
+    assert task is not None
+
+    clk.t += 6.0                            # A's lease lapses silently
+    b = MasterClient(None, service=svc)
+    slot_b = b.register(ttl_s=5.0)
+    assert slot_b == slot_a                 # the slot number is REUSED
+
+    # the zombie's renewal races the reclamation: same slot, stale token
+    assert a.heartbeat() is False
+    # the client noticed it was declared dead and dropped its identity
+    assert a._slot is None and a._token is None
+    # the new owner is untouched by the zombie's attempt
+    assert b.heartbeat() is True
+    # A's task requeued when its lease expired — the next fetch re-serves
+    # it instead of losing it
+    ids = set()
+    while True:
+        t = svc.get_task(owner=slot_b)
+        if t is None:
+            break
+        ids.add(t.id)
+    assert task.id in ids
+
+
+def test_lease_lapse_inside_inner_sweep_still_requeues(dataset):
+    """A lease that lapses BETWEEN Service's own expiry sweep and the
+    sweep LeaseTable runs internally (inside heartbeat/register/members)
+    must still requeue the dead member's in-flight tasks promptly — the
+    freed slot is not silently discarded by the inner sweep, leaving the
+    task to the slow per-task timeout path."""
+    class SteppingClock:
+        # advances a little on EVERY read, like a real clock: that is
+        # exactly what opens the window between the two sweeps
+        def __init__(self):
+            self.now = 0.0
+            self.step = 0.0
+
+        def __call__(self):
+            self.now += self.step
+            return self.now
+
+    clk = SteppingClock()
+    svc = Service(chunks_per_task=1, timeout_s=1000.0, time_fn=clk)
+    svc.set_dataset(dataset)
+    ttl = svc.lease_ttl_s                    # 3 * timeout_s = 3000
+    slot_a, tok_a = svc.register()           # deadline_a = ttl
+    slot_b, tok_b = svc.register()
+    task = svc.get_task(owner=slot_a)
+    assert task is not None
+    clk.now = 10.0
+    assert svc.heartbeat(slot_b, tok_b)      # B renews: deadline ~ttl+10
+
+    # park just short of A's deadline and arm the per-read step so the
+    # deadline falls between Service._expire_members (A still alive)
+    # and the inner LeaseTable sweep (A lapsed)
+    clk.now = ttl - 0.5
+    clk.step = 0.3
+    assert svc.heartbeat(slot_b, tok_b)      # B fine; A dies INSIDE here
+    clk.step = 0.0
+
+    assert svc.heartbeat(slot_a, tok_a) is False   # A is gone
+    # the requeue happened inside that heartbeat call, not lazily later:
+    # A's task is already back in todo with a failure charged
+    assert task.id not in svc._pending
+    assert svc._todo and svc._todo[0].id == task.id
+    assert svc._todo[0].num_failures == 1
+
+
+def test_lease_table_on_expire_fires_on_internal_sweeps():
+    """The on_expire hook runs on EVERY sweep, including the ones
+    register/heartbeat/members do internally, so no freed slot is ever
+    dropped on the floor."""
+    from paddle_tpu.master import LeaseTable
+
+    clk = FakeClock()
+    freed = []
+    lt = LeaseTable(ttl_s=5.0, time_fn=clk, on_expire=freed.append)
+    slot, _tok = lt.register()
+    clk.t += 6.0
+    slot2, _tok2 = lt.register()             # internal sweep frees `slot`
+    assert freed == [slot]
+    assert slot2 == slot                     # and the slot is reusable
+
+
+def test_lease_table_heartbeat_never_resurrects_expired_lease():
+    """LeaseTable.heartbeat re-checks the deadline itself: a renewal
+    arriving exactly when the lease lapsed is refused even though the
+    slot has not been reclaimed by anyone yet."""
+    from paddle_tpu.master import LeaseTable
+
+    clk = FakeClock()
+    lt = LeaseTable(ttl_s=5.0, time_fn=clk)
+    slot, token = lt.register()
+    assert lt.heartbeat(slot, token) is True
+    clk.t += 5.0                            # dl <= now: lapsed, unswept
+    assert lt.heartbeat(slot, token) is False
+    assert lt.members() == []
+    # re-registering mints a fresh token on the same slot; the old token
+    # stays dead forever
+    slot2, token2 = lt.register()
+    assert slot2 == slot
+    assert lt.heartbeat(slot, token) is False
+    assert lt.heartbeat(slot2, token2) is True
+    assert lt.drop(slot2, token) is False   # stale token can't evict
+    assert lt.drop(slot2, token2) is True

@@ -597,3 +597,52 @@ def test_every_terminal_status_reachable_in_one_run(rng):
                 "requests_failed", "requests_shed", "retries",
                 "deadline_miss_rate", "queue_wait_ms_p95"):
         assert key in snap
+
+
+def test_submit_during_drain_rejected_running_finishes(rng):
+    """drain(): new submits REJECT immediately, but queued AND running
+    requests finish normally, and drain(False) reopens admission — the
+    engine-side half of a fleet replica's DRAINING state."""
+    model, params = _small_model()
+    clock = ManualClock(tick_s=0.01)
+    eng = _engine(model, params, FaultPlan(clock=clock))
+    running = eng.submit(rng.randint(2, 50, size=3).tolist(), max_tokens=4)
+    queued = eng.submit(rng.randint(2, 50, size=3).tolist(), max_tokens=4)
+    eng.step()                      # `running` holds a slot now
+    assert not eng.draining
+    eng.drain()
+    assert eng.draining and eng.healthz()["draining"]
+    refused = eng.submit(rng.randint(2, 50, size=3).tolist(), max_tokens=4)
+    assert eng.status(refused) is RequestStatus.REJECTED
+    assert eng.metrics.rejected == 1
+    eng.run(max_ticks=100)
+    # accepted work all finished despite the drain
+    assert eng.status(running) is RequestStatus.COMPLETED
+    assert eng.status(queued) is RequestStatus.COMPLETED
+    assert_drained(eng)
+    eng.drain(False)                # rejoin: admission reopens
+    accepted = eng.submit(rng.randint(2, 50, size=3).tolist(), max_tokens=2)
+    eng.run(max_ticks=100)
+    assert eng.status(accepted) is RequestStatus.COMPLETED
+    assert_drained(eng)
+
+
+def test_healthz_first_class_load_signals(rng):
+    """queue_depth and free_pages are first-class healthz fields (the
+    fleet router balances on them without reaching into internals)."""
+    model, params = _small_model()
+    eng = _engine(model, params, FaultPlan(clock=ManualClock(tick_s=0.01)))
+    hz = eng.healthz()
+    assert hz["queue_depth"] == 0
+    assert hz["free_pages"] == eng.pool.num_free == hz["pages_free"]
+    assert hz["draining"] is False
+    for _ in range(4):
+        eng.submit(rng.randint(2, 50, size=3).tolist(), max_tokens=6)
+    hz = eng.healthz()              # max_slots=2: the rest queue up
+    assert hz["queue_depth"] == 4   # nothing admitted before a step
+    eng.step()
+    hz = eng.healthz()
+    assert hz["queue_depth"] == 2 and hz["running"] == 2
+    assert hz["free_pages"] < eng.pool.num_usable
+    eng.run(max_ticks=100)
+    assert_drained(eng)

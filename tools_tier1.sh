@@ -44,4 +44,22 @@ elif [ "$lint_rc" -ne 0 ]; then
     echo "LINT-FAIL: linter itself exited $lint_rc without running to completion"
     exit 5
 fi
+# fleet conservation gate (paddle_tpu.serving.fleet): replays a seeded
+# replica-kill chaos trace and checks every fleet rid reached exactly
+# one terminal status, nothing completed twice, and no replica pool —
+# dead ones included — leaked a page or a ref.  Exit 6 extends the
+# ladder (PAGE-LEAK=3, REF-LEAK=4, LINT-FAIL=5); same contract as the
+# lint step: branch on the checker's OWN exit status (findings=1,
+# crash=2), never on a grep of the shared log.  Run via -c, not -m:
+# runpy would execute a second copy of fleet.py next to the one the
+# serving package already imported (RuntimeWarning + duplicate classes)
+env JAX_PLATFORMS=cpu python -c 'import sys; from paddle_tpu.serving.fleet import main; sys.exit(main(["check"]))' 2>&1 | tee -a /tmp/_t1.log
+fleet_rc=${PIPESTATUS[0]}
+if [ "$fleet_rc" -eq 1 ]; then
+    echo 'FLEET-LEAK: serving-fleet conservation violated (see log above)'
+    exit 6
+elif [ "$fleet_rc" -ne 0 ]; then
+    echo "FLEET-LEAK: fleet checker itself exited $fleet_rc without running to completion"
+    exit 6
+fi
 exit $rc
