@@ -1098,6 +1098,8 @@ def worker_serving_fleet():
     deadline_s, kill_tick = 0.8, 25
 
     def replay(routing):
+        from paddle_tpu.obs import MetricsRegistry, Tracer
+
         clock = ManualClock(tick_s=0.02)
         plan = FleetFaultPlan(seed=0, clock=clock,
                               kill_at={kill_tick: 0})   # 1-of-4 dies
@@ -1108,8 +1110,14 @@ def worker_serving_fleet():
                                  max_slots=4, buckets=(16, 64),
                                  prefill_chunk=64, time_fn=time_fn)
 
+        # obs: one explicit tracer + registry per replay (same injected
+        # clock), so the bench ships a trace artifact and a per-stage
+        # latency breakdown without touching the global FLAGS gate
+        registry = MetricsRegistry()
+        tracer = Tracer(time_fn=clock, registry=registry)
         fleet = FleetRouter(mk, 4, heartbeat_s=0.1, resubmit_budget=2,
-                            routing=routing, faults=plan)
+                            routing=routing, faults=plan, tracer=tracer,
+                            registry=registry)
         rids = []
         i = 0
         while i < n_req or fleet.has_work:
@@ -1126,10 +1134,36 @@ def worker_serving_fleet():
         assert snap["fleet_duplicate_completions"] == 0
         outs = {j: fleet.result(r) for j, r in enumerate(rids)
                 if fleet.status(r) is RequestStatus.COMPLETED}
-        return outs, snap
+        return outs, snap, fleet
 
-    outs_aff, snap_aff = replay("affinity")
-    outs_rr, snap_rr = replay("round_robin")
+    outs_aff, snap_aff, fleet_aff = replay("affinity")
+    outs_rr, snap_rr, _ = replay("round_robin")
+
+    # per-stage latency attribution (injected-clock seconds) from the
+    # unified registry — the baseline future kernel PRs diff against:
+    # where does a request's time go, queue vs prefill vs decode, and
+    # how much re-dispatch churn did the kill cause
+    def stage_ms(fleet):
+        stages = {}
+        hist = fleet.registry.histogram("serving_stage_seconds")
+        for key, s in hist.series():
+            stage = dict(key)["stage"]
+            tot, cnt = stages.get(stage, (0.0, 0))
+            stages[stage] = (tot + s.sum, cnt + s.count)
+        return {stage: round(1000.0 * tot / cnt, 2) if cnt else 0.0
+                for stage, (tot, cnt) in stages.items()}
+
+    stages_aff = stage_ms(fleet_aff)
+
+    # trace artifact: the affinity replay's full timeline as
+    # Chrome-trace JSON (open in ui.perfetto.dev), next to the numbers
+    from paddle_tpu.obs import save_chrome_trace
+    from paddle_tpu.platform.flags import FLAGS as _FLAGS
+
+    os.makedirs(str(_FLAGS.obs_dump_dir), exist_ok=True)
+    trace_path = os.path.join(str(_FLAGS.obs_dump_dir),
+                              "worker_serving_fleet_trace.json")
+    save_chrome_trace(fleet_aff.tracer.events, trace_path)
 
     # greedy parity across policies: a request completed under BOTH saw
     # token-identical output no matter which replicas computed it (and
@@ -1167,6 +1201,14 @@ def worker_serving_fleet():
         "serving_fleet_parity_ok": int(all(outs_aff[j] == outs_rr[j]
                                            for j in common)),
         "serving_fleet_parity_checked": len(common),
+        # per-stage breakdown (affinity replay, injected-ms means) +
+        # the exported trace artifact — the latency-attribution
+        # baseline for ROADMAP item 2's kernel work
+        "serving_fleet_stage_queue_ms": stages_aff.get("queue", 0.0),
+        "serving_fleet_stage_prefill_ms": stages_aff.get("prefill", 0.0),
+        "serving_fleet_stage_decode_ms": stages_aff.get("decode", 0.0),
+        "serving_fleet_trace_path": trace_path,
+        "serving_fleet_trace_events": len(fleet_aff.tracer.events),
     }
     print(json.dumps(out), flush=True)
 

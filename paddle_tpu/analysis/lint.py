@@ -4,10 +4,11 @@ actually had to engineer away.
 Every rule encodes a repo contract that tests cannot easily enforce:
 
 - ``wall-clock``       — ``time.time()`` / ``time.monotonic()`` /
-  ``time.perf_counter()`` called in serving/ or master/ code.  Those
-  layers run on an injectable clock (``time_fn=`` / ``FaultPlan``
-  ``ManualClock``) so SLO and fault paths are testable without sleeps;
-  a direct call reintroduces wall-clock dependence.  Passing
+  ``time.perf_counter()`` called in serving/, master/ or obs/ code.
+  Those layers run on an injectable clock (``time_fn=`` / ``FaultPlan``
+  ``ManualClock``) so SLO, fault AND tracing paths are testable without
+  sleeps — the obs tracer stamping events off the injected clock is
+  what makes chaos-trace exports byte-deterministic.  Passing
   ``time.monotonic`` as an injectable *default* is fine — only calls
   are flagged.
 - ``unseeded-random``  — module-function ``np.random.*`` calls (the
@@ -16,9 +17,11 @@ Every rule encodes a repo contract that tests cannot easily enforce:
 - ``host-sync``        — ``.item()``, ``np.asarray``/``np.array``/
   ``jnp.asarray``/``jax.device_get`` calls — and ``float()``/``int()``
   over a jax expression — lexically inside a ``for``/``while`` loop in
-  serving code: a per-tick loop that syncs per element serializes the
-  device pipeline (one sync per *tick* is the engine's documented
-  budget).
+  serving or obs code: a per-tick loop that syncs per element
+  serializes the device pipeline (one sync per *tick* is the engine's
+  documented budget, and instrumentation must add ZERO to it — obs is
+  covered so a tracer hook can never smuggle a readback into the
+  tick).
 - ``mutable-default``  — mutable default argument values (list/dict/set
   literals or constructors), the classic shared-state trap.
 - ``import-time-flags``— reading ``FLAGS.<name>`` at module import time
@@ -254,16 +257,17 @@ def _in_dirs(*names):
 RULES: Dict[str, Rule] = {
     "wall-clock": Rule(
         "wall-clock",
-        "direct clock calls in serving/master code (injectable-clock "
-        "layers)", _in_dirs("serving", "master"), _check_wall_clock),
+        "direct clock calls in serving/master/obs code (injectable-"
+        "clock layers)", _in_dirs("serving", "master", "obs"),
+        _check_wall_clock),
     "unseeded-random": Rule(
         "unseeded-random",
         "process-global np.random use in library code",
         lambda parts: True, _check_unseeded_random),
     "host-sync": Rule(
         "host-sync",
-        "per-element device syncs inside serving loops",
-        _in_dirs("serving"), _check_host_sync),
+        "per-element device syncs inside serving/obs loops",
+        _in_dirs("serving", "obs"), _check_host_sync),
     "mutable-default": Rule(
         "mutable-default", "mutable default argument values",
         lambda parts: True, _check_mutable_default),

@@ -98,6 +98,18 @@ class RetraceAuditor:
         self.sites: Dict[str, SiteRecord] = {}
         self.diagnostics: List[Diagnostic] = []
         self._sealed_all = False
+        # obs hook: when attached (ServingEngine.set_tracer does it for
+        # an enabled tracer under FLAGS.jit_audit), every compile lands
+        # on the trace timeline as a `jit_compile` instant — so a chaos
+        # replay shows WHERE the compile spikes sit between the request
+        # spans.  None = no tracing, zero overhead.
+        self.tracer = None
+
+    def attach_tracer(self, tracer) -> None:
+        """Report each compile to an obs tracer (last attach wins; the
+        auditor is process-global, so a fleet attaches its shared base
+        tracer once).  Cleared by :meth:`reset`."""
+        self.tracer = tracer
 
     # ---- bookkeeping (called by audit_jit wrappers) ----------------------
 
@@ -118,6 +130,9 @@ class RetraceAuditor:
             rec._pending_sig = sig
 
     def _on_compile(self, rec: SiteRecord) -> None:
+        if self.tracer is not None:
+            self.tracer.instant("jit_compile", cat="compile",
+                                site=rec.name)
         with self._lock:
             rec.compiles += 1
             sig = rec._pending_sig
@@ -184,6 +199,7 @@ class RetraceAuditor:
         so replacing the dict would orphan them and every later count
         would silently read 0 while the wrappers kept incrementing the
         discarded records."""
+        self.tracer = None
         with self._lock:
             self._sealed_all = False
             for rec in self.sites.values():

@@ -54,10 +54,17 @@ class LeaseTable:
     lock; the serving fleet is single-threaded on the engine tick loop.
     """
 
-    def __init__(self, ttl_s: float, time_fn=time.time, on_expire=None):
+    def __init__(self, ttl_s: float, time_fn=time.time, on_expire=None,
+                 tracer=None):
         self.ttl_s = float(ttl_s)
         self._time = time_fn
         self._on_expire = on_expire
+        # obs hook (paddle_tpu.obs): lease transitions — register,
+        # zombie-rejected renewal, expiry, drop — land on the fleet
+        # trace timeline.  None (the default, and the training master's
+        # setting) costs one is-None check per transition.  Tokens are
+        # NEVER recorded: slots identify members on the timeline.
+        self.tracer = tracer
         # slot -> (lease deadline, lease token)
         self._members: Dict[int, Tuple[float, str]] = {}
 
@@ -71,6 +78,8 @@ class LeaseTable:
         token = secrets.token_hex(8)
         self._members[slot] = (self._time() + float(ttl_s or self.ttl_s),
                                token)
+        if self.tracer is not None:
+            self.tracer.instant("lease_register", cat="lease", lease=slot)
         return slot, token
 
     def heartbeat(self, slot: int, token: str,
@@ -81,6 +90,9 @@ class LeaseTable:
         now = self._time()
         ent = self._members.get(slot)
         if ent is None or ent[1] != token or ent[0] <= now:
+            if self.tracer is not None:
+                self.tracer.instant("lease_reject", cat="lease",
+                                    lease=slot)
             return False
         self._members[slot] = (now + float(ttl_s or self.ttl_s), token)
         return True
@@ -100,6 +112,8 @@ class LeaseTable:
         if ent is None or ent[1] != token:
             return False
         del self._members[slot]
+        if self.tracer is not None:
+            self.tracer.instant("lease_drop", cat="lease", lease=slot)
         return True
 
     def members(self) -> List[int]:
@@ -114,6 +128,10 @@ class LeaseTable:
         dead = [s for s, (dl, _) in self._members.items() if dl <= now]
         for slot in dead:
             del self._members[slot]
+        if self.tracer is not None:
+            for slot in dead:
+                self.tracer.instant("lease_expire", cat="lease",
+                                    lease=slot)
         if self._on_expire is not None:
             for slot in dead:
                 self._on_expire(slot)

@@ -1,0 +1,72 @@
+"""Trainer-event -> obs-span bridge: training and serving share one
+timeline format.
+
+The v2 trainer already fires :mod:`paddle_tpu.event` objects
+(BeginPass / EndPass / BeginIteration / EndIteration / TestResult) at
+every loop edge; this module turns an ordinary ``event_handler`` into
+one that ALSO records those edges as obs spans, so a training run
+exports through the same ``obs.export`` pipeline as a serving chaos
+replay:
+
+- each pass becomes an async ``train_pass`` span (``b``/``e`` paired by
+  pass id);
+- each iteration becomes a complete ``train_iteration`` span (begin at
+  BeginIteration, closed at EndIteration);
+- TestResult becomes a ``test_result`` instant.
+
+Usage::
+
+    tracer = Tracer(registry=obs.default_registry())
+    trainer.train(reader, event_handler=trainer_event_bridge(tracer,
+                                                             my_handler))
+
+The bridge never reads the event's lazy ``.cost``/``.metrics``
+properties — those force a device sync the trainer deliberately avoids
+per batch — so wrapping a handler adds zero host syncs.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from paddle_tpu import event as v2_event
+
+__all__ = ["trainer_event_bridge"]
+
+
+def trainer_event_bridge(tracer, handler: Optional[Callable] = None,
+                         registry=None) -> Callable:
+    """Wrap ``handler`` (or nothing) so trainer events are mirrored as
+    obs spans on ``tracer``.  ``registry`` additionally counts passes /
+    iterations (defaults to the tracer's registry, if any)."""
+    reg = registry if registry is not None else getattr(tracer, "registry",
+                                                        None)
+
+    def on_event(ev) -> None:
+        if isinstance(ev, v2_event.BeginPass):
+            tracer.async_begin("train_pass", id=ev.pass_id,
+                               id_space="pass", cat="train",
+                               pass_id=ev.pass_id)
+        elif isinstance(ev, v2_event.EndPass):
+            tracer.async_end("train_pass", id=ev.pass_id,
+                             id_space="pass", cat="train",
+                             pass_id=ev.pass_id)
+            if reg is not None:
+                reg.counter("train_passes_total",
+                            "completed training passes").inc()
+        elif isinstance(ev, v2_event.BeginIteration):
+            tracer.begin("train_iteration", key=(ev.pass_id, ev.batch_id),
+                         cat="train", pass_id=ev.pass_id,
+                         batch=ev.batch_id)
+        elif isinstance(ev, v2_event.EndIteration):
+            tracer.end("train_iteration", key=(ev.pass_id, ev.batch_id),
+                       cat="train")
+            if reg is not None:
+                reg.counter("train_iterations_total",
+                            "completed training iterations").inc()
+        elif isinstance(ev, v2_event.TestResult):
+            tracer.instant("test_result", cat="train")
+        if handler is not None:
+            handler(ev)
+
+    return on_event

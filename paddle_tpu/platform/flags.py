@@ -203,6 +203,35 @@ FLAGS.define("serving_fleet_resubmit_budget", 2,
              "FAILED — bounded recovery, never an infinite "
              "kill->resubmit loop. 0 = fail on the first death.",
              parser=int)
+FLAGS.define("obs_trace", False,
+             "request-scoped span tracing (paddle_tpu.obs): when on, "
+             "ServingEngine/FleetRouter construct a real Tracer on "
+             "their injected clock and every request lifecycle edge "
+             "(submit/route/admit/prefill chunk/decode tick/preempt/"
+             "resubmit/terminal), fleet lease/fence/reap transition, "
+             "and PagePool alloc/ref/free lands on one exportable "
+             "timeline (python -m paddle_tpu.obs export -> Perfetto). "
+             "Checked at CONSTRUCTION time (the audit_jit idiom): set "
+             "it before building the engine/fleet being traced. Off = "
+             "the shared NULL_TRACER, a true no-op — zero events, zero "
+             "clock reads, zero extra compiles or host syncs on the "
+             "decode tick.")
+FLAGS.define("obs_keep_all", True,
+             "flag-built tracers retain the FULL event list for export "
+             "(the replay/debug default). A long-running service should "
+             "set this off: only the bounded flight-recorder ring "
+             "(obs_ring_size) is kept, so tracing memory cannot grow "
+             "without bound; save()/export then cover the ring's most-"
+             "recent window.")
+FLAGS.define("obs_ring_size", 4096,
+             "flight-recorder depth: the tracer keeps this many most-"
+             "recent events in a bounded ring, dumped to a postmortem "
+             "file whenever a conservation invariant (PAGE-LEAK/"
+             "REF-LEAK/FLEET-LEAK) trips.", parser=int)
+FLAGS.define("obs_dump_dir", "/tmp/paddle_tpu_obs",
+             "directory for flight-recorder postmortem dumps; each dump "
+             "prints a grep-able 'OBS-POSTMORTEM: <path>' line that "
+             "tools_tier1.sh surfaces on any ladder exit >= 3.")
 FLAGS.define("fluid_verify", "warn",
              "static program verification before Executor.run compiles "
              "a fluid Program: 'warn' (default) logs every diagnostic "

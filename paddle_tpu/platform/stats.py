@@ -57,22 +57,57 @@ class StatSet:
             self._entries.setdefault(name, StatEntry()).add(seconds)
 
     def get(self, name: str) -> Optional[StatEntry]:
-        return self._entries.get(name)
+        """Snapshot of one entry.  Takes the lock and returns a COPY:
+        the previous lock-free read handed back the live mutable entry,
+        so a reader summing ``total``/``count`` while a timer thread
+        called ``add`` could see a torn pair (count bumped, total not
+        yet) — the two-thread stress test in tests/test_obs.py pins the
+        fixed behavior."""
+        with self._lock:
+            e = self._entries.get(name)
+            if e is None:
+                return None
+            return StatEntry(total=e.total, count=e.count, max=e.max,
+                             min=e.min)
+
+    def snapshot(self) -> Dict[str, StatEntry]:
+        """Copied view of every entry (same locking contract as
+        :meth:`get` — safe to iterate while timers run)."""
+        with self._lock:
+            return {name: StatEntry(total=e.total, count=e.count,
+                                    max=e.max, min=e.min)
+                    for name, e in self._entries.items()}
 
     def reset(self) -> None:
         with self._lock:
             self._entries.clear()
 
+    def publish(self, registry, prefix: str = "stat_", **labels) -> None:
+        """Publish every timer into an obs
+        :class:`~paddle_tpu.obs.registry.MetricsRegistry` — the scrape
+        path that replaces ad-hoc :meth:`report` prints: per timer name,
+        ``<prefix>seconds_total`` / ``<prefix>calls`` /
+        ``<prefix>seconds_max`` gauges labeled ``name=<timer>``."""
+        for name, e in sorted(self.snapshot().items()):
+            lbl = dict(labels, name=name)
+            registry.gauge(prefix + "seconds_total").labels(**lbl).set(
+                e.total)
+            registry.gauge(prefix + "calls").labels(**lbl).set(e.count)
+            registry.gauge(prefix + "seconds_max").labels(**lbl).set(e.max)
+
     def report(self) -> str:
-        """Formatted table like the reference's StatSet print (Stat.h:114)."""
+        """Formatted table like the reference's StatSet print
+        (Stat.h:114).  DEPRECATED as a scrape surface: prefer
+        :meth:`publish` into the obs registry (one text/snapshot export
+        for timers, serving metrics, and fleet counters alike); this
+        stays for interactive debugging."""
         lines = ["======= StatSet ======="]
         lines.append(f"{'name':<40} {'calls':>8} {'total(ms)':>12} {'avg(ms)':>10} {'max(ms)':>10}")
-        with self._lock:
-            for name, e in sorted(self._entries.items()):
-                lines.append(
-                    f"{name:<40} {e.count:>8} {e.total * 1e3:>12.3f} "
-                    f"{e.avg * 1e3:>10.3f} {e.max * 1e3:>10.3f}"
-                )
+        for name, e in sorted(self.snapshot().items()):
+            lines.append(
+                f"{name:<40} {e.count:>8} {e.total * 1e3:>12.3f} "
+                f"{e.avg * 1e3:>10.3f} {e.max * 1e3:>10.3f}"
+            )
         return "\n".join(lines)
 
 

@@ -72,7 +72,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from paddle_tpu.analysis.retrace import audit_jit
+from paddle_tpu.analysis.retrace import audit_jit, auditor
+from paddle_tpu.obs.registry import MetricsRegistry
+from paddle_tpu.obs.trace import NULL_TRACER, tracer_for
 from paddle_tpu.ops.attention import (DEFAULT_MASK_VALUE, flash_attention,
                                       mha_reference)
 from paddle_tpu.platform.flags import FLAGS
@@ -228,7 +230,8 @@ class ServingEngine:
                  prefix_cache: Optional[bool] = None,
                  prefill_chunk: Optional[int] = None,
                  faults: Optional[FaultPlan] = None,
-                 time_fn: Optional[Callable[[], float]] = None):
+                 time_fn: Optional[Callable[[], float]] = None,
+                 tracer=None, registry: Optional[MetricsRegistry] = None):
         self.model = model
         self.params = params
         self.eos_id = int(eos_id)
@@ -288,6 +291,17 @@ class ServingEngine:
                 else None),
             cache=self.cache, time_fn=self._time)
         self.metrics = ServingMetrics(pool_pages=self.pool.num_usable)
+        # obs: tracer (FLAGS.obs_trace-gated at construction — a fleet
+        # rebinds its shared, replica-scoped tracer via set_tracer) and
+        # the unified metrics registry the per-stage latency histograms
+        # and healthz publish into
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self._reg_labels: Dict[str, str] = {}
+        self._tracer = NULL_TRACER
+        self._postmortems_dumped: set = set()
+        self.set_tracer(tracer if tracer is not None
+                        else tracer_for(self._time, registry=self.registry))
         self._use_kernel = use_kernel
         self._buckets = tuple(sorted(int(b) for b in buckets)) if buckets \
             else _parse_buckets(FLAGS.serving_prefill_buckets)
@@ -325,6 +339,48 @@ class ServingEngine:
         self._prev_tick_busy = False
         self._tick_dur_ema = 0.0      # drives the unmeetable-deadline shed
         self._draining = False        # drain(): REJECT new submits
+
+    # ---- observability wiring -------------------------------------------
+
+    def set_tracer(self, tracer) -> None:
+        """(Re)bind the engine's span tracer — the fleet calls this with
+        its shared tracer scoped to the replica index.  The pool,
+        scheduler and prefix cache get the raw hook (None when tracing
+        is off, so their hot paths pay one is-None check); when the
+        retrace auditor is active the tracer also receives its
+        ``jit_compile`` events."""
+        self._tracer = tracer if tracer is not None else NULL_TRACER
+        hook = self._tracer if self._tracer.enabled else None
+        self.pool.tracer = hook
+        self.scheduler.tracer = hook
+        if self.cache is not None:
+            self.cache.tracer = hook
+        if hook is not None and getattr(FLAGS, "jit_audit", False):
+            auditor().attach_tracer(self._tracer.base)
+
+    def set_registry(self, registry: MetricsRegistry, **labels) -> None:
+        """(Re)bind the unified metrics registry (fleet: one registry,
+        per-replica labels).  All later stage observations and healthz
+        publishes land there."""
+        self.registry = registry
+        self._reg_labels = {k: str(v) for k, v in labels.items()}
+
+    def _observe_stage(self, stage: str, seconds: float) -> None:
+        """Per-stage latency attribution (queue / prefill / decode) on
+        the engine's injected clock — the registry half of the span
+        timeline, cheap enough to stay on unconditionally."""
+        self.registry.histogram(
+            "serving_stage_seconds",
+            "request time per lifecycle stage").labels(
+            stage=stage, **self._reg_labels).observe(max(0.0, seconds))
+
+    def _dump_postmortem(self, reason: str) -> None:
+        """Flight-recorder dump on a tripped conservation invariant —
+        once per reason per engine, so a prober that calls healthz in a
+        leaky steady state doesn't spray one file per probe."""
+        if reason not in self._postmortems_dumped:
+            self._postmortems_dumped.add(reason)
+            self._tracer.dump_postmortem(reason)
 
     # ---- compiled device functions --------------------------------------
 
@@ -495,6 +551,8 @@ class ServingEngine:
             ok = self.scheduler.submit(req, now=t)
         self.metrics.on_submit(t, ok)
         self._requests[req.rid] = req
+        self._tracer.instant("submit", rid=req.rid, tokens=len(req.prompt),
+                             max_tokens=req.max_tokens, accepted=ok)
         if not ok:
             self._retire(req)
         return req.rid
@@ -529,6 +587,10 @@ class ServingEngine:
             RequestStatus.FAILED: self.metrics.on_fail,
         }[status]
         hook()
+        if req.first_token_at is not None:
+            self._observe_stage("decode", now - req.first_token_at)
+        self._tracer.instant("terminal", rid=req.rid, status=str(status),
+                             shed=shed, tokens=len(req.generated))
         self._retire(req)
 
     def _retire(self, req: Request) -> None:
@@ -611,10 +673,14 @@ class ServingEngine:
             if req.admitted_at is None:
                 # queue wait is a first-admission stat: re-admissions
                 # after preemption would fold running time into it
-                m.on_admit(now - (req.submitted_at
-                                  if req.submitted_at is not None else now))
+                wait = now - (req.submitted_at
+                              if req.submitted_at is not None else now)
+                m.on_admit(wait)
+                self._observe_stage("queue", wait)
                 req.admitted_at = now
             req.last_progress_tick = tick
+            self._tracer.instant("admit", rid=req.rid, slot=req.slot,
+                                 cached=req.cached_len, tick=tick)
             self._begin_prefill(req)
         # ONE chunk per prefilling request per tick: a freshly-admitted
         # request takes its first chunk now, earlier admissions resume —
@@ -623,12 +689,17 @@ class ServingEngine:
         prefilling = [r for r in sched.running_requests()
                       if r.status is RequestStatus.RUNNING and r.prefilling]
         for req in prefilling:
-            self._prefill_step(req)
+            with self._tracer.span("prefill_chunk", rid=req.rid,
+                                   slot=req.slot, start=req.cache_len,
+                                   tick=tick):
+                self._prefill_step(req)
         running = [r for r in sched.running_requests()
                    if r.status is RequestStatus.RUNNING
                    and not r.prefilling and r.generated]
         if running:
-            self._decode_with_retry(running, tick)
+            with self._tracer.span("decode_tick", tick=tick,
+                                   n=len(running)):
+                self._decode_with_retry(running, tick)
         self._prev_tick_busy = (bool(running) or bool(admitted) or
                                 bool(prefilling))
         self._watchdog_sweep(tick)
@@ -679,6 +750,9 @@ class ServingEngine:
           preemption-unref and eviction all have to balance exactly."""
         pool = self.pool
         if pool.num_free + pool.num_in_use != pool.num_usable:
+            # flight recorder: the leak report ships WITH the event
+            # history that produced it (no-op when tracing is off)
+            self._dump_postmortem("PAGE-LEAK")
             raise PageLeakError(
                 f"PAGE-LEAK: free={pool.num_free} in_use={pool.num_in_use} "
                 f"usable={pool.num_usable}")
@@ -691,6 +765,7 @@ class ServingEngine:
         if self.faults is not None:
             held += len(self.faults.held_pages)
         if held != pool.total_refs:
+            self._dump_postmortem("REF-LEAK")
             raise PageLeakError(
                 f"REF-LEAK: held={held} refs={pool.total_refs} "
                 f"cached={pool.num_cached} free={pool.num_free} "
@@ -729,8 +804,13 @@ class ServingEngine:
             leak = False
         except PageLeakError:
             leak = True
+        # the unified-registry surface: publish this engine's counters,
+        # then hand back the registry's flat snapshot so one healthz
+        # probe reads the same numbers a scraper would
+        self.metrics.publish(self.registry, **self._reg_labels)
         return {
             "ok": not leak,
+            "metrics": self.registry.snapshot(),
             "tick": self._tick,
             "queue_depth": self.scheduler.queue_depth,
             "running": len(self.scheduler.running),
@@ -957,6 +1037,9 @@ class ServingEngine:
             req.first_token_at = now
             ttft = max(0.0, now - (req.submitted_at
                                    if req.submitted_at is not None else now))
+            self._observe_stage("prefill", now - (
+                req.admitted_at if req.admitted_at is not None else now))
+            self._tracer.instant("first_token", rid=req.rid, slot=req.slot)
         self.metrics.on_token(now, ttft)
         if req.on_token is not None:
             req.on_token(tok)

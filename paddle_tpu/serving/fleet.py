@@ -69,6 +69,8 @@ from typing import (Callable, Deque, Dict, List, Optional, Sequence, Set,
                     Tuple)
 
 from paddle_tpu.master.service import LeaseTable
+from paddle_tpu.obs.registry import MetricsRegistry
+from paddle_tpu.obs.trace import NULL_TRACER, tracer_for
 from paddle_tpu.platform.enforce import enforce_that
 from paddle_tpu.platform.flags import FLAGS
 from paddle_tpu.serving.engine import ServingEngine
@@ -166,7 +168,9 @@ class FleetRouter:
                  max_retained: int = 10000,
                  max_owner_keys: int = 16384,
                  faults: Optional[FleetFaultPlan] = None,
-                 time_fn: Optional[Callable[[], float]] = None):
+                 time_fn: Optional[Callable[[], float]] = None,
+                 tracer=None,
+                 registry: Optional[MetricsRegistry] = None):
         enforce_that(routing in ("affinity", "round_robin"),
                      f"unknown routing policy {routing!r}",
                      context="serving")
@@ -193,7 +197,20 @@ class FleetRouter:
             self._time = faults.clock
         else:
             self._time = time_fn or time.monotonic
-        self._lease = LeaseTable(self.lease_ttl_s, time_fn=self._time)
+        # obs: ONE tracer and ONE registry for the whole fleet (replica
+        # engines get the tracer scoped to their index and the registry
+        # labeled with it), so a chaos replay yields one timeline and
+        # one scrape surface instead of N disjoint ones
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self.tracer = tracer if tracer is not None \
+            else tracer_for(self._time, registry=self.registry)
+        if self.tracer.enabled and self.tracer.registry is None:
+            self.tracer.registry = self.registry
+        self._postmortems_dumped: Set[str] = set()
+        self._lease = LeaseTable(self.lease_ttl_s, time_fn=self._time,
+                                 tracer=self.tracer if self.tracer.enabled
+                                 else None)
         self.metrics = FleetMetrics()
         self.replicas: List[Replica] = []
         self._requests: Dict[int, _FleetRequest] = {}
@@ -222,10 +239,15 @@ class FleetRouter:
         sweep once the lease is live and healthz reports ok."""
         idx = len(self.replicas)
         rep = Replica(idx, self._make_engine(idx, self._time))
+        # one fleet-wide tracer/registry: the engine's instrumentation
+        # points report under this replica's identity
+        rep.engine.set_tracer(self.tracer.scoped(replica=idx))
+        rep.engine.set_registry(self.registry, replica=idx)
         rep.slot, rep.token = self._lease.register(self.lease_ttl_s)
         rep.last_hb = self._time()
         self.replicas.append(rep)
         self.metrics.replicas_joined += 1
+        self.tracer.instant("replica_join", cat="fleet", replica=idx)
         return idx
 
     def drain_replica(self, idx: int) -> None:
@@ -240,6 +262,7 @@ class FleetRouter:
         rep.state = ReplicaState.DRAINING
         rep.engine.drain()
         self._forget_owner(idx)
+        self.tracer.instant("replica_drain", cat="fleet", replica=idx)
 
     def kill_replica(self, idx: int,
                      reason: str = "killed by operator") -> None:
@@ -260,6 +283,8 @@ class FleetRouter:
             if self._lease.alive(rep.slot, rep.token) and \
                     rep.engine.healthz()["ok"]:
                 rep.state = ReplicaState.READY
+                self.tracer.instant("replica_ready", cat="fleet",
+                                    replica=rep.idx)
 
     def _lease_sweep(self, tick: int, now: float) -> None:
         """Renew every live replica's lease (unless partitioned), then
@@ -334,6 +359,8 @@ class FleetRouter:
         self.metrics.replicas_dead += 1
         self._lease.drop(rep.slot, rep.token)
         self._forget_owner(rep.idx)
+        self.tracer.instant("replica_fence", cat="fleet", replica=rep.idx,
+                            reason=reason)
 
     def _reap(self, rep: Replica, now: float) -> None:
         """Resubmit a fenced replica's unfinished work to survivors.
@@ -342,6 +369,8 @@ class FleetRouter:
         first so only genuinely unfinished work resubmits."""
         self._harvest(rep, now)
         pending = list(rep.rid_map.items())
+        self.tracer.instant("replica_reap", cat="fleet", replica=rep.idx,
+                            in_flight=len(pending))
         # sever the map BEFORE resubmitting: from this line on, nothing
         # this replica's engine does can reach a fleet request again
         rep.rid_map.clear()
@@ -366,6 +395,8 @@ class FleetRouter:
         rep.dead_reason = "drained"
         self.metrics.replicas_drained += 1
         self._forget_owner(rep.idx)
+        self.tracer.instant("replica_drained", cat="fleet",
+                            replica=rep.idx)
 
     # ---- routing ----------------------------------------------------------
 
@@ -433,6 +464,12 @@ class FleetRouter:
         self._requests[freq.frid] = freq
         self._live.add(freq.frid)
         self.metrics.on_submit(now)
+        # THE root span: one async begin per fleet rid, ended by the
+        # request's single terminal transition in _finish — the
+        # exactly-once invariant drawn as exactly one bar per rid
+        self.tracer.async_begin("fleet_request", id=freq.frid,
+                                id_space="frid", tokens=len(freq.prompt),
+                                max_tokens=freq.max_tokens)
         self._dispatch(freq, now)
         return freq.frid
 
@@ -562,6 +599,10 @@ class FleetRouter:
             if self.routing == "affinity":
                 self._record_owner(hashes, idx)   # RR never reads the map
             self.metrics.on_route(affinity)
+            self.tracer.instant("route", cat="fleet", replica=idx,
+                                frid=freq.frid, erid=erid,
+                                affinity=affinity,
+                                attempt=freq.resubmits)
             return True
 
     def _resubmit(self, freq: _FleetRequest, now: float) -> None:
@@ -574,6 +615,10 @@ class FleetRouter:
             return
         freq.resubmits += 1
         self.metrics.on_resubmit()
+        self.registry.counter("fleet_resubmits_total",
+                              "death-driven re-dispatches").inc()
+        self.tracer.instant("resubmit", cat="fleet", frid=freq.frid,
+                            attempt=freq.resubmits)
         self._dispatch(freq, now)
 
     def _harvest(self, rep: Replica, now: float) -> None:
@@ -621,6 +666,10 @@ class FleetRouter:
         freq.erid = None
         self._live.discard(freq.frid)
         self.metrics.on_terminal(status, shed=shed)
+        self.tracer.async_end("fleet_request", id=freq.frid,
+                              id_space="frid", status=str(status),
+                              resubmits=freq.resubmits,
+                              tokens=freq.emitted)
         self._retired.append(freq.frid)
         while len(self._retired) > self.max_retained:
             self._requests.pop(self._retired.popleft(), None)
@@ -657,6 +706,11 @@ class FleetRouter:
                 problems.append(f"replica {rep.idx}: {refs} live page "
                                 "refs after fleet drain")
         if problems:
+            # flight recorder: ship the event history with the report
+            # (once per router; no-op when tracing is off)
+            if "FLEET-LEAK" not in self._postmortems_dumped:
+                self._postmortems_dumped.add("FLEET-LEAK")
+                self.tracer.dump_postmortem("FLEET-LEAK")
             raise PageLeakError("FLEET-LEAK: " + "; ".join(problems))
 
     def healthz(self) -> Dict[str, object]:
@@ -706,7 +760,19 @@ class FleetRouter:
             round(r.engine.metrics.prefix_hit_rate(), 4)
             for r in self.replicas]
         snap["replica_states"] = [r.state.value for r in self.replicas]
+        # keep the unified registry current: fleet counters land next to
+        # the replicas' serving_* series and stage histograms, so one
+        # scrape surface (registry.snapshot()/to_text()) has it all
+        self.metrics.publish(self.registry)
         return snap
+
+    def metrics_text(self) -> str:
+        """Prometheus-style exposition of the fleet's unified registry
+        (publishes the latest fleet + per-replica counters first)."""
+        self.metrics.publish(self.registry)
+        for rep in self.replicas:
+            rep.engine.metrics.publish(self.registry, replica=rep.idx)
+        return self.registry.to_text()
 
 
 # ---------------------------------------------------------------------------

@@ -183,6 +183,10 @@ class PagePool:
     scan on every free."""
 
     num_pages: int
+    # obs hook: the engine binds its (enabled) tracer here so page
+    # custody changes land on the request timeline; None = tracing off,
+    # one is-None check per pool call (never per page)
+    tracer: Optional[object] = field(default=None, repr=False, compare=False)
     _free: List[int] = field(default_factory=list)
     _free_set: Set[int] = field(default_factory=set)
     _refs: Dict[int, int] = field(default_factory=dict)
@@ -250,6 +254,9 @@ class PagePool:
         for p in got:
             self._free_set.discard(p)
             self._refs[p] = 1
+        if self.tracer is not None and got:
+            self.tracer.instant("page_alloc", cat="pages", n=len(got),
+                                pages=tuple(got))
         return got
 
     def ref(self, pages: Sequence[int]) -> None:
@@ -259,6 +266,9 @@ class PagePool:
             enforce_that(p in self._refs, f"ref of free page {p}",
                          context="serving")
             self._refs[p] += 1
+        if self.tracer is not None and pages:
+            self.tracer.instant("page_ref", cat="pages", n=len(pages),
+                                pages=tuple(pages))
 
     def free(self, pages: Sequence[int]) -> None:
         """Drop one holder per page (unref).  A page reaches the free
@@ -276,6 +286,9 @@ class PagePool:
                 del self._refs[p]
                 self._free.append(p)
                 self._free_set.add(p)
+        if self.tracer is not None and pages:
+            self.tracer.instant("page_free", cat="pages", n=len(pages),
+                                pages=tuple(pages))
 
     def mark_cached(self, p: int) -> None:
         """Register a (non-free) page as prefix-cache-held: at refcount
@@ -308,6 +321,8 @@ class PagePool:
         del self._refs[p]
         self._free.append(p)
         self._free_set.add(p)
+        if self.tracer is not None:
+            self.tracer.instant("page_evict", cat="pages", page=p)
 
 
 # ---------------------------------------------------------------------------
@@ -385,6 +400,7 @@ class PrefixCache:
         self.pool = pool
         self.page_size = int(page_size)
         self._hash = hash_fn or _chain_hash
+        self.tracer = None     # obs hook, bound by the engine (see pool)
         self._index: "OrderedDict[int, _CacheEntry]" = OrderedDict()
         self.hits = 0          # lookups that matched >= 1 page (healthz)
         self.misses = 0        # lookups that matched none (healthz)
@@ -487,6 +503,8 @@ class PrefixCache:
                 self.pool.release_cached(e.page)
                 self.evictions += 1
                 freed += 1
+        if self.tracer is not None and freed:
+            self.tracer.instant("cache_evict", cat="pages", n=freed)
         return freed
 
     def flush(self) -> int:

@@ -160,6 +160,15 @@ class ServingMetrics:
             return 0.0
         return self.prefill_tokens_saved / self.prefix_requested_tokens
 
+    def publish(self, registry, **labels) -> None:
+        """Publish every :meth:`snapshot` value into an obs
+        :class:`~paddle_tpu.obs.registry.MetricsRegistry` as gauges
+        named ``serving_<key>`` (labels — typically ``replica=idx`` —
+        keep multi-engine series apart).  Duck-typed on the registry so
+        this module stays importable without obs."""
+        for k, v in self.snapshot().items():
+            registry.gauge("serving_" + k).labels(**labels).set(v)
+
     def snapshot(self) -> Dict[str, float]:
         return {
             "tokens_per_s": round(self.tokens_per_s(), 2),
@@ -275,6 +284,13 @@ class FleetMetrics:
         if demand == 0:
             return 0.0
         return (self.timed_out + self.shed) / demand
+
+    def publish(self, registry, **labels) -> None:
+        """Publish every :meth:`snapshot` value (already
+        ``fleet_``-prefixed) into an obs registry as gauges — the
+        fleet-level half of the one-scrape-surface contract."""
+        for k, v in self.snapshot().items():
+            registry.gauge(k).labels(**labels).set(v)
 
     def snapshot(self) -> Dict[str, float]:
         return {

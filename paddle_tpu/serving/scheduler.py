@@ -147,6 +147,9 @@ class ContinuousBatchingScheduler:
         self.pool = pool
         self.cfg = cfg
         self.cache = cache          # prefix cache; None = caching off
+        self.tracer = None          # obs hook, bound by the engine; None
+        #                             (tracing off) costs one is-None
+        #                             check on the preempt/requeue edges
         # injectable clock (engine passes its own — possibly a fault
         # plan's ManualClock); only the submit(now=None) fallback reads it
         self._time = time_fn
@@ -315,6 +318,9 @@ class ContinuousBatchingScheduler:
         return max(cands, key=lambda r: (r.submitted_at, r.rid))
 
     def _preempt(self, req: Request) -> None:
+        if self.tracer is not None:
+            self.tracer.instant("preempt", rid=req.rid, slot=req.slot,
+                                preemptions=req.preemptions + 1)
         self._release_slot_and_pages(req)
         req.cache_len = 0
         req.cached_len = 0

@@ -25,6 +25,7 @@ import numpy as np
 
 from paddle_tpu import event as v2_event
 from paddle_tpu.analysis.retrace import audit_jit
+from paddle_tpu.obs.registry import default_registry
 from paddle_tpu.data_feeder import DataFeeder
 from paddle_tpu.optimizer import Optimizer
 from paddle_tpu.parameters import Parameters
@@ -395,6 +396,15 @@ class SGD:
                 event_handler(v2_event.EndPass(pass_id, result_metrics, self.parameters))
             if save_dir is not None and (pass_id + 1) % saving_period == 0:
                 self.save_checkpoint(save_dir, pass_id)
+            # scrape surface for the per-batch timers: publish the
+            # StatSet into the obs registry each pass instead of ad-hoc
+            # report() prints — training timings land next to serving
+            # metrics on ONE export (obs.default_registry().to_text()).
+            # Wrap event_handler with obs.trainer_event_bridge(tracer)
+            # to additionally put every pass/iteration on a trace
+            # timeline.
+            stats.timer_stats().publish(default_registry(),
+                                        prefix="trainer_")
 
         self.parameters.update_from(params)
         self.opt_state = opt_state
@@ -577,6 +587,10 @@ class SGD:
             pass_id += 1
             flush(pass_id, epoch)
             sync_back()
+            # same registry publish as the reader path: elastic passes
+            # expose their trainOneBatch timings through obs too
+            stats.timer_stats().publish(default_registry(),
+                                        prefix="trainer_")
             if test_reader is not None:
                 tr = self.test(test_reader, feeding)
                 event_handler(v2_event.EndPass(pass_id - 1, tr.metrics,

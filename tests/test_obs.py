@@ -1,0 +1,650 @@
+"""paddle_tpu.obs tests: span tracer, Chrome-trace export determinism,
+flight-recorder postmortems, unified metrics registry, trainer bridge,
+and the obs-off zero-overhead contract.
+
+Marker ``obs``.  Everything runs on injected clocks — no sleeps — and
+the chaos scenarios reuse the ONE seeded replay definition in
+``paddle_tpu.obs.cli.seeded_chaos`` (also the CLI's and the acceptance
+criterion's), so "byte-identical across two replays" is tested against
+the same trace a human would export.
+"""
+
+import json
+import threading
+from collections import Counter
+from pathlib import Path
+
+import jax
+import pytest
+
+import paddle_tpu.obs as obs
+from paddle_tpu import event as v2_event
+from paddle_tpu.analysis.lint import lint_source, run_lint
+from paddle_tpu.analysis.retrace import auditor
+from paddle_tpu.obs import (NULL_TRACER, Event, MetricsRegistry, Tracer,
+                            chrome_trace, dumps_chrome, load_events,
+                            trainer_event_bridge)
+from paddle_tpu.obs.cli import main as obs_main
+from paddle_tpu.obs.cli import seeded_chaos
+from paddle_tpu.platform import stats as pstats
+from paddle_tpu.platform.flags import FLAGS
+from paddle_tpu.serving import (DecoderLM, FleetFaultPlan, FleetRouter,
+                                ManualClock, PageLeakError, RequestStatus,
+                                ServingEngine)
+from paddle_tpu.master.service import LeaseTable
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    model = DecoderLM(vocab_size=64, num_layers=1, num_heads=2, head_dim=8,
+                      max_positions=128)
+    params = model.init_params(jax.random.PRNGKey(0))
+    return model, params
+
+
+def make_engine(model, params, clock, **kw):
+    return ServingEngine(model, params, eos_id=1, page_size=4,
+                         num_pages=32, max_pages_per_seq=8, max_slots=4,
+                         buckets=(8, 16), time_fn=clock, **kw)
+
+
+@pytest.fixture
+def dump_dir(tmp_path):
+    old = FLAGS.obs_dump_dir
+    FLAGS.obs_dump_dir = str(tmp_path)
+    yield tmp_path
+    FLAGS.obs_dump_dir = old
+
+
+@pytest.fixture(scope="module")
+def chaos_pair():
+    """Two replays of the seeded acceptance chaos (kill + partition +
+    slow on 4 replicas) — shared by the root-span and determinism
+    tests so the expensive replays run once."""
+    return seeded_chaos(), seeded_chaos()
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+
+def test_registry_counter_gauge_histogram():
+    reg = MetricsRegistry()
+    reg.counter("reqs", "requests").inc()
+    reg.counter("reqs").labels(replica=1).inc(2)
+    reg.gauge("depth").set(7)
+    reg.histogram("lat", buckets=(0.1, 1.0)).observe(0.05)
+    reg.histogram("lat").observe(5.0)
+    snap = reg.snapshot()
+    assert snap["reqs"] == 1
+    assert snap["reqs{replica=1}"] == 2
+    assert snap["depth"] == 7
+    assert snap["lat_count"] == 2
+    assert snap["lat_sum"] == pytest.approx(5.05)
+    assert snap["lat_max"] == 5.0
+    text = reg.to_text()
+    assert "# TYPE reqs counter" in text
+    assert 'lat_bucket{le="+Inf"} 2' in text
+    # exposition format: label VALUES are double-quoted
+    assert 'reqs{replica="1"} 2' in text
+    # a name keeps its kind
+    with pytest.raises(TypeError):
+        reg.gauge("reqs")
+    # snapshot order is deterministic
+    assert list(snap) == list(reg.snapshot())
+
+
+def test_serving_and_fleet_metrics_publish_into_registry():
+    from paddle_tpu.serving.metrics import FleetMetrics, ServingMetrics
+
+    reg = MetricsRegistry()
+    sm = ServingMetrics(pool_pages=8)
+    sm.on_submit(0.0, True)
+    sm.on_complete()
+    sm.publish(reg, replica=0)
+    fm = FleetMetrics()
+    fm.on_submit(0.0)
+    fm.publish(reg)
+    snap = reg.snapshot()
+    assert snap["serving_requests_submitted{replica=0}"] == 1
+    assert snap["serving_requests_completed{replica=0}"] == 1
+    assert snap["fleet_submitted"] == 1
+
+
+# ---------------------------------------------------------------------------
+# StatSet satellite: locked get/iteration + publish
+# ---------------------------------------------------------------------------
+
+
+def test_statset_get_locked_and_copied_under_concurrency():
+    ss = pstats.StatSet()
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        while not stop.is_set():
+            ss.add("hot", 0.001)
+
+    def reader():
+        try:
+            while not stop.is_set():
+                e = ss.get("hot")
+                if e is not None:
+                    # a torn read (count bumped before total) would make
+                    # avg wildly off; a copied entry never mutates
+                    c0, t0 = e.count, e.total
+                    assert e.count == c0 and e.total == t0
+                ss.report()
+                ss.snapshot()
+        except Exception as exc:               # pragma: no cover
+            errors.append(exc)
+
+    threads = [threading.Thread(target=writer) for _ in range(2)] + \
+              [threading.Thread(target=reader)]
+    for t in threads:
+        t.start()
+    import time as _time
+    _time.sleep(0.2)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errors
+    got = ss.get("hot")
+    assert got is not None and got.count > 0
+    # the returned entry is a COPY: mutating it cannot corrupt the set
+    got.count = -1
+    assert ss.get("hot").count > 0
+    assert ss.get("missing") is None
+
+
+def test_statset_publish_into_registry():
+    ss = pstats.StatSet()
+    ss.add("trainOneBatch", 0.25)
+    ss.add("trainOneBatch", 0.75)
+    reg = MetricsRegistry()
+    ss.publish(reg, prefix="trainer_")
+    snap = reg.snapshot()
+    assert snap["trainer_seconds_total{name=trainOneBatch}"] == \
+        pytest.approx(1.0)
+    assert snap["trainer_calls{name=trainOneBatch}"] == 2
+    assert snap["trainer_seconds_max{name=trainOneBatch}"] == \
+        pytest.approx(0.75)
+
+
+# ---------------------------------------------------------------------------
+# tracer + exporter units
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_spans_instants_async_and_export_shape():
+    clk = ManualClock(tick_s=0.01)
+    t = Tracer(time_fn=clk, ring_size=64)
+    with t.span("decode_tick", replica=0, tick=3, n=2):
+        clk.advance(0.02)
+    t.instant("admit", rid=5, slot=1, replica=0)
+    t.async_begin("fleet_request", id=17, id_space="frid")
+    t.async_end("fleet_request", id=17, id_space="frid", status="completed")
+    trace = chrome_trace(t.events)
+    evs = trace["traceEvents"]
+    # metadata names replicas/slots
+    assert {"ph": "M", "name": "process_name", "pid": 0,
+            "args": {"name": "replica 0"}} in evs
+    assert any(e.get("args", {}).get("name") == "slot 1" for e in evs
+               if e.get("ph") == "M" and e.get("name") == "thread_name")
+    span = next(e for e in evs if e.get("ph") == "X")
+    assert span["name"] == "decode_tick" and span["dur"] == 20000
+    inst = next(e for e in evs if e.get("ph") == "i")
+    assert inst["s"] == "t" and inst["args"]["rid"] == 0   # normalized
+    b = next(e for e in evs if e.get("ph") == "b")
+    e = next(e for e in evs if e.get("ph") == "e")
+    assert b["id"] == e["id"] == 0                          # normalized
+    assert json.loads(dumps_chrome(t.events))["traceEvents"]
+
+
+def test_event_roundtrip_and_jsonl(tmp_path):
+    ev = Event(kind="i", name="route", ts=1.25, cat="fleet", replica=2,
+               id=4, id_space="frid", args={"pages": (3, 4), "ok": True})
+    back = Event.from_dict(json.loads(json.dumps(ev.to_dict())))
+    assert back.name == "route" and back.replica == 2
+    assert back.args["pages"] == [3, 4]
+    t = Tracer(time_fn=ManualClock())
+    t.instant("a")
+    t.instant("b", rid=1)
+    p = t.save(str(tmp_path / "ev.jsonl"))
+    assert [e.name for e in load_events(p)] == ["a", "b"]
+
+
+def test_flight_recorder_ring_bounded():
+    t = Tracer(time_fn=ManualClock(), ring_size=4, keep_all=False)
+    for i in range(10):
+        t.instant("tick", tick=i)
+    assert len(t.ring) == 4
+    assert t.dropped == 6
+    assert [e.args["tick"] for e in t.ring] == [6, 7, 8, 9]
+    # keep_all=True counts ring displacement identically: a postmortem's
+    # dropped_before_ring is honest about the ring window either way
+    t2 = Tracer(time_fn=ManualClock(), ring_size=4, keep_all=True)
+    for i in range(10):
+        t2.instant("tick", tick=i)
+    assert len(t2.events) == 10 and t2.dropped == 6
+
+
+def test_obs_keep_all_flag_bounds_flag_built_tracers():
+    from paddle_tpu.obs.trace import tracer_for
+    old_trace, old_keep = FLAGS.obs_trace, FLAGS.obs_keep_all
+    try:
+        FLAGS.obs_trace = True
+        FLAGS.obs_keep_all = False
+        clk = ManualClock()
+        t = tracer_for(clk)
+        for i in range(FLAGS.obs_ring_size + 5):
+            t.instant("tick", tick=i)
+        assert t.events == []            # bounded: only the ring retained
+        assert len(t.ring) == FLAGS.obs_ring_size
+    finally:
+        FLAGS.obs_trace, FLAGS.obs_keep_all = old_trace, old_keep
+
+
+def test_begin_end_keep_the_opening_category():
+    clk = ManualClock(tick_s=0.01)
+    t = Tracer(time_fn=clk, ring_size=16)
+    t.begin("phase", key=1, cat="train", replica=2)
+    clk.advance(0.01)
+    t.end("phase", key=1)                # no cat: begin's wins
+    assert t.events[-1].cat == "train" and t.events[-1].replica == 2
+    t.begin("phase", key=2, cat="train")
+    t.end("phase", key=2, cat="fleet")   # explicit end cat overrides
+    assert t.events[-1].cat == "fleet"
+
+
+def test_null_tracer_is_inert(dump_dir):
+    assert not NULL_TRACER.enabled
+    with NULL_TRACER.span("x", rid=1):
+        pass
+    NULL_TRACER.instant("y")
+    NULL_TRACER.async_begin("z", id=1)
+    assert NULL_TRACER.scoped(replica=3) is NULL_TRACER
+    assert NULL_TRACER.dump_postmortem("PAGE-LEAK") is None
+    assert list(dump_dir.iterdir()) == []
+
+
+# ---------------------------------------------------------------------------
+# engine lifecycle tracing
+# ---------------------------------------------------------------------------
+
+
+def test_engine_trace_covers_request_lifecycle(small_model):
+    model, params = small_model
+    clk = ManualClock(tick_s=0.01)
+    tracer = Tracer(time_fn=clk, registry=MetricsRegistry())
+    eng = make_engine(model, params, clk, tracer=tracer,
+                      registry=tracer.registry)
+    rid = eng.submit([2, 3, 4, 5, 6], max_tokens=4)
+    eng.run()
+    assert eng.status(rid) is RequestStatus.COMPLETED
+    names = Counter(e.name for e in tracer.events)
+    for expected in ("submit", "admit", "prefill_chunk", "decode_tick",
+                     "first_token", "terminal", "page_alloc", "page_free"):
+        assert names[expected] >= 1, (expected, names)
+    term = next(e for e in tracer.events if e.name == "terminal")
+    assert term.args["status"] == "completed"
+    # per-stage histograms observed on the same injected clock
+    snap = tracer.registry.snapshot()
+    assert snap["serving_stage_seconds{stage=queue}_count"] >= 1
+    assert snap["serving_stage_seconds{stage=prefill}_count"] >= 1
+    assert snap["serving_stage_seconds{stage=decode}_count"] >= 1
+
+
+def test_engine_healthz_exposes_registry(small_model):
+    model, params = small_model
+    clk = ManualClock(tick_s=0.01)
+    eng = make_engine(model, params, clk)
+    eng.submit([2, 3, 4], max_tokens=2)
+    eng.run()
+    hz = eng.healthz()
+    assert hz["ok"]
+    assert hz["metrics"]["serving_requests_completed"] == 1
+    assert "serving_stage_seconds{stage=queue}_count" in hz["metrics"]
+
+
+# ---------------------------------------------------------------------------
+# fleet chaos: root spans + deterministic export (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+
+def _root_span_counts(events):
+    per = Counter()
+    for e in events:
+        if e.name == "fleet_request":
+            per[(e.kind, e.id)] += 1
+    return per
+
+
+def test_chaos_exactly_one_root_span_per_fleet_rid(chaos_pair):
+    (tracer, fleet, frids), _ = chaos_pair
+    assert not fleet.has_work
+    # chaos actually happened: an injected kill AND a lease-expiry
+    # death, with resubmits to survivors
+    reasons = [r.dead_reason for r in fleet.replicas]
+    assert "injected kill @ tick 8" in reasons
+    assert "lease expired" in reasons
+    assert fleet.metrics.resubmits > 0
+    per = _root_span_counts(tracer.events)
+    begun = {i for (k, i), _ in per.items() if k == "b"}
+    ended = {i for (k, i), _ in per.items() if k == "e"}
+    assert begun == ended == set(frids)
+    assert all(c == 1 for c in per.values()), per
+    # resubmit edges are on the timeline, tied to their fleet rid
+    resubs = [e for e in tracer.events if e.name == "resubmit"]
+    assert len(resubs) == fleet.metrics.resubmits
+    assert all(e.args["frid"] in frids for e in resubs)
+    # every root span closes with the request's terminal status
+    for e in tracer.events:
+        if e.kind == "e" and e.name == "fleet_request":
+            assert e.args["status"] == str(fleet.status(e.id))
+
+
+def test_chaos_export_is_byte_identical_across_replays(chaos_pair):
+    (t1, fleet1, _), (t2, fleet2, _) = chaos_pair
+    b1 = dumps_chrome(t1.events)
+    b2 = dumps_chrome(t2.events)
+    assert b1 == b2
+    # and it is valid Chrome-trace JSON Perfetto accepts: a traceEvents
+    # list whose entries all carry a phase, with matched async pairs
+    trace = json.loads(b1)
+    assert isinstance(trace["traceEvents"], list) and trace["traceEvents"]
+    assert all("ph" in e for e in trace["traceEvents"])
+    asyncs = Counter((e["ph"], e["id"]) for e in trace["traceEvents"]
+                     if e["ph"] in ("b", "e"))
+    bs = sorted(i for (ph, i) in asyncs if ph == "b")
+    es = sorted(i for (ph, i) in asyncs if ph == "e")
+    assert bs == es == list(range(len(bs)))    # dense normalized ids
+    # replica processes are named
+    names = {e["args"]["name"] for e in trace["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert {"replica 0", "replica 1", "replica 2", "replica 3"} <= names
+
+
+def test_budget_exhausted_failed_still_gets_one_root_span(small_model):
+    """A fleet rid that dies with its replica and has NO resubmit budget
+    ends FAILED — and still closes exactly one root span."""
+    model, params = small_model
+    clock = ManualClock(tick_s=0.01)
+    plan = FleetFaultPlan(seed=0, clock=clock, kill_at={3: 0})
+    tracer = Tracer(time_fn=clock)
+
+    def mk(i, time_fn):
+        return make_engine(model, params, time_fn)
+
+    fleet = FleetRouter(mk, 1, heartbeat_s=0.05, resubmit_budget=0,
+                        faults=plan, tracer=tracer)
+    frid = fleet.submit([2, 3, 4, 5], max_tokens=8)
+    fleet.run(max_ticks=50)
+    assert fleet.status(frid) is RequestStatus.FAILED
+    per = _root_span_counts(tracer.events)
+    assert per == {("b", frid): 1, ("e", frid): 1}
+    end = next(e for e in tracer.events
+               if e.kind == "e" and e.name == "fleet_request")
+    assert end.args["status"] == "failed"
+    assert end.args["resubmits"] == 0
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: postmortem on a forced REF-LEAK
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_dumps_postmortem_on_ref_leak(small_model, dump_dir,
+                                                      capsys):
+    model, params = small_model
+    clk = ManualClock(tick_s=0.01)
+    tracer = Tracer(time_fn=clk)
+    eng = make_engine(model, params, clk, tracer=tracer)
+    rid = eng.submit([2, 3, 4, 5], max_tokens=3)
+    eng.run()
+    assert eng.status(rid) is RequestStatus.COMPLETED
+    # force a REF-LEAK: a page held by nobody the engine accounts for
+    eng.pool.alloc(1)
+    with pytest.raises(PageLeakError, match="REF-LEAK"):
+        eng.check_page_conservation()
+    path = tracer.last_postmortem
+    assert path is not None and Path(path).exists()
+    assert str(dump_dir) in path and "ref-leak" in Path(path).name
+    assert "OBS-POSTMORTEM: " + path in capsys.readouterr().out
+    payload = json.loads(Path(path).read_text())
+    assert payload["reason"] == "REF-LEAK"
+    names = {e["name"] for e in payload["events"]}
+    # the dump carries the history that produced the leak — including
+    # the rogue allocation itself
+    assert {"submit", "terminal", "page_alloc"} <= names
+    # the postmortem file round-trips through the exporter
+    evs = load_events(path)
+    assert json.loads(dumps_chrome(evs))["traceEvents"]
+    # once per reason per engine: a healthz probe of the still-leaky
+    # pool must not spray one dump per probe
+    assert not eng.healthz()["ok"]
+    assert tracer.last_postmortem == path
+    assert len(list(dump_dir.iterdir())) == 1
+
+
+# ---------------------------------------------------------------------------
+# obs off == zero overhead (sealed-auditor run, the acceptance pin)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def audit():
+    old = FLAGS.jit_audit
+    FLAGS.jit_audit = True
+    auditor().reset()
+    yield auditor()
+    FLAGS.jit_audit = old
+    auditor().reset()
+
+
+def _steady_traffic(eng, clock, n=6):
+    rids = [eng.submit([2, 3, 4, 5], max_tokens=4),
+            eng.submit([3, 4, 5, 6], max_tokens=4)]
+    eng.run()
+    for _ in range(n - 2):
+        rids.append(eng.submit([2, 3, 4, 5], max_tokens=4))
+        eng.run()
+    return [eng.result(r) for r in rids]
+
+
+def test_obs_off_adds_zero_compiles_to_sealed_decode(small_model, audit):
+    """FLAGS.obs_trace off: the engine runs on the NULL_TRACER, records
+    nothing, and a sealed steady-state decode stays at EXACTLY one
+    compile with zero retraces — the same budget the pre-obs engine
+    pinned.  Then the same traffic with tracing ON still holds the
+    budget and produces token-identical outputs: instrumentation adds
+    zero compiles and zero host syncs to the tick either way (the
+    linter's host-sync rule over obs/ proves the syncs side
+    statically)."""
+    model, params = small_model
+    assert not FLAGS.obs_trace
+    clk = ManualClock(tick_s=0.01)
+    eng = make_engine(model, params, clk, prefix_cache=False)
+    assert eng._tracer is NULL_TRACER
+    assert eng.pool.tracer is None and eng.scheduler.tracer is None
+    out_off = _steady_traffic(eng, clk)
+    audit.seal()
+    out_off += _steady_traffic(eng, clk)     # steady state: no compiles
+    audit.assert_budget("serving.decode", 1)
+    audit.assert_no_retraces()
+    assert NULL_TRACER.events == [] and len(NULL_TRACER.ring) == 0
+
+    auditor().reset()
+    clk2 = ManualClock(tick_s=0.01)
+    tracer = Tracer(time_fn=clk2)
+    eng2 = make_engine(model, params, clk2, prefix_cache=False,
+                       tracer=tracer)
+    out_on = _steady_traffic(eng2, clk2)
+    auditor().seal()
+    out_on += _steady_traffic(eng2, clk2)
+    auditor().assert_budget("serving.decode", 1)
+    auditor().assert_no_retraces()
+    assert out_on == out_off
+    assert any(e.name == "decode_tick" for e in tracer.events)
+
+
+def test_obs_trace_flag_gates_at_construction(small_model):
+    model, params = small_model
+    clk = ManualClock(tick_s=0.01)
+    old = FLAGS.obs_trace
+    try:
+        FLAGS.obs_trace = True
+        eng = make_engine(model, params, clk)
+        assert eng._tracer.enabled
+        rid = eng.submit([2, 3, 4], max_tokens=2)
+        eng.run()
+        assert eng.status(rid) is RequestStatus.COMPLETED
+        assert any(e.name == "decode_tick" for e in eng._tracer.events)
+    finally:
+        FLAGS.obs_trace = old
+
+
+# ---------------------------------------------------------------------------
+# jit_compile events via the retrace auditor
+# ---------------------------------------------------------------------------
+
+
+def test_auditor_compiles_land_on_the_timeline(small_model, audit):
+    model, params = small_model
+    clk = ManualClock(tick_s=0.01)
+    tracer = Tracer(time_fn=clk)
+    eng = make_engine(model, params, clk, tracer=tracer)
+    assert audit.tracer is tracer            # set_tracer attached it
+    eng.submit([2, 3, 4, 5], max_tokens=3)
+    eng.run()
+    sites = [e.args["site"] for e in tracer.events
+             if e.name == "jit_compile"]
+    assert "serving.decode" in sites
+    assert audit.compile_count("serving.decode") == \
+        sites.count("serving.decode")
+
+
+# ---------------------------------------------------------------------------
+# lease transitions on the timeline
+# ---------------------------------------------------------------------------
+
+
+def test_lease_table_transitions_traced():
+    clk = ManualClock(tick_s=0.0)
+    tracer = Tracer(time_fn=clk)
+    lt = LeaseTable(1.0, time_fn=clk, tracer=tracer)
+    slot, token = lt.register()
+    assert lt.heartbeat(slot, token)
+    clk.advance(2.0)                       # past TTL: expires on sweep
+    assert not lt.heartbeat(slot, token)   # zombie renewal rejected
+    slot2, token2 = lt.register()
+    assert lt.drop(slot2, token2)
+    names = [e.name for e in tracer.events]
+    assert names.count("lease_register") == 2
+    assert "lease_expire" in names and "lease_reject" in names
+    assert "lease_drop" in names
+    # tokens never reach the timeline
+    assert all(token not in str(e.args) and token2 not in str(e.args)
+               for e in tracer.events)
+
+
+# ---------------------------------------------------------------------------
+# trainer event bridge
+# ---------------------------------------------------------------------------
+
+
+def test_trainer_event_bridge_mirrors_events_as_spans():
+    clk = ManualClock(tick_s=0.0)
+    reg = MetricsRegistry()
+    tracer = Tracer(time_fn=clk, registry=reg)
+    seen = []
+    handler = trainer_event_bridge(tracer, seen.append)
+    handler(v2_event.BeginPass(0))
+    for b in range(3):
+        handler(v2_event.BeginIteration(0, b))
+        clk.advance(0.01)
+        handler(v2_event.EndIteration(0, b, cost=0.5))
+    handler(v2_event.EndPass(0))
+    assert len(seen) == 8                      # inner handler still runs
+    spans = [e for e in tracer.events if e.kind == "X"]
+    assert len(spans) == 3
+    assert all(e.name == "train_iteration" and
+               e.dur == pytest.approx(0.01) for e in spans)
+    roots = [(e.kind, e.id) for e in tracer.events
+             if e.name == "train_pass"]
+    assert roots == [("b", 0), ("e", 0)]
+    snap = reg.snapshot()
+    assert snap["train_iterations_total"] == 3
+    assert snap["train_passes_total"] == 1
+    # serving + training share one export pipeline
+    assert json.loads(dumps_chrome(tracer.events))["traceEvents"]
+
+
+def test_bridge_never_forces_the_lazy_cost_sync():
+    class Exploding:
+        """A device-scalar stand-in whose float() is the sync."""
+
+        def __float__(self):
+            raise AssertionError("bridge forced a host sync")
+
+    tracer = Tracer(time_fn=ManualClock())
+    handler = trainer_event_bridge(tracer)
+    handler(v2_event.BeginIteration(0, 0))
+    handler(v2_event.EndIteration(0, 0, cost=Exploding()))
+
+
+# ---------------------------------------------------------------------------
+# lint coverage over obs/ (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_lint_wall_clock_and_host_sync_cover_obs_dir():
+    wall = lint_source("import time\n\ndef f():\n    return time.time()\n",
+                       path="paddle_tpu/obs/bad.py", rules=["wall-clock"])
+    assert len(wall) == 1 and "wall-clock" in wall[0].code
+    sync = lint_source(
+        "import numpy as np\n\ndef f(xs):\n    for x in xs:\n"
+        "        np.asarray(x)\n",
+        path="paddle_tpu/obs/bad.py", rules=["host-sync"])
+    assert len(sync) == 1 and "host-sync" in sync[0].code
+    # ...and an unrelated dir still skips the dir-scoped rules
+    assert lint_source("import time\n\ndef f():\n    return time.time()\n",
+                       path="paddle_tpu/models/x.py",
+                       rules=["wall-clock"]) == []
+
+
+def test_obs_package_lints_clean():
+    obs_dir = Path(obs.__file__).resolve().parent
+    assert run_lint([str(obs_dir)]) == []
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def test_cli_export_jsonl_and_postmortem(tmp_path, capsys):
+    clk = ManualClock()
+    t = Tracer(time_fn=clk)
+    t.instant("submit", rid=1)
+    with t.span("decode_tick", tick=0):
+        clk.advance(0.01)
+    src = t.save(str(tmp_path / "events.jsonl"))
+    out = str(tmp_path / "trace.json")
+    assert obs_main(["export", src, "-o", out]) == 0
+    trace = json.loads(Path(out).read_text())
+    assert any(e.get("name") == "decode_tick"
+               for e in trace["traceEvents"])
+    pm = t.dump_postmortem("PAGE-LEAK", dump_dir=str(tmp_path))
+    out2 = str(tmp_path / "pm.json")
+    assert obs_main(["export", pm, "-o", out2]) == 0
+    assert json.loads(Path(out2).read_text())["traceEvents"]
+    assert obs_main([]) == 2
+    assert obs_main(["nope"]) == 2
+    # a trailing flag with no value falls back to the default instead of
+    # an IndexError traceback
+    assert obs_main(["export", src, "-o"]) == 0
+    capsys.readouterr()

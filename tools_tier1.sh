@@ -12,11 +12,20 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
     -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+# flight-recorder surfacing (paddle_tpu.obs): when a conservation
+# invariant trips with tracing on, the engine/fleet dumps the recent
+# event ring to a postmortem file and stamps its path into the log —
+# print those paths next to ANY ladder exit >= 3 so the leak report
+# arrives with the event history that produced it
+print_postmortems() {
+    grep -ao 'OBS-POSTMORTEM: .*' /tmp/_t1.log | sort -u
+}
 # the serving page-leak invariant checker stamps PAGE-LEAK into any
 # failure it raises: a leak anywhere in the suite is a loud, distinct
 # failure (exit 3), not one more red test to skim past
 if grep -aq 'PAGE-LEAK' /tmp/_t1.log; then
     echo 'PAGE-LEAK: serving free-list conservation violated (see log above)'
+    print_postmortems
     exit 3
 fi
 # same contract for the refcount invariant: a page reference that no
@@ -24,6 +33,7 @@ fi
 # prefix sharing, COW forks, preemption-unref or eviction went unbalanced
 if grep -aq 'REF-LEAK' /tmp/_t1.log; then
     echo 'REF-LEAK: serving page-refcount conservation violated (see log above)'
+    print_postmortems
     exit 4
 fi
 # repo-invariant linter (paddle_tpu.analysis.lint): wall-clock in
@@ -39,9 +49,11 @@ env JAX_PLATFORMS=cpu python -m paddle_tpu.analysis lint 2>&1 | tee -a /tmp/_t1.
 lint_rc=${PIPESTATUS[0]}
 if [ "$lint_rc" -eq 1 ]; then
     echo 'LINT-FAIL: repo-invariant lint findings (see log above)'
+    print_postmortems
     exit 5
 elif [ "$lint_rc" -ne 0 ]; then
     echo "LINT-FAIL: linter itself exited $lint_rc without running to completion"
+    print_postmortems
     exit 5
 fi
 # fleet conservation gate (paddle_tpu.serving.fleet): replays a seeded
@@ -57,9 +69,11 @@ env JAX_PLATFORMS=cpu python -c 'import sys; from paddle_tpu.serving.fleet impor
 fleet_rc=${PIPESTATUS[0]}
 if [ "$fleet_rc" -eq 1 ]; then
     echo 'FLEET-LEAK: serving-fleet conservation violated (see log above)'
+    print_postmortems
     exit 6
 elif [ "$fleet_rc" -ne 0 ]; then
     echo "FLEET-LEAK: fleet checker itself exited $fleet_rc without running to completion"
+    print_postmortems
     exit 6
 fi
 exit $rc
