@@ -32,7 +32,8 @@ Budget assertions for tests::
     ... run warmup traffic ...
     auditor().seal()                      # steady state begins
     ... run steady-state traffic ...
-    auditor().assert_budget("serving.decode", 1)   # one compile, ever
+    auditor().assert_budget("serving.step", 3)   # one compile per
+    #                                 (decode_bucket, prefill_bucket) pair
     auditor().assert_no_retraces()
 
 Assertion failures carry the literal token ``RETRACE`` so CI wrappers

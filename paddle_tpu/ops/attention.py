@@ -58,9 +58,19 @@ def mha_reference(q, k, v, segment_ids=None, kv_segment_ids=None,
 
     q: (B, Sq, H, D); k, v: (B, Sk, H, D); segment_ids: (B, Sq) int32,
     kv_segment_ids: (B, Sk).  Returns (B, Sq, H, D).
+
+    GQA: k/v may carry FEWER heads than q (H_kv dividing H) — each
+    group of H // H_kv query heads then attends over one shared KV head
+    (query head h reads KV head h // group).  The heads are replicated
+    here, so this stays the oracle for the serving kernel's head-group
+    packing.
     """
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
+    if k.shape[2] != q.shape[2]:
+        group = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * sm_scale
     mask = None

@@ -161,6 +161,18 @@ FLAGS.define("serving_prefill_chunk", 256,
              "smallest bucket >= C; a chunk above the top bucket rounds "
              "up and wastes the excess). 0 disables chunking "
              "(whole-prompt single-shot prefill).", parser=int)
+FLAGS.define("serving_kv_dtype", "float32",
+             "storage dtype of the paged KV pool: float32 | bfloat16 | "
+             "int8. bfloat16 halves and int8 roughly quarters the bytes "
+             "per page (int8 adds per-token, per-kv-head f32 scale "
+             "arrays — amax/127 symmetric quantization applied on every "
+             "write, dequantized in-register by the ragged attention "
+             "kernel and by the gather fallback, so the oracle and the "
+             "kernel read identical stored values). At a fixed pool "
+             "byte budget (ServingEngine(pool_bytes=...)) the smaller "
+             "dtypes admit proportionally more pages, which multiplies "
+             "prefix-cache capacity and admissible concurrency. "
+             "Per-engine override: ServingEngine(kv_dtype=...).")
 FLAGS.define("serving_queue_deadline_s", 0.0,
              "default per-request admission deadline: a request still "
              "queued this many seconds after submit is shed as TIMED_OUT "

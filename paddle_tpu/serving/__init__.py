@@ -8,7 +8,9 @@ control and preemption (``scheduler``), and the user-facing
 """
 
 from paddle_tpu.serving.decode_attention import (
-    paged_decode_attention, paged_decode_attention_reference)
+    BLOCK_ROWS, attention_path, paged_decode_attention,
+    paged_decode_attention_reference, ragged_paged_attention,
+    ragged_paged_attention_reference)
 from paddle_tpu.serving.engine import (DecodeModel, DecoderLM, ServingEngine,
                                        greedy_decode_reference)
 from paddle_tpu.serving.faults import (FaultPlan, FleetFaultPlan,
@@ -17,21 +19,28 @@ from paddle_tpu.serving.faults import (FaultPlan, FleetFaultPlan,
 from paddle_tpu.serving.fleet import FleetRouter, Replica, ReplicaState
 from paddle_tpu.serving.kv_cache import (NULL_PAGE, KVPages, PagedKVConfig,
                                          PagePool, PrefixCache, append_token,
-                                         fork_page, gather_kv, init_kv_pages,
-                                         prefix_chain_hashes, write_prompt)
+                                         dequantize_kv, fork_page, gather_kv,
+                                         init_kv_pages, pages_for_budget,
+                                         prefix_chain_hashes, quantize_kv,
+                                         resolve_kv_dtype, write_prompt)
 from paddle_tpu.serving.metrics import FleetMetrics, ServingMetrics
 from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
                                           Request, RequestStatus,
-                                          SchedulerConfig, bucket_for)
+                                          SchedulerConfig, bucket_for,
+                                          pack_prefill_chunks)
 
 __all__ = [
     "ServingEngine", "DecodeModel", "DecoderLM", "greedy_decode_reference",
     "paged_decode_attention", "paged_decode_attention_reference",
+    "ragged_paged_attention", "ragged_paged_attention_reference",
+    "attention_path", "BLOCK_ROWS",
     "PagedKVConfig", "KVPages", "PagePool", "PrefixCache", "NULL_PAGE",
     "init_kv_pages", "append_token", "write_prompt", "gather_kv",
-    "fork_page", "prefix_chain_hashes",
+    "fork_page", "prefix_chain_hashes", "quantize_kv", "dequantize_kv",
+    "pages_for_budget", "resolve_kv_dtype",
     "ContinuousBatchingScheduler", "Request", "RequestStatus",
-    "SchedulerConfig", "bucket_for", "ServingMetrics", "FleetMetrics",
+    "SchedulerConfig", "bucket_for", "pack_prefill_chunks",
+    "ServingMetrics", "FleetMetrics",
     "FaultPlan", "FleetFaultPlan", "ManualClock", "InjectedDeviceError",
     "PageLeakError",
     "FleetRouter", "Replica", "ReplicaState",

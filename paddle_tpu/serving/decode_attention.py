@@ -1,26 +1,50 @@
-"""Single-token decode attention over the paged KV cache.
+"""Ragged paged attention v2: ONE kernel for mixed prefill + decode.
 
-One query token per sequence attends over everything that sequence has
-cached, where the cache is scattered across non-contiguous pages (see
-``kv_cache.py``).  Two paths with identical semantics:
+The v1 kernel (rounds 5-9) was decode-only — grid ``(B, H, pages)``, one
+query row per sequence — and chunked prefill ran as a *separate*
+gather+offset-masked program interleaved at the tick level, so every
+tick with in-flight prefill paid two dispatches, two softmax passes over
+shared pages, and duplicate K/V HBM traffic per query head.  This
+rebuild (the headline kernel of arXiv 2604.15464) folds both into one
+ragged invocation:
 
-- **Pallas kernel** (``use_kernel=True`` or auto on TPU when the shape
-  allows): grid ``(batch, heads, pages-per-seq)`` with the page axis
-  streamed — the page table rides in as a *scalar-prefetch* operand
-  (``pltpu.PrefetchScalarGridSpec``) so the K/V BlockSpec index maps can
-  chase it and DMA exactly the pages each sequence owns, page j+1's
-  fetch overlapping page j's compute.  The online-softmax carry (m, l,
-  acc) lives in VMEM scratch across the page axis, the same pattern as
-  ``ops/attention.py``'s flash forward.  Pages past a sequence's length
-  are skipped with ``pl.when`` AND their index maps clamp to the last
-  live page, so the revisiting optimisation elides the dead DMAs (the
-  ragged-page-table trick of arXiv 2604.15464).
-- **Reference path** (the CPU/interpreter fallback and the test oracle):
-  ``gather_kv``-style linearization + ``ops.attention.mha_reference``
-  with length masking expressed as segment ids — no new math to trust.
+- **Sequence-packed rows.**  The query batch is a flat ``[T, H, D]`` row
+  stack: decode slots contribute one row each, in-flight prefill chunks
+  contribute up to ``serving_prefill_chunk`` rows each.  A row→sequence
+  map (``row_seq``) and a per-row absolute position (``qpos``, −1 for
+  padding) drive ONE causal/offset mask — ``token t is visible to the
+  row at position p iff t <= p`` — which subsumes decode length masking,
+  in-chunk causality, and cached-prefix offsets.
+- **Scalar-prefetched page tables.**  For the pallas path the rows are
+  packed into blocks of :data:`BLOCK_ROWS` with one sequence per block;
+  the per-block sequence id, the page tables, and the KV lengths ride in
+  as scalar-prefetch operands so the K/V BlockSpec index maps chase the
+  ragged page chain and DMA exactly the pages each block's sequence
+  owns, page j+1's fetch overlapping page j's compute.  Dead pages are
+  skipped with ``pl.when`` AND their index maps clamp to the last live
+  page, so the revisiting optimisation elides the dead DMAs.
+- **GQA head-group packing.**  The grid's head axis runs over KV heads,
+  not query heads: a block of ``BLOCK_ROWS * group`` query rows (group =
+  ``num_heads // num_kv_heads``) is packed against each K/V page load,
+  so K/V HBM traffic drops by the group factor — the pool stores KV
+  heads only.
+- **int8 pages, dequant in-register.**  Quantized pools ship per-token,
+  per-kv-head f32 scales next to the int8 pages; the kernel (and the
+  gather fallback — see ``kv_cache.dequantize_kv``, the ONE shared
+  rule) dequantizes in-register, so HBM reads stay 1 byte/element.
 
-Decode is bandwidth-bound (a [1, D] x [page, D] product per page), so
-the kernel's job is DMA shape, not MXU utilisation.
+Two paths with identical semantics, selected by :func:`attention_path`
+— the single dispatch gate every paged-attention call routes through:
+
+- **Pallas kernel**: grid ``(row_blocks, kv_heads, pages)``, online-
+  softmax carry (m, l, acc) in VMEM scratch across the page axis.
+- **Reference path** (CPU/interpreter fallback and the parity oracle):
+  page-table gather + masked softmax in f32 — no new math to trust,
+  reading the SAME stored (possibly quantized) values.
+
+Decode rows are bandwidth-bound (a [G, D] x [page, D] product per
+page), so the kernel's job there is DMA shape; prefill rows add real
+MXU work that v1 paid in a second dispatch.
 """
 
 from __future__ import annotations
@@ -36,49 +60,131 @@ from jax.experimental.pallas import tpu as pltpu
 from paddle_tpu.ops.attention import (DEFAULT_MASK_VALUE, _dim_semantics,
                                       mha_reference)
 from paddle_tpu.ops.kernel_util import interpret_default as _interpret_default
+from paddle_tpu.platform.enforce import enforce_that
+from paddle_tpu.serving.kv_cache import dequantize_kv, quantize_kv
 
-_LANES = 128  # lane width of the (1, _LANES) m/l scratch carries
+_LANES = 128     # lane width of the (rows, _LANES) m/l scratch carries
+BLOCK_ROWS = 8   # sublane row-block granularity of the sequence packing
+
+# the int8 parity harness's logit-error bound: attention output feeds
+# logits through bounded linear maps, so a relative output-error bound
+# IS a logit-error bound up to the model's Lipschitz constant.  The
+# per-token amax/127 scheme lands well under 2% on gaussian K/V; 5%
+# leaves slack for adversarial value distributions without letting a
+# broken quant path (wrong scale axis, missing dequant) slip through.
+QUANT_DRIFT_BOUND = 0.05
+
+
+# ---------------------------------------------------------------------------
+# Dispatch gate
+# ---------------------------------------------------------------------------
+
+def attention_path(head_dim: int, page_size: int, *,
+                   num_heads: Optional[int] = None,
+                   num_kv_heads: Optional[int] = None,
+                   quantized: bool = False,
+                   use_kernel: Optional[bool] = None,
+                   interpret: Optional[bool] = None) -> str:
+    """THE chooser: every paged-attention dispatch (ragged kernel,
+    decode wrapper, engine step builder) routes through this one gate,
+    so odd head dims / tiny pages / mismatched head groups fall back to
+    the reference path at a single point instead of per-call-site
+    guesswork.  Returns ``"kernel"`` or ``"reference"``.
+
+    Native-compile gate: the kernel's tiles are (page, D) and
+    (rows*group, D) — lane-aligned D and sublane-aligned pages avoid
+    relayouts on real hardware; int8 additionally wants lane-aligned
+    pages for its (page,) scale vectors.  ``use_kernel`` (not None)
+    forces the answer either way (tests run the kernel under
+    ``interpret=True``)."""
+    if use_kernel is not None:
+        return "kernel" if use_kernel else "reference"
+    if interpret is None:
+        interpret = _interpret_default()
+    if interpret:
+        return "reference"
+    if head_dim % _LANES != 0 or page_size % 8 != 0:
+        return "reference"
+    if quantized and page_size % _LANES != 0:
+        return "reference"
+    if num_heads and num_kv_heads and num_heads % num_kv_heads != 0:
+        return "reference"
+    return "kernel"
+
+
+def _kernel_shape_ok(head_dim: int, page_size: int) -> bool:
+    """Back-compat shim over :func:`attention_path` (v1 name)."""
+    return attention_path(head_dim, page_size, interpret=False) == "kernel"
 
 
 # ---------------------------------------------------------------------------
 # Reference path (oracle + CPU fallback)
 # ---------------------------------------------------------------------------
 
-def paged_decode_attention_reference(q, k_pages, v_pages, page_table,
-                                     lengths, sm_scale: Optional[float] = None):
-    """Gather-then-mask oracle.
+def ragged_paged_attention_reference(q, k_pages, v_pages, page_table,
+                                     kv_lens, row_seq, qpos, *,
+                                     k_scale=None, v_scale=None,
+                                     sm_scale: Optional[float] = None):
+    """Gather-then-mask oracle for the ragged kernel.
 
-    q: [B, H, D]; k_pages/v_pages: [num_pages, page, H, D] (ONE layer's
-    pool slice); page_table: [B, max_pages_per_seq] int32; lengths: [B]
-    int32 — the number of valid cached tokens per sequence (the query
-    attends over positions 0..len-1).  Returns [B, H, D].
+    q: [T, H, D] — the sequence-packed row stack (decode rows AND
+    prefill-chunk rows); k_pages/v_pages: [num_pages, page, H_kv, D]
+    (ONE layer's pool slice, possibly int8 with ``k_scale``/``v_scale``
+    [num_pages, page, H_kv]); page_table: [S, max_pages_per_seq] int32;
+    kv_lens: [S] int32 — valid cached tokens per sequence AFTER this
+    step's writes; row_seq: [T] int32 row→sequence map; qpos: [T] int32
+    per-row absolute position (−1 = padded row).  Returns [T, H, D].
 
-    Rows with length 0 return an arbitrary finite value (a fully-masked
-    softmax degenerates to uniform); the engine never reads them."""
-    b, pm = page_table.shape
-    _, page, h, d = k_pages.shape
-    k = k_pages[page_table].reshape(b, pm * page, h, d)
-    v = v_pages[page_table].reshape(b, pm * page, h, d)
-    pos = jnp.arange(pm * page, dtype=jnp.int32)[None, :]
-    kv_seg = jnp.where(pos < lengths[:, None], 0, 1).astype(jnp.int32)
-    q_seg = jnp.zeros((b, 1), jnp.int32)
-    out = mha_reference(q[:, None], k, v, segment_ids=q_seg,
-                        kv_segment_ids=kv_seg, sm_scale=sm_scale)
-    return out[:, 0]
+    Row r attends over tokens ``0..qpos[r]`` of sequence ``row_seq[r]``
+    — decode length masking, in-chunk causality and cached-prefix
+    offsets are all this one inequality.  Padded rows return an
+    arbitrary finite value (fully-masked softmax degenerates to
+    uniform); callers never read them."""
+    t, h, d = q.shape
+    _, page, kvh, _ = k_pages.shape
+    pm = page_table.shape[1]
+    if sm_scale is None:
+        sm_scale = float(d) ** -0.5
+    pt = page_table[row_seq]                       # [T, Pm]
+    k = k_pages[pt]                                # [T, Pm, page, KVH, D]
+    v = v_pages[pt]
+    if k_scale is not None:
+        k = dequantize_kv(k, k_scale[pt])
+        v = dequantize_kv(v, v_scale[pt])
+    k = k.reshape(t, pm * page, kvh, d).astype(jnp.float32)
+    v = v.reshape(t, pm * page, kvh, d).astype(jnp.float32)
+    if kvh != h:
+        k = jnp.repeat(k, h // kvh, axis=2)        # GQA head replication
+        v = jnp.repeat(v, h // kvh, axis=2)
+    tok = jnp.arange(pm * page, dtype=jnp.int32)
+    live = ((tok[None, :] <= qpos[:, None]) &
+            (tok[None, :] < kv_lens[row_seq][:, None]))
+    s = jnp.einsum("thd,tkhd->thk", q.astype(jnp.float32), k) * sm_scale
+    s = jnp.where(live[:, None, :], s, DEFAULT_MASK_VALUE)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("thk,tkhd->thd", p, v)
+    return out.astype(q.dtype)
 
 
 # ---------------------------------------------------------------------------
 # Pallas kernel
 # ---------------------------------------------------------------------------
 
-def _paged_decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                         m_scr, l_scr, acc_scr, *, page_size: int,
-                         num_pb: int, sm_scale: float):
-    # grid (B, H, pages-per-seq): the page axis is streamed; (m, l, acc)
-    # persist in VMEM scratch across it.  pt_ref/len_ref are the
-    # scalar-prefetched page table [B, Pm] and lengths [B] (SMEM).
-    # q_ref/o_ref: (1, 1, D); k_ref/v_ref: (1, 1, page, D).
-    b = pl.program_id(0)
+def _ragged_kernel(blk_seq_ref, pt_ref, len_ref, qpos_ref, q_ref, k_ref,
+                   v_ref, *rest, page_size: int, num_pb: int,
+                   sm_scale: float, quantized: bool):
+    # grid (row_blocks, kv_heads, pages-per-seq): the page axis is
+    # streamed; (m, l, acc) persist in VMEM scratch across it.
+    # blk_seq/pt/len are the scalar-prefetched block→sequence map [NB],
+    # page table [S, Pm] and KV lengths [S] (SMEM).  qpos_ref: (1, RBG)
+    # — per-score-row absolute positions, already group-expanded.
+    # q_ref/o_ref: (1, 1, RBG, D); k_ref/v_ref: (1, 1, page, D);
+    # quantized adds ks/vs (1, 1, page) scale rows.
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
+    ib = pl.program_id(0)
     j = pl.program_id(2)
 
     @pl.when(j == 0)
@@ -87,20 +193,25 @@ def _paged_decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    n = len_ref[b]
+    n = len_ref[blk_seq_ref[ib]]
     live = j * page_size < n
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0]                       # (1, D)
-        kb = k_ref[0, 0, :, :]             # (page, D)
-        vb = v_ref[0, 0, :, :]
+        q = q_ref[0, 0]                    # (RBG, D)
+        kb = k_ref[0, 0]                   # (page, D)
+        vb = v_ref[0, 0]
+        if quantized:
+            # in-register dequant: HBM traffic stays 1 byte/element
+            kb = kb.astype(jnp.float32) * ks_ref[0, 0][:, None]
+            vb = vb.astype(jnp.float32) * vs_ref[0, 0][:, None]
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        s = s * sm_scale                   # (1, page)
-        tok = j * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, (1, page_size), 1)
-        s = jnp.where(tok < n, s, DEFAULT_MASK_VALUE)
+        s = s * sm_scale                   # (RBG, page)
+        tok = j * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        # ONE inequality is the whole mask: causal for prefill rows,
+        # length for decode rows, everything for padded rows (qpos −1)
+        s = jnp.where(tok <= qpos_ref[0][:, None], s, DEFAULT_MASK_VALUE)
 
         m_prev = jnp.max(m_scr[...], axis=1, keepdims=True)
         l_prev = jnp.max(l_scr[...], axis=1, keepdims=True)
@@ -118,95 +229,289 @@ def _paged_decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
     def _finalize():
         l = jnp.max(l_scr[...], axis=1, keepdims=True)
         l = jnp.where(l == 0.0, 1.0, l)    # length-0 rows -> zeros, not NaN
-        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        o_ref[0, 0] = (acc_scr[...] / l).astype(o_ref.dtype)
 
 
-def _paged_decode_pallas(q, k_pages, v_pages, page_table, lengths, sm_scale,
-                         interpret: bool):
-    b, h, d = q.shape
-    _, page, _, _ = k_pages.shape
+def _ragged_pallas(q, k_pages, v_pages, k_scale, v_scale, page_table,
+                   kv_lens, row_seq, qpos, sm_scale, interpret: bool):
+    """Kernel-path entry.  REQUIRES block-uniform packing: T a multiple
+    of :data:`BLOCK_ROWS` and every aligned block of rows belonging to
+    ONE sequence (callers pad each sequence's rows to the block size —
+    decode slots to one block, chunks to whole blocks).  The block map
+    is read as ``row_seq[::BLOCK_ROWS]``; rows that violate uniformity
+    would silently attend over the wrong pages, so the engine owns the
+    packing and tests pin it against the reference path."""
+    t, h, d = q.shape
+    _, page, kvh, _ = k_pages.shape
     pm = page_table.shape[1]
-    # [P, page, H, D] -> [H, P, page, D]: per-head pages are contiguous
-    # blocks the index map can address as (h, page_id, 0, 0)
+    enforce_that(t % BLOCK_ROWS == 0,
+                 f"ragged kernel rows ({t}) must pack to BLOCK_ROWS "
+                 f"({BLOCK_ROWS})", context="serving")
+    enforce_that(h % kvh == 0, f"num_heads ({h}) must be a multiple of "
+                 f"num_kv_heads ({kvh})", context="serving")
+    g = h // kvh
+    nb = t // BLOCK_ROWS
+    rbg = BLOCK_ROWS * g
+    quantized = k_scale is not None
+
+    blk_seq = row_seq.reshape(nb, BLOCK_ROWS)[:, 0].astype(jnp.int32)
+    qpos_rows = jnp.repeat(qpos.astype(jnp.int32).reshape(nb, BLOCK_ROWS),
+                           g, axis=1)                     # (NB, RBG)
+    # [T, H, D] -> [KVH, NB, RB*G, D]: each block packs its G query
+    # heads per KV head next to each other, so one K/V page load feeds
+    # the whole head group
+    q5 = q.reshape(nb, BLOCK_ROWS, kvh, g, d).transpose(2, 0, 1, 3, 4)
+    q5 = q5.reshape(kvh, nb, rbg, d)
+    # [P, page, KVH, D] -> [KVH, P, page, D]: per-kv-head pages are
+    # contiguous blocks the index map can address as (h, page_id, 0, 0)
     kt = k_pages.transpose(2, 0, 1, 3)
     vt = v_pages.transpose(2, 0, 1, 3)
     pt = page_table.astype(jnp.int32)
-    ln = lengths.astype(jnp.int32)
+    ln = kv_lens.astype(jnp.int32)
 
-    def kv_idx(bi, hi, j, pt_ref, len_ref):
-        # clamp dead pages (j past the sequence's last live page) to the
-        # last live one so their DMA is elided by revisiting; pl.when
-        # skips their compute.  max(len-1, 0) keeps length-0 rows legal.
-        last = jnp.maximum(len_ref[bi] - 1, 0) // page
-        return (hi, pt_ref[bi, jnp.minimum(j, last)], 0, 0)
+    def qpos_idx(ib, hi, j, blk_ref, pt_ref, len_ref):
+        return (ib, 0)
+
+    def q_idx(ib, hi, j, blk_ref, pt_ref, len_ref):
+        return (hi, ib, 0, 0)
+
+    def kv_idx(ib, hi, j, blk_ref, pt_ref, len_ref):
+        # clamp dead pages (j past the block's sequence's last live
+        # page) to the last live one so their DMA is elided by
+        # revisiting; pl.when skips their compute.  max(len-1, 0) keeps
+        # length-0 sequences legal.
+        seq = blk_ref[ib]
+        last = jnp.maximum(len_ref[seq] - 1, 0) // page
+        return (hi, pt_ref[seq, jnp.minimum(j, last)], 0, 0)
+
+    def scale_idx(ib, hi, j, blk_ref, pt_ref, len_ref):
+        seq = blk_ref[ib]
+        last = jnp.maximum(len_ref[seq] - 1, 0) // page
+        return (hi, pt_ref[seq, jnp.minimum(j, last)], 0)
+
+    in_specs = [
+        pl.BlockSpec((1, rbg), qpos_idx),
+        pl.BlockSpec((1, 1, rbg, d), q_idx),
+        pl.BlockSpec((1, 1, page, d), kv_idx),
+        pl.BlockSpec((1, 1, page, d), kv_idx),
+    ]
+    args = [qpos_rows, q5, kt, vt]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, 1, page), scale_idx),
+                     pl.BlockSpec((1, 1, page), scale_idx)]
+        args += [k_scale.transpose(2, 0, 1), v_scale.transpose(2, 0, 1)]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(b, h, pm),
-        in_specs=[
-            pl.BlockSpec((1, 1, d), lambda bi, hi, j, pt_ref, len_ref:
-                         (bi, hi, 0)),
-            pl.BlockSpec((1, 1, page, d), kv_idx),
-            pl.BlockSpec((1, 1, page, d), kv_idx),
-        ],
-        out_specs=pl.BlockSpec((1, 1, d), lambda bi, hi, j, pt_ref, len_ref:
-                               (bi, hi, 0)),
+        num_scalar_prefetch=3,
+        grid=(nb, kvh, pm),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, rbg, d), q_idx),
         scratch_shapes=[
-            pltpu.VMEM((1, _LANES), jnp.float32),
-            pltpu.VMEM((1, _LANES), jnp.float32),
-            pltpu.VMEM((1, d), jnp.float32),
+            pltpu.VMEM((rbg, _LANES), jnp.float32),
+            pltpu.VMEM((rbg, _LANES), jnp.float32),
+            pltpu.VMEM((rbg, d), jnp.float32),
         ],
     )
-    kernel = functools.partial(_paged_decode_kernel, page_size=page,
-                               num_pb=pm, sm_scale=sm_scale)
+    kernel = functools.partial(_ragged_kernel, page_size=page, num_pb=pm,
+                               sm_scale=sm_scale, quantized=quantized)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((kvh, nb, rbg, d), q.dtype),
         compiler_params=_dim_semantics(3, interpret),
         interpret=interpret,
-    )(pt, ln, q, kt, vt)
-    return out
-
-
-def _kernel_shape_ok(head_dim: int, page_size: int) -> bool:
-    """Native-compile gate: the kernel's tiles are (page, D) and (1, D);
-    lane-aligned D and sublane-aligned pages avoid relayouts on real
-    hardware.  Anything else rides the reference path (still correct)."""
-    return head_dim % _LANES == 0 and page_size % 8 == 0
+    )(blk_seq, pt, ln, *args)
+    out = out.reshape(kvh, nb, BLOCK_ROWS, g, d).transpose(1, 2, 0, 3, 4)
+    return out.reshape(t, h, d)
 
 
 # ---------------------------------------------------------------------------
 # Public API
 # ---------------------------------------------------------------------------
 
-def paged_decode_attention(q, k_pages, v_pages, page_table, lengths, *,
+def ragged_paged_attention(q, k_pages, v_pages, page_table, kv_lens,
+                           row_seq, qpos, *, k_scale=None, v_scale=None,
                            sm_scale: Optional[float] = None,
                            use_kernel: Optional[bool] = None,
                            interpret: Optional[bool] = None):
-    """Decode-step attention over a paged KV cache.
+    """Ragged paged attention over a sequence-packed mixed batch (see
+    :func:`ragged_paged_attention_reference` for shapes/semantics).
 
-    q: [B, H, D] — this tick's single query token per sequence (its K/V
-    already appended, so ``lengths`` INCLUDES it); k_pages/v_pages:
-    [num_pages, page, H, D]; page_table: [B, max_pages_per_seq] int32;
-    lengths: [B] int32.  Returns [B, H, D] in q's dtype.
-
-    ``use_kernel=None`` auto-selects: the pallas kernel on TPU when the
-    shape is lane/sublane aligned, otherwise the ``mha_reference``-based
-    path (which is also the CPU/interpreter-mode fallback — the kernel
-    itself runs under ``interpret=True`` only when forced, for tests)."""
+    ``use_kernel=None`` auto-selects through :func:`attention_path`; the
+    kernel additionally requires block-uniform :data:`BLOCK_ROWS`
+    packing (the engine's packer guarantees it), falling back to the
+    reference path otherwise."""
     if sm_scale is None:
         sm_scale = float(q.shape[-1]) ** -0.5
     if interpret is None:
         interpret = _interpret_default()
-    if use_kernel is None:
-        use_kernel = (not interpret) and _kernel_shape_ok(
-            q.shape[-1], k_pages.shape[1])
-    if not use_kernel:
+    path = attention_path(q.shape[-1], k_pages.shape[1],
+                          num_heads=q.shape[1],
+                          num_kv_heads=k_pages.shape[2],
+                          quantized=k_scale is not None,
+                          use_kernel=use_kernel, interpret=interpret)
+    if path == "kernel" and q.shape[0] % BLOCK_ROWS == 0:
+        return _ragged_pallas(q, k_pages, v_pages, k_scale, v_scale,
+                              page_table.astype(jnp.int32),
+                              kv_lens.astype(jnp.int32),
+                              row_seq.astype(jnp.int32),
+                              qpos.astype(jnp.int32),
+                              float(sm_scale), bool(interpret))
+    return _ragged_reference_blocked(
+        q, k_pages, v_pages, page_table, kv_lens, row_seq, qpos,
+        k_scale=k_scale, v_scale=v_scale, sm_scale=sm_scale)
+
+
+_REF_ROW_BLOCK = 64   # fallback row-block: bounds the per-row K/V gather
+
+
+def _ragged_reference_blocked(q, k_pages, v_pages, page_table, kv_lens,
+                              row_seq, qpos, k_scale=None, v_scale=None,
+                              sm_scale=None, block: int = _REF_ROW_BLOCK):
+    """The reference path evaluated in row blocks.  The dumb oracle
+    gathers each row's whole page chain ([T, Pm, page, H_kv, D]) — fine
+    for tests, but as the ENGINE's fallback a 256-row prefill chunk
+    would materialize 256 copies of its sequence's K/V where v1's chunk
+    program shared one.  Mapping the oracle over fixed row blocks
+    bounds the transient to ``block`` copies with identical results
+    (rows are independent); the pallas path owns big shapes, this owns
+    big-ish fallbacks."""
+    t = q.shape[0]
+    if t <= block:
+        return ragged_paged_attention_reference(
+            q, k_pages, v_pages, page_table, kv_lens, row_seq, qpos,
+            k_scale=k_scale, v_scale=v_scale, sm_scale=sm_scale)
+    pad = (-t) % block
+    qp_ = jnp.concatenate([q, jnp.zeros((pad,) + q.shape[1:], q.dtype)]) \
+        if pad else q
+    rs_ = jnp.concatenate([row_seq, jnp.zeros((pad,), row_seq.dtype)]) \
+        if pad else row_seq
+    pp_ = jnp.concatenate([qpos, jnp.full((pad,), -1, qpos.dtype)]) \
+        if pad else qpos
+
+    def body(args):
+        qb, rb, pb = args
+        return ragged_paged_attention_reference(
+            qb, k_pages, v_pages, page_table, kv_lens, rb, pb,
+            k_scale=k_scale, v_scale=v_scale, sm_scale=sm_scale)
+
+    h, d = q.shape[1], q.shape[2]
+    out = jax.lax.map(body, (qp_.reshape(-1, block, h, d),
+                             rs_.reshape(-1, block),
+                             pp_.reshape(-1, block)))
+    return out.reshape(-1, h, d)[:t]
+
+
+def quant_parity_error(q, k_pages, v_pages, page_table, kv_lens, row_seq,
+                       qpos, *, sm_scale: Optional[float] = None) -> float:
+    """The int8 parity harness: max relative error the quantization
+    adds to ragged attention output, measured f32-pages vs the SAME
+    pages int8-roundtripped through :func:`~kv_cache.quantize_kv` (the
+    identical write path the engine uses).  Padded rows are excluded.
+    Host-syncs by design — this is a test/CI harness, not a tick op."""
+    import numpy as np
+    out32 = np.asarray(ragged_paged_attention_reference(
+        q, k_pages, v_pages, page_table, kv_lens, row_seq, qpos,
+        sm_scale=sm_scale))
+    kq, ks = quantize_kv(k_pages)
+    vq, vs = quantize_kv(v_pages)
+    out8 = np.asarray(ragged_paged_attention_reference(
+        q, kq, vq, page_table, kv_lens, row_seq, qpos,
+        k_scale=ks, v_scale=vs, sm_scale=sm_scale))
+    real = np.asarray(qpos) >= 0
+    denom = max(float(np.abs(out32[real]).max()), 1e-20)
+    return float(np.abs(out8[real] - out32[real]).max()) / denom
+
+
+def check_quant_drift(q, k_pages, v_pages, page_table, kv_lens, row_seq,
+                      qpos, *, bound: float = QUANT_DRIFT_BOUND,
+                      sm_scale: Optional[float] = None) -> float:
+    """Assert the harness error stays under ``bound``; the failure
+    message carries the literal ``QUANT-DRIFT`` tag tools_tier1.sh
+    greps into its exit-code ladder (exit 7), so a quantization
+    regression anywhere in the suite is a loud, distinct failure."""
+    err = quant_parity_error(q, k_pages, v_pages, page_table, kv_lens,
+                             row_seq, qpos, sm_scale=sm_scale)
+    if err > bound:
+        raise AssertionError(
+            f"QUANT-DRIFT: int8 KV parity error {err:.4f} exceeds the "
+            f"logit-error bound {bound:.4f}")
+    return err
+
+
+# ---------------------------------------------------------------------------
+# Decode-only wrappers (v1 API, now thin views over the ragged paths)
+# ---------------------------------------------------------------------------
+
+def paged_decode_attention_reference(q, k_pages, v_pages, page_table,
+                                     lengths, sm_scale: Optional[float]
+                                     = None, *, k_scale=None, v_scale=None):
+    """Decode-only oracle: one row per sequence at position len-1.
+
+    q: [B, H, D]; k_pages/v_pages: [num_pages, page, H_kv, D];
+    page_table: [B, max_pages_per_seq] int32; lengths: [B] int32 (the
+    query's K/V already appended, so lengths INCLUDES it).  Rows with
+    length 0 return an arbitrary finite value; the engine never reads
+    them."""
+    b = q.shape[0]
+    row_seq = jnp.arange(b, dtype=jnp.int32)
+    lengths = lengths.astype(jnp.int32)
+    return ragged_paged_attention_reference(
+        q, k_pages, v_pages, page_table, lengths, row_seq, lengths - 1,
+        k_scale=k_scale, v_scale=v_scale, sm_scale=sm_scale)
+
+
+def expand_decode_rows(q, qpos):
+    """Pad one-row-per-sequence decode queries to one :data:`BLOCK_ROWS`
+    block each — THE one copy of the kernel's one-sequence-per-block
+    packing for decode rows (the decode wrapper and the engine's
+    unified step both build on it, so the contract can't silently fork).
+    Rows 0 mod BLOCK_ROWS are real, the rest padding (qpos −1).
+    Returns ``(q_expanded, row_seq, qpos_expanded)``; sequence ``i`` is
+    row block ``i``, so callers slice results back with
+    ``[::BLOCK_ROWS]``."""
+    b, h, d = q.shape
+    t = b * BLOCK_ROWS
+    qe = jnp.zeros((t, h, d), q.dtype).at[::BLOCK_ROWS].set(q)
+    row_seq = jnp.repeat(jnp.arange(b, dtype=jnp.int32), BLOCK_ROWS)
+    qp = jnp.full((t,), -1, jnp.int32).at[::BLOCK_ROWS].set(
+        qpos.astype(jnp.int32))
+    return qe, row_seq, qp
+
+
+def _paged_decode_pallas(q, k_pages, v_pages, page_table, lengths, sm_scale,
+                         interpret: bool, k_scale=None, v_scale=None):
+    qe, row_seq, qpos = expand_decode_rows(q, lengths.astype(jnp.int32) - 1)
+    out = _ragged_pallas(qe, k_pages, v_pages, k_scale, v_scale,
+                         page_table.astype(jnp.int32),
+                         lengths.astype(jnp.int32), row_seq, qpos,
+                         float(sm_scale), bool(interpret))
+    return out[::BLOCK_ROWS]
+
+
+def paged_decode_attention(q, k_pages, v_pages, page_table, lengths, *,
+                           sm_scale: Optional[float] = None,
+                           use_kernel: Optional[bool] = None,
+                           interpret: Optional[bool] = None,
+                           k_scale=None, v_scale=None):
+    """Decode-step attention over a paged KV cache (v1 entry point,
+    kept for callers that only ever decode).  Dispatch routes through
+    :func:`attention_path` like everything else."""
+    if sm_scale is None:
+        sm_scale = float(q.shape[-1]) ** -0.5
+    if interpret is None:
+        interpret = _interpret_default()
+    path = attention_path(q.shape[-1], k_pages.shape[1],
+                          num_heads=q.shape[1],
+                          num_kv_heads=k_pages.shape[2],
+                          quantized=k_scale is not None,
+                          use_kernel=use_kernel, interpret=interpret)
+    if path != "kernel":
         return paged_decode_attention_reference(
-            q, k_pages, v_pages, page_table, lengths,
-            sm_scale=sm_scale).astype(q.dtype)
+            q, k_pages, v_pages, page_table, lengths, sm_scale=sm_scale,
+            k_scale=k_scale, v_scale=v_scale).astype(q.dtype)
     return _paged_decode_pallas(q, k_pages, v_pages,
                                 page_table.astype(jnp.int32),
                                 lengths.astype(jnp.int32),
-                                float(sm_scale), bool(interpret))
+                                float(sm_scale), bool(interpret),
+                                k_scale=k_scale, v_scale=v_scale)

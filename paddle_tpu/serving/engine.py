@@ -14,17 +14,19 @@ Usage::
     eng.metrics.snapshot()       # tokens/s, TTFT, SLO counters, ...
     eng.healthz()                # liveness/conservation snapshot
 
-The engine owns exactly two compiled functions:
-
-- a **bucketed prefill** (one jit specialization per padded length in
-  the bucket ladder): full causal self-attention over the prompt —
-  through ``ops.attention.flash_attention`` when the bucket is
-  kernel-shaped, ``mha_reference`` otherwise — that writes the prompt's
-  K/V into the request's pages and emits the first token from the
-  last-position logits;
-- a **fused decode step** over ALL running sequences per tick: embed the
-  last emitted tokens, append their K/V into each sequence's current
-  page, and attend over the paged cache (``paged_decode_attention``).
+The engine owns exactly ONE compiled tick function family (round 12):
+the **unified step**, jitted once per ``(decode_bucket,
+prefill_bucket)`` pair — the decode bucket is the fixed ``max_slots``
+row count, the prefill bucket the padded total of this tick's packed
+prefill-chunk rows (0 on decode-only ticks).  One dispatch embeds the
+tick's decode tokens AND every in-flight prefill chunk, scatters all
+their K/V into pages (quantizing on write when the pool is int8 — see
+``FLAGS.serving_kv_dtype``), and runs ONE ragged paged attention
+(``ragged_paged_attention``: sequence-packed rows, GQA head-group
+packing, in-register dequant) over the whole mixed batch — where the
+v1 engine paid two dispatches and two softmax passes per tick with
+in-flight prefill.  ``fuse_tick=False`` keeps the v1 two-dispatch
+shape as a bench control (same math, token-identical).
 
 Decoding is greedy (argmax) — the deterministic contract the parity
 tests pin; sampling policies layer on top later.
@@ -50,8 +52,9 @@ refcount-shared (charged zero new pages), the tail prefills with its
 positions offset by the cached length, and a full-cover hit
 copy-on-write-forks the last shared page and recomputes only the final
 token.  Prompts longer than ``FLAGS.serving_prefill_chunk`` prefill one
-chunk per tick, interleaved with the fused decode step, so a long
-prompt in the queue no longer degrades running slots' latency.
+chunk per tick — since round 12 riding the SAME unified dispatch as the
+decode rows rather than a second one — so a long prompt in the queue
+no longer degrades running slots' latency.
 
 The model plugs in through the small :class:`DecodeModel` contract
 rather than a ``Topology``: serving needs per-layer access to Q/K/V
@@ -75,20 +78,23 @@ import numpy as np
 from paddle_tpu.analysis.retrace import audit_jit, auditor
 from paddle_tpu.obs.registry import MetricsRegistry
 from paddle_tpu.obs.trace import NULL_TRACER, tracer_for
-from paddle_tpu.ops.attention import (DEFAULT_MASK_VALUE, flash_attention,
-                                      mha_reference)
+from paddle_tpu.ops.attention import mha_reference
 from paddle_tpu.platform.flags import FLAGS
-from paddle_tpu.serving.decode_attention import paged_decode_attention
+from paddle_tpu.serving.decode_attention import (
+    BLOCK_ROWS, _ragged_reference_blocked, attention_path,
+    expand_decode_rows, ragged_paged_attention)
 from paddle_tpu.serving.faults import (FaultPlan, InjectedDeviceError,
                                        PageLeakError)
 from paddle_tpu.serving.kv_cache import (NULL_PAGE, KVPages, PagedKVConfig,
                                          PagePool, PrefixCache, append_token,
-                                         fork_page, gather_kv, init_kv_pages,
-                                         write_prompt, zero_pages)
+                                         fork_page, init_kv_pages,
+                                         pages_for_budget, resolve_kv_dtype,
+                                         zero_pages)
 from paddle_tpu.serving.metrics import ServingMetrics
 from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
                                           Request, RequestStatus,
-                                          SchedulerConfig, bucket_for)
+                                          SchedulerConfig, bucket_for,
+                                          pack_prefill_chunks)
 
 __all__ = ["DecodeModel", "DecoderLM", "ServingEngine",
            "greedy_decode_reference"]
@@ -100,8 +106,14 @@ class DecodeModel:
     over leading batch/sequence dims:
 
     - ``num_layers``, ``num_heads``, ``head_dim``, ``vocab_size``
+    - ``num_kv_heads`` (optional, defaults to ``num_heads``): GQA — K/V
+      carry this many heads (``<= num_heads``, dividing it); query head
+      ``h`` reads KV head ``h // (num_heads // num_kv_heads)``.  The
+      paged pool stores KV heads only and the ragged kernel loads each
+      K/V page once per head GROUP instead of once per query head.
     - ``embed(params, tokens, positions) -> [..., E]``
-    - ``qkv(params, layer, x) -> (q, k, v)`` each ``[..., H, D]``
+    - ``qkv(params, layer, x) -> (q, k, v)`` — q ``[..., H, D]``, k/v
+      ``[..., H_kv, D]``
     - ``attn_out(params, layer, ctx, x) -> [..., E]`` — attention output
       ``ctx`` [..., H, D] combined with the residual stream ``x``
       (projection, residual, FFN — whatever the architecture does after
@@ -113,6 +125,7 @@ class DecodeModel:
     num_heads: int
     head_dim: int
     vocab_size: int
+    num_kv_heads: int  # optional on duck-typed models (= num_heads)
 
 
 def _rms(x, eps: float = 1e-6):
@@ -128,17 +141,24 @@ class DecoderLM(DecodeModel):
 
     def __init__(self, vocab_size: int, num_layers: int = 2,
                  num_heads: int = 2, head_dim: int = 16,
-                 ffn_mult: int = 4, max_positions: int = 1024):
+                 ffn_mult: int = 4, max_positions: int = 1024,
+                 num_kv_heads: Optional[int] = None):
         self.vocab_size = vocab_size
         self.num_layers = num_layers
         self.num_heads = num_heads
+        self.num_kv_heads = int(num_kv_heads or num_heads)
+        if num_heads % self.num_kv_heads != 0:
+            raise ValueError(f"num_kv_heads ({self.num_kv_heads}) must "
+                             f"divide num_heads ({num_heads})")
         self.head_dim = head_dim
         self.embed_dim = num_heads * head_dim
+        self.kv_dim = self.num_kv_heads * head_dim
         self.ffn_dim = ffn_mult * self.embed_dim
         self.max_positions = max_positions
 
     def init_params(self, key) -> Dict[str, jax.Array]:
         e, f, v = self.embed_dim, self.ffn_dim, self.vocab_size
+        kv = self.kv_dim
         keys = jax.random.split(key, 2 + 6 * self.num_layers + 1)
         ki = iter(keys)
 
@@ -149,8 +169,8 @@ class DecoderLM(DecodeModel):
                                                   0.02)}
         for l in range(self.num_layers):
             p[f"l{l}.wq"] = mat((e, e), e ** -0.5)
-            p[f"l{l}.wk"] = mat((e, e), e ** -0.5)
-            p[f"l{l}.wv"] = mat((e, e), e ** -0.5)
+            p[f"l{l}.wk"] = mat((e, kv), e ** -0.5)
+            p[f"l{l}.wv"] = mat((e, kv), e ** -0.5)
             p[f"l{l}.wo"] = mat((e, e), e ** -0.5)
             p[f"l{l}.w1"] = mat((e, f), e ** -0.5)
             p[f"l{l}.w2"] = mat((f, e), f ** -0.5)
@@ -161,12 +181,11 @@ class DecoderLM(DecodeModel):
         return params["emb"][tokens] + params["pos"][positions]
 
     def qkv(self, params, layer, x):
-        h, d = self.num_heads, self.head_dim
+        h, kvh, d = self.num_heads, self.num_kv_heads, self.head_dim
         xn = _rms(x)
-        shape = x.shape[:-1] + (h, d)
-        q = (xn @ params[f"l{layer}.wq"]).reshape(shape)
-        k = (xn @ params[f"l{layer}.wk"]).reshape(shape)
-        v = (xn @ params[f"l{layer}.wv"]).reshape(shape)
+        q = (xn @ params[f"l{layer}.wq"]).reshape(x.shape[:-1] + (h, d))
+        k = (xn @ params[f"l{layer}.wk"]).reshape(x.shape[:-1] + (kvh, d))
+        v = (xn @ params[f"l{layer}.wv"]).reshape(x.shape[:-1] + (kvh, d))
         return q, k, v
 
     def attn_out(self, params, layer, ctx, x):
@@ -219,7 +238,9 @@ class ServingEngine:
                  max_slots: Optional[int] = None,
                  buckets: Optional[Sequence[int]] = None,
                  max_queue: Optional[int] = None,
-                 dtype=jnp.float32,
+                 dtype=None, kv_dtype=None,
+                 pool_bytes: Optional[int] = None,
+                 fuse_tick: bool = True,
                  use_kernel: Optional[bool] = None,
                  queue_deadline_s: Optional[float] = None,
                  preempt_budget: Optional[int] = None,
@@ -236,8 +257,24 @@ class ServingEngine:
         self.params = params
         self.eos_id = int(eos_id)
         page_size = int(page_size or FLAGS.serving_page_size)
-        num_pages = int(num_pages or FLAGS.serving_max_pages)
         max_slots = int(max_slots or FLAGS.serving_max_slots)
+        # KV storage dtype: explicit kv_dtype > legacy dtype param >
+        # FLAGS.serving_kv_dtype.  int8 turns on quantized pages.
+        if kv_dtype is None:
+            kv_dtype = dtype if dtype is not None else FLAGS.serving_kv_dtype
+        kv_dtype = resolve_kv_dtype(kv_dtype)
+        num_kv_heads = int(getattr(model, "num_kv_heads", 0)
+                           or model.num_heads)
+        if num_pages is None and pool_bytes is not None:
+            # size the pool by BYTES: smaller KV dtypes admit
+            # proportionally more pages, which the scheduler charges
+            # against — int8's doubled-and-more page budget is exactly
+            # this arithmetic
+            num_pages = pages_for_budget(
+                pool_bytes, model.num_layers, model.num_heads,
+                model.head_dim, page_size, kv_dtype,
+                num_kv_heads=num_kv_heads)
+        num_pages = int(num_pages or FLAGS.serving_max_pages)
         if max_pages_per_seq is None:
             # default: one sequence may claim up to half the usable pool
             max_pages_per_seq = max(1, (num_pages - 1) // 2)
@@ -270,7 +307,7 @@ class ServingEngine:
             num_layers=model.num_layers, num_heads=model.num_heads,
             head_dim=model.head_dim, page_size=page_size,
             num_pages=num_pages, max_pages_per_seq=int(max_pages_per_seq),
-            dtype=dtype)
+            dtype=kv_dtype, num_kv_heads=num_kv_heads)
         self._kv: KVPages = init_kv_pages(self.kv_cfg)
         self.pool = PagePool(num_pages)
         if prefix_cache is None:
@@ -302,10 +339,31 @@ class ServingEngine:
         self._postmortems_dumped: set = set()
         self.set_tracer(tracer if tracer is not None
                         else tracer_for(self._time, registry=self.registry))
-        self._use_kernel = use_kernel
+        # dispatch path, decided ONCE through the single chooser (the
+        # per-call decision of v1 is gone): kernel iff the shapes are
+        # native-compile-clean on this backend, or forced by the caller
+        if use_kernel is None:
+            self._ragged_kernel = attention_path(
+                self.kv_cfg.head_dim, self.kv_cfg.page_size,
+                num_heads=self.kv_cfg.num_heads,
+                num_kv_heads=self.kv_cfg.kv_heads,
+                quantized=self.kv_cfg.quantized) == "kernel"
+        else:
+            self._ragged_kernel = bool(use_kernel)
         self._buckets = tuple(sorted(int(b) for b in buckets)) if buckets \
             else _parse_buckets(FLAGS.serving_prefill_buckets)
         self._max_slots = max_slots
+        self._fuse_tick = bool(fuse_tick)
+        # prefill-row packing: the kernel needs each sequence's rows
+        # padded to whole BLOCK_ROWS blocks; the per-tick row budget
+        # bounds the (decode_bucket, prefill_bucket) jit-pair ladder
+        self._row_align = BLOCK_ROWS if self._ragged_kernel else 1
+        top = max(self._buckets) if self._buckets else \
+            self.kv_cfg.max_seq_len
+        chunk_rows = self._prefill_chunk if self._prefill_chunk > 0 \
+            else self.kv_cfg.max_seq_len
+        chunk_rows = -(-chunk_rows // self._row_align) * self._row_align
+        self._prefill_budget = max(top, chunk_rows)
         # donate the incoming KV pool: every call overwrites self._kv
         # with the returned pool, so XLA may update pages in place —
         # without this the decode tick copies the whole pool and peak
@@ -314,11 +372,11 @@ class ServingEngine:
         self._donate_kv = (1,) if jax.default_backend() != "cpu" else ()
         # audit_jit == jax.jit unless FLAGS.jit_audit is on, in which
         # case each named site's compiles are counted by the retrace
-        # auditor (paddle_tpu.analysis.retrace): the fused decode step
-        # must compile exactly once, prefill once per bucket shape
-        self._decode_fn = audit_jit(self._build_decode_fn(),
-                                    site="serving.decode",
-                                    donate_argnums=self._donate_kv)
+        # auditor (paddle_tpu.analysis.retrace): the unified step must
+        # compile exactly once per (decode_bucket, prefill_bucket) pair
+        # — decode_bucket is the fixed max_slots row count, so the pair
+        # ladder is one entry per prefill bucket plus the decode-only 0
+        self._step_fns: Dict[int, Callable] = {}
         # COW fork + failure scrub: kv is argument 0 in both (same
         # donation gate as above)
         self._fork_fn = audit_jit(
@@ -327,8 +385,6 @@ class ServingEngine:
         self._zero_fn = audit_jit(
             zero_pages, site="serving.zero_pages",
             donate_argnums=(0,) if self._donate_kv else ())
-        self._prefill_fns: Dict[int, Callable] = {}
-        self._chunk_fns: Dict[int, Callable] = {}
         self._results: Dict[int, List[int]] = {}
         self._requests: Dict[int, Request] = {}
         # terminal rids in retirement order; oldest evicted past
@@ -384,128 +440,96 @@ class ServingEngine:
 
     # ---- compiled device functions --------------------------------------
 
-    def _build_decode_fn(self):
-        model, cfg = self.model, self.kv_cfg
-        page, use_kernel = cfg.page_size, self._use_kernel
+    def _attend(self, kv: KVPages, layer: int, q, table, att_lens,
+                row_seq, qpos):
+        """One ragged paged attention over the tick's mixed row stack.
+        The reference path consumes the compact ``[B + pb]`` rows as-is;
+        the kernel path expands each decode row to its own BLOCK_ROWS
+        block (the one-sequence-per-block packing contract) — prefill
+        rows are already block-aligned by the packer — and slices the
+        context back out.  The expansion touches [B, H, D]-sized data,
+        noise next to the attention itself."""
+        ks = kv.k_scale[layer] if kv.k_scale is not None else None
+        vs = kv.v_scale[layer] if kv.v_scale is not None else None
+        if not self._ragged_kernel:
+            # row-blocked fallback: identical math to the oracle, with
+            # the per-row K/V gather bounded to one block of rows
+            return _ragged_reference_blocked(
+                q, kv.k[layer], kv.v[layer], table, att_lens, row_seq,
+                qpos, k_scale=ks, v_scale=vs)
+        b, rb = self._max_slots, BLOCK_ROWS
+        td = b * rb
+        # decode rows expand through THE shared packing helper (one copy
+        # of the one-sequence-per-block contract); prefill rows are
+        # already block-aligned by the packer and concatenate behind
+        qd, rsd, qpd = expand_decode_rows(q[:b], qpos[:b])
+        qe = jnp.concatenate([qd, q[b:]])
+        rs = jnp.concatenate([rsd, row_seq[b:]])
+        qp = jnp.concatenate([qpd, qpos[b:]])
+        ctx = ragged_paged_attention(
+            qe, kv.k[layer], kv.v[layer], table, att_lens, rs, qp,
+            k_scale=ks, v_scale=vs, use_kernel=True)
+        return jnp.concatenate([ctx[:td:rb], ctx[td:]])
 
-        def fn(params, kv: KVPages, tokens, positions, page_table, lens,
-               active):
-            # tokens/positions/lens/active: [B]; page_table: [B, Pm].
-            # One fused decode step: embed, per-layer append + paged
-            # attention, logits.  Inactive rows write the null page and
-            # produce garbage logits the host ignores.
-            b = tokens.shape[0]
-            x = model.embed(params, tokens, positions)
-            page_ids = jnp.where(
-                active, page_table[jnp.arange(b), lens // page], NULL_PAGE)
-            offs = lens % page
-            att_lens = jnp.where(active, lens + 1, 0)
+    def _step_fn(self, pb: int):
+        """The unified per-tick step for prefill bucket ``pb`` (0 =
+        decode-only): ONE dispatch embeds the decode rows and the packed
+        prefill-chunk rows, scatters every row's K/V into its page
+        (quantize-on-write on int8 pools; masked rows write ZEROS to the
+        shared null page so computed junk can never leak into gathered
+        fallback reads), runs one ragged paged attention over the whole
+        mixed batch per layer, and returns logits for the decode rows
+        plus each slot's chunk-final row — prior context and in-chunk
+        causality come from the ONE ``token <= position`` mask, with no
+        separate prefill/decode paths to keep in sync."""
+        fn = self._step_fns.get(pb)
+        if fn is not None:
+            return fn
+        model, cfg = self.model, self.kv_cfg
+        b, page = self._max_slots, cfg.page_size
+
+        def raw(params, kv: KVPages, d_tokens, d_pos, d_active, p_tokens,
+                p_qpos, p_seq, p_last, table, att_lens):
+            # d_tokens/d_pos/d_active: [B] — one decode row per slot
+            # (inactive rows write the null page and produce garbage
+            # logits the host ignores).  p_tokens/p_qpos/p_seq: [pb] —
+            # packed prefill rows, qpos -1 = padding (p_seq stays the
+            # owning slot so kernel blocks remain sequence-uniform).
+            # p_last: [B] — row index of each slot's chunk-final row in
+            # the packed stack (0 for slots not prefilling).  table:
+            # [B, Pm]; att_lens: [B] — valid KV per slot AFTER this
+            # step's writes.
+            arange_b = jnp.arange(b)
+            p_act = p_qpos >= 0
+            pq = jnp.maximum(p_qpos, 0)
+            tokens = jnp.concatenate([d_tokens, p_tokens])
+            pos = jnp.concatenate([d_pos, pq])
+            x = model.embed(params, tokens, pos)          # [B + pb, E]
+            d_pages = jnp.where(d_active, table[arange_b, d_pos // page],
+                                NULL_PAGE)
+            p_pages = jnp.where(p_act, table[p_seq, pq // page], NULL_PAGE)
+            pages = jnp.concatenate([d_pages, p_pages])
+            offs = jnp.concatenate([d_pos % page, pq % page])
+            wmask = jnp.concatenate([d_active, p_act])[:, None, None]
+            row_seq = jnp.concatenate([arange_b, p_seq])
+            qpos = jnp.concatenate([jnp.where(d_active, d_pos, -1),
+                                    p_qpos])
             for l in range(cfg.num_layers):
                 q, k, v = model.qkv(params, l, x)
-                kv = append_token(kv, l, k, v, page_ids, offs)
-                ctx = paged_decode_attention(
-                    q, kv.k[l], kv.v[l], page_table, att_lens,
-                    use_kernel=use_kernel)
+                kv = append_token(kv, l, jnp.where(wmask, k, 0.0),
+                                  jnp.where(wmask, v, 0.0), pages, offs)
+                ctx = self._attend(kv, l, q, table, att_lens, row_seq,
+                                   qpos)
                 x = model.attn_out(params, l, ctx, x)
-            return model.logits(params, x), kv
+            # logits only where the host will read them: the B decode
+            # rows + each slot's chunk-final row (2B rows, not B + pb)
+            sel = jnp.concatenate([arange_b, p_last])
+            logits = model.logits(params, x[sel])
+            return logits[:b], logits[b:], kv
 
-        return fn
-
-    def _prefill_fn(self, bucket: int):
-        fn = self._prefill_fns.get(bucket)
-        if fn is not None:
-            return fn
-        model, cfg = self.model, self.kv_cfg
-        page = cfg.page_size
-        # kernel-shaped buckets prefill through the flash kernel; the
-        # rest (short buckets, odd head dims) use the plain reference
-        use_flash = (bucket % 128 == 0 and
-                     (cfg.head_dim * cfg.num_heads) % 8 == 0)
-
-        def raw(params, kv: KVPages, tokens, n, page_row):
-            # tokens: [bucket] i32 (padded); n: scalar i32 true length;
-            # page_row: [Pm] i32 — this request's page table row.
-            pos = jnp.arange(bucket, dtype=jnp.int32)
-            x = model.embed(params, tokens[None], pos[None])   # [1, T, E]
-            tmask = pos < n
-            dest = jnp.where(tmask, page_row[pos // page], NULL_PAGE)
-            offs = pos % page
-            seg = jnp.where(tmask, 0, 1)[None].astype(jnp.int32)
-            for l in range(cfg.num_layers):
-                q, k, v = model.qkv(params, l, x)              # [1, T, H, D]
-                kv = write_prompt(kv, l, k[0], v[0], dest, offs)
-                if use_flash:
-                    ctx = flash_attention(q, k, v, segment_ids=seg,
-                                          causal=True)
-                else:
-                    ctx = mha_reference(q, k, v, segment_ids=seg,
-                                        causal=True)
-                x = model.attn_out(params, l, ctx, x)
-            last = jnp.take(x[0], jnp.maximum(n - 1, 0), axis=0)
-            return model.logits(params, last), kv
-
-        fn = audit_jit(raw, site="serving.prefill",
+        fn = audit_jit(raw, site="serving.step",
                        donate_argnums=self._donate_kv)
-        self._prefill_fns[bucket] = fn
-        return fn
-
-    def _chunk_fn(self, bucket: int):
-        """Prefill one CHUNK of a prompt whose earlier tokens are already
-        materialized in pages (a cached prefix, a COW-forked page, or
-        previous chunks).  The chunk's K/V is scattered into its pages
-        first, then attention runs over the request's whole gathered page
-        row with an offset-causal mask — kv position ``t`` is visible to
-        the query at absolute position ``start + i`` iff ``t <= start+i``
-        — so prior context and in-chunk causality come from ONE masked
-        attention, with no separate cross/self paths to keep in sync."""
-        fn = self._chunk_fns.get(bucket)
-        if fn is not None:
-            return fn
-        model, cfg = self.model, self.kv_cfg
-        page, pm = cfg.page_size, cfg.max_pages_per_seq
-        scale = float(cfg.head_dim) ** -0.5
-
-        def raw(params, kv: KVPages, tokens, n, start, page_row):
-            # tokens: [bucket] i32 (padded chunk); n: scalar i32 true
-            # chunk length; start: scalar i32 absolute position of
-            # tokens[0]; page_row: [Pm] i32 — this request's page table.
-            pos = jnp.arange(bucket, dtype=jnp.int32)
-            abs_pos = start + pos
-            x = model.embed(params, tokens[None], abs_pos[None])  # [1,T,E]
-            tmask = pos < n
-            dest = jnp.where(tmask, page_row[abs_pos // page], NULL_PAGE)
-            offs = abs_pos % page
-            kv_pos = jnp.arange(pm * page, dtype=jnp.int32)
-            mask = kv_pos[None, :] <= abs_pos[:, None]       # [T, Pm*page]
-            # positions beyond this chunk's end hold garbage (stale page
-            # contents, the null page): zero their gathered K/V rather
-            # than trusting the mask alone — softmax gives them weight
-            # exactly 0, but 0 * inf in the PV product would still be NaN
-            valid = (kv_pos < start + n)[None, :, None, None]
-            wmask = tmask[:, None, None]
-            for l in range(cfg.num_layers):
-                q, k, v = model.qkv(params, l, x)            # [1, T, H, D]
-                # padded rows attend over REAL keys (no segment split
-                # here), so their values can be junk: write zeros to the
-                # shared null page, never computed junk
-                kv = write_prompt(kv, l, jnp.where(wmask, k[0], 0.0),
-                                  jnp.where(wmask, v[0], 0.0), dest, offs)
-                kg, vg = gather_kv(kv, l, page_row[None])    # [1,Pm*pg,H,D]
-                kg = jnp.where(valid, kg, 0.0)
-                vg = jnp.where(valid, vg, 0.0)
-                s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                               kg.astype(jnp.float32)) * scale
-                s = jnp.where(mask[None, None], s, DEFAULT_MASK_VALUE)
-                p = jax.nn.softmax(s, axis=-1)
-                ctx = jnp.einsum("bhqk,bkhd->bqhd", p,
-                                 vg.astype(jnp.float32)).astype(q.dtype)
-                x = model.attn_out(params, l, ctx, x)
-            last = jnp.take(x[0], jnp.maximum(n - 1, 0), axis=0)
-            return model.logits(params, last), kv
-
-        fn = audit_jit(raw, site="serving.chunk_prefill",
-                       donate_argnums=self._donate_kv)
-        self._chunk_fns[bucket] = fn
+        self._step_fns[pb] = fn
         return fn
 
     # ---- user surface ----------------------------------------------------
@@ -682,24 +706,40 @@ class ServingEngine:
             self._tracer.instant("admit", rid=req.rid, slot=req.slot,
                                  cached=req.cached_len, tick=tick)
             self._begin_prefill(req)
-        # ONE chunk per prefilling request per tick: a freshly-admitted
-        # request takes its first chunk now, earlier admissions resume —
-        # and the fused decode below still runs every tick, so a long
-        # prefill no longer stalls running slots' inter-token latency
-        prefilling = [r for r in sched.running_requests()
-                      if r.status is RequestStatus.RUNNING and r.prefilling]
-        for req in prefilling:
-            with self._tracer.span("prefill_chunk", rid=req.rid,
-                                   slot=req.slot, start=req.cache_len,
-                                   tick=tick):
-                self._prefill_step(req)
+        # the unified step: this tick's decode rows AND every selected
+        # prefill chunk ride ONE dispatch (one ragged attention over
+        # shared pages), so a long prefill no longer stalls running
+        # slots' inter-token latency NOR costs a second dispatch.
+        # Chunk candidates go oldest-progress-first so a request
+        # crowded out by the row budget is first in line next tick.
+        prefilling = sorted(
+            (r for r in sched.running_requests()
+             if r.status is RequestStatus.RUNNING and r.prefilling),
+            key=lambda r: (r.last_progress_tick, r.slot))
+        chunks, total_rows = pack_prefill_chunks(
+            prefilling, self._prefill_chunk, self._row_align,
+            self._prefill_budget)
         running = [r for r in sched.running_requests()
                    if r.status is RequestStatus.RUNNING
                    and not r.prefilling and r.generated]
-        if running:
+        if running or chunks:
+            for req, start, n, _ in chunks:
+                self._tracer.instant("prefill_chunk", rid=req.rid,
+                                     slot=req.slot, start=start, n=n,
+                                     tick=tick)
+            # span keeps its historical name: it IS the fused tick
             with self._tracer.span("decode_tick", tick=tick,
-                                   n=len(running)):
-                self._decode_with_retry(running, tick)
+                                   n=len(running),
+                                   prefill_rows=total_rows):
+                if self._fuse_tick or not (running and chunks):
+                    self._step_with_retry(running, chunks, total_rows,
+                                          tick)
+                else:
+                    # fuse_tick=False: the v1 tick-interleave shape —
+                    # prefill and decode as separate dispatches (bench
+                    # control; same math, token-identical)
+                    self._step_with_retry([], chunks, total_rows, tick)
+                    self._step_with_retry(running, [], 0, tick)
         self._prev_tick_busy = (bool(running) or bool(admitted) or
                                 bool(prefilling))
         self._watchdog_sweep(tick)
@@ -827,6 +867,12 @@ class ServingEngine:
             "pages_in_use": self.pool.num_live,
             "pages_cached": self.pool.num_cached,
             "pages_reclaimable": self.pool.num_reclaimable,
+            # effective cache capacity: what the pool's byte budget buys
+            # at this KV dtype (int8 admits ~4x the f32 pages — see
+            # ServingEngine(pool_bytes=...))
+            "pages_total": self.pool.num_usable,
+            "kv_dtype": str(jnp.dtype(self.kv_cfg.dtype).name),
+            "kv_bytes": self.kv_cfg.kv_bytes(),
             # `is not None`, not truthiness: PrefixCache defines __len__,
             # so an empty-but-active cache is falsy
             "cache_hits": self.cache.hits if self.cache is not None else 0,
@@ -870,7 +916,8 @@ class ServingEngine:
                     > req.deadline_at):
                 self._finish(req, RequestStatus.REJECTED, now, shed=True)
 
-    def _decode_with_retry(self, running: List[Request], tick: int) -> None:
+    def _step_with_retry(self, running: List[Request], chunks, total_rows,
+                         tick: int) -> None:
         attempt = 0
         while True:
             try:
@@ -878,7 +925,7 @@ class ServingEngine:
                         self.faults.decode_should_fail(tick, attempt):
                     raise InjectedDeviceError(f"injected @ tick {tick} "
                                               f"attempt {attempt}")
-                self._do_decode(running)
+                self._do_step(running, chunks, total_rows)
                 return
             except self.transient_errors:
                 attempt += 1
@@ -916,54 +963,102 @@ class ServingEngine:
             req.cow_src = None
             self.metrics.on_cow()
 
-    def _prefill_step(self, req: Request) -> None:
-        """Advance one prefill chunk — or the whole prompt on the
-        single-shot fast path (no cached prefix, fits in one chunk).  On
-        the final chunk the last position's logits emit the first token
-        and the request joins the fused decode batch.
+    def _do_step(self, running: List[Request], chunks,
+                 total_rows: int) -> None:
+        """Assemble and dispatch ONE unified step, then walk its
+        results: chunk bookkeeping first (cache inserts, finite guard,
+        final-chunk first-token emission — the v1 tick order), decode
+        emissions second.
 
-        Every chunk's logits go through the finite guard BEFORE its full
-        pages are indexed (a chunk's last-position logits attend over
-        every K/V written so far, so finiteness transitively vouches for
-        the whole chain): without the per-chunk check, suspect K/V from
-        an overflowing prompt would be hittable for the whole multi-tick
-        prefill window, and a sharer admitted in that window would
-        stitch it before the final-chunk rollback ran.  The sync this
-        costs is one host readback per chunk — the tick already pays one
-        for decode."""
-        toks = req.cache_tokens
-        total = len(toks)
-        start = req.cache_len
-        chunk = self._prefill_chunk
+        Every chunk's final-row logits go through the finite guard
+        BEFORE its full pages are indexed (those logits attend over
+        every K/V written so far, so finiteness transitively vouches
+        for the whole chain): without the per-chunk check, suspect K/V
+        from an overflowing prompt would be hittable for the whole
+        multi-tick prefill window, and a sharer admitted in that window
+        would stitch it before the final-chunk rollback ran."""
+        b = self._max_slots
         cfg = self.kv_cfg
-        row = np.full((cfg.max_pages_per_seq,), NULL_PAGE, np.int32)
-        row[:len(req.pages)] = req.pages
-        if start == 0 and (chunk <= 0 or total <= chunk):
-            # fast path: one-shot bucketed prefill (flash when shaped)
-            bucket = bucket_for(total, self._buckets, cfg.max_seq_len)
-            padded = np.zeros((bucket,), np.int32)
-            padded[:total] = toks
-            logits, self._kv = self._prefill_fn(bucket)(
-                self.params, self._kv, jnp.asarray(padded),
-                jnp.asarray(total, jnp.int32), jnp.asarray(row))
-            req.cache_len = total
-            self.metrics.on_prefill(total)
-        else:
-            end = total if chunk <= 0 else min(total, start + chunk)
-            n = end - start
-            bucket = bucket_for(n, self._buckets, cfg.max_seq_len)
-            padded = np.zeros((bucket,), np.int32)
-            padded[:n] = toks[start:end]
-            logits, self._kv = self._chunk_fn(bucket)(
-                self.params, self._kv, jnp.asarray(padded),
-                jnp.asarray(n, jnp.int32), jnp.asarray(start, jnp.int32),
-                jnp.asarray(row))
-            req.cache_len = end
-            self.metrics.on_prefill(n)
-        req.last_progress_tick = self._tick   # chunks are progress too
-        logits = np.asarray(logits)   # forces device sync
-        # stamp AFTER the sync so TTFT includes the prefill compute
+        d_tokens = np.zeros((b,), np.int32)
+        d_pos = np.zeros((b,), np.int32)
+        d_active = np.zeros((b,), bool)
+        att_lens = np.zeros((b,), np.int32)
+        table = np.full((b, cfg.max_pages_per_seq), NULL_PAGE, np.int32)
+        for req in running:
+            s = req.slot
+            d_tokens[s] = req.generated[-1]
+            d_pos[s] = req.cache_len
+            d_active[s] = True
+            att_lens[s] = req.cache_len + 1
+            table[s, :len(req.pages)] = req.pages
+        pb = 0
+        if chunks:
+            pb = bucket_for(total_rows, self._buckets,
+                            max(cfg.max_seq_len, total_rows))
+            if self._ragged_kernel:  # whole blocks only (kernel packing)
+                pb = -(-pb // BLOCK_ROWS) * BLOCK_ROWS
+        p_tokens = np.zeros((pb,), np.int32)
+        p_qpos = np.full((pb,), -1, np.int32)
+        p_seq = np.zeros((pb,), np.int32)
+        p_last = np.zeros((b,), np.int32)
+        off = 0
+        for req, start, n, rows in chunks:
+            s = req.slot
+            toks = req.cache_tokens
+            p_tokens[off:off + n] = toks[start:start + n]
+            p_qpos[off:off + n] = np.arange(start, start + n)
+            # padding rows keep the owning slot so each kernel block
+            # stays sequence-uniform (their qpos -1 masks them out)
+            p_seq[off:off + rows] = s
+            p_last[s] = b + off + n - 1   # absolute row in the step's stack
+            att_lens[s] = start + n
+            table[s, :len(req.pages)] = req.pages
+            off += rows
+        d_logits, p_logits, self._kv = self._step_fn(pb)(
+            self.params, self._kv, jnp.asarray(d_tokens),
+            jnp.asarray(d_pos), jnp.asarray(d_active),
+            jnp.asarray(p_tokens), jnp.asarray(p_qpos),
+            jnp.asarray(p_seq), jnp.asarray(p_last), jnp.asarray(table),
+            jnp.asarray(att_lens))
+        d_logits = np.asarray(d_logits)   # forces device sync
+        p_logits = np.asarray(p_logits)
+        self.metrics.on_step(len(running), total_rows,
+                             pb - sum(c[2] for c in chunks))
+        # stamp AFTER the sync so TTFT includes the step compute
         now = self._time()
+        for req, start, n, _rows in chunks:
+            if req.status is not RequestStatus.RUNNING:
+                continue    # cancelled from an earlier chunk's on_token
+            self._finish_chunk(req, start, n, p_logits[req.slot], now)
+        if self.faults is not None and self.faults.nan_rids:
+            poisoned = [r for r in running
+                        if r.rid in self.faults.nan_rids]
+            if poisoned:              # only then pay for a writable copy
+                d_logits = d_logits.copy()
+                for req in poisoned:
+                    d_logits[req.slot] = np.nan
+        for req in running:
+            if req.status is not RequestStatus.RUNNING:
+                continue    # cancelled from another slot's on_token
+            row = d_logits[req.slot]
+            if not np.isfinite(row).all():
+                # poisoned slot: fail ONLY this request — its pages go
+                # back, the fused batchmates keep decoding untouched
+                self._finish(req, RequestStatus.FAILED, now)
+                continue
+            req.cache_len += 1
+            self._emit(req, int(np.argmax(row)), now)
+
+    def _finish_chunk(self, req: Request, start: int, n: int, logits,
+                      now: float) -> None:
+        """Post-dispatch bookkeeping for one prefill chunk that rode
+        the unified step: advance the materialized length, guard, index
+        the newly-completed full pages, and on the final chunk emit the
+        first token from the chunk-final row's logits."""
+        toks = req.cache_tokens
+        req.cache_len = start + n
+        self.metrics.on_prefill(n)
+        req.last_progress_tick = self._tick   # chunks are progress too
         if not np.isfinite(logits).all():
             if self.cache is not None:
                 # roll back entries ONLY for pages the FAILING chunk
@@ -972,7 +1067,8 @@ class ServingEngine:
                 # pages may already be stitched by a concurrent sharer —
                 # forgetting them would route them into the FAILED scrub
                 # below and zero-wipe K/V the sharer is reading
-                self.cache.forget(req.pages[start // cfg.page_size:])
+                self.cache.forget(
+                    req.pages[start // self.kv_cfg.page_size:])
             req.prefilling = False
             self._finish(req, RequestStatus.FAILED, now)
             return
@@ -984,50 +1080,10 @@ class ServingEngine:
             req.chain_hash, req.chain_blocks = self.cache.insert(
                 toks, req.pages, req.cache_len,
                 from_block=req.chain_blocks, prev_hash=req.chain_hash)
-        if req.cache_len < total:
+        if req.cache_len < len(toks):
             return                            # more chunks, later ticks
         req.prefilling = False
         self._emit(req, int(np.argmax(logits)), now)
-
-    def _do_decode(self, running: List[Request]) -> None:
-        b = self._max_slots
-        cfg = self.kv_cfg
-        tokens = np.zeros((b,), np.int32)
-        positions = np.zeros((b,), np.int32)
-        lens = np.zeros((b,), np.int32)
-        active = np.zeros((b,), bool)
-        table = np.full((b, cfg.max_pages_per_seq), NULL_PAGE, np.int32)
-        for req in running:
-            s = req.slot
-            tokens[s] = req.generated[-1]
-            positions[s] = req.cache_len
-            lens[s] = req.cache_len
-            active[s] = True
-            table[s, :len(req.pages)] = req.pages
-        logits, self._kv = self._decode_fn(
-            self.params, self._kv, jnp.asarray(tokens),
-            jnp.asarray(positions), jnp.asarray(table), jnp.asarray(lens),
-            jnp.asarray(active))
-        logits = np.asarray(logits)   # forces device sync
-        if self.faults is not None and self.faults.nan_rids:
-            poisoned = [r for r in running
-                        if r.rid in self.faults.nan_rids]
-            if poisoned:              # only then pay for a writable copy
-                logits = logits.copy()
-                for req in poisoned:
-                    logits[req.slot] = np.nan
-        now = self._time()            # emission time includes the compute
-        for req in running:
-            if req.status is not RequestStatus.RUNNING:
-                continue    # cancelled from another slot's on_token
-            row = logits[req.slot]
-            if not np.isfinite(row).all():
-                # poisoned slot: fail ONLY this request — its pages go
-                # back, the fused batchmates keep decoding untouched
-                self._finish(req, RequestStatus.FAILED, now)
-                continue
-            req.cache_len += 1
-            self._emit(req, int(np.argmax(row)), now)
 
     def _emit(self, req: Request, tok: int, now: float) -> None:
         req.generated.append(tok)

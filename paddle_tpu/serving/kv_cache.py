@@ -51,10 +51,34 @@ from paddle_tpu.platform.enforce import enforce_that
 NULL_PAGE = 0
 
 
+_QMAX = 127.0        # symmetric int8 range; -128 is never produced
+_KV_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+              "int8": jnp.int8}
+
+
+def resolve_kv_dtype(name):
+    """Map ``FLAGS.serving_kv_dtype`` (or an explicit dtype) to a jnp
+    dtype.  Accepts the flag strings and dtype objects alike."""
+    if isinstance(name, str):
+        enforce_that(name in _KV_DTYPES,
+                     f"serving_kv_dtype must be one of {sorted(_KV_DTYPES)},"
+                     f" got {name!r}", context="serving")
+        return _KV_DTYPES[name]
+    return jnp.dtype(name)
+
+
 @dataclass(frozen=True)
 class PagedKVConfig:
     """Static geometry of the paged pool (one pool shared by all layers:
-    page id ``p`` addresses layer ``l``'s slice ``k[l, p]`` for every l)."""
+    page id ``p`` addresses layer ``l``'s slice ``k[l, p]`` for every l).
+
+    ``num_kv_heads`` (None = ``num_heads``) is the GQA knob: the pool
+    stores K/V for the KV heads only, and the ragged attention kernel
+    packs each group of ``num_heads // num_kv_heads`` query heads
+    against one K/V load.  ``dtype=jnp.int8`` turns on quantized pages:
+    every write stores amax/127-scaled int8 values plus a per-token,
+    per-kv-head f32 scale (see :func:`quantize_kv`), read back by
+    dequantizing in-register — roughly quartering bytes per page."""
 
     num_layers: int
     num_heads: int
@@ -63,6 +87,7 @@ class PagedKVConfig:
     num_pages: int           # includes the reserved null page 0
     max_pages_per_seq: int   # page-table width (static decode grid bound)
     dtype: jnp.dtype = jnp.float32
+    num_kv_heads: Optional[int] = None   # None = MHA (== num_heads)
 
     def __post_init__(self):
         enforce_that(self.num_pages >= 2,
@@ -71,6 +96,21 @@ class PagedKVConfig:
         enforce_that(self.page_size >= 1 and self.max_pages_per_seq >= 1,
                      "page_size and max_pages_per_seq must be positive",
                      context="serving")
+        enforce_that(self.num_heads % self.kv_heads == 0,
+                     f"num_kv_heads ({self.kv_heads}) must divide "
+                     f"num_heads ({self.num_heads})", context="serving")
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads if self.num_kv_heads else self.num_heads
+
+    @property
+    def q_heads_per_group(self) -> int:
+        return self.num_heads // self.kv_heads
+
+    @property
+    def quantized(self) -> bool:
+        return jnp.dtype(self.dtype) == jnp.int8
 
     @property
     def max_seq_len(self) -> int:
@@ -80,34 +120,105 @@ class PagedKVConfig:
     def usable_pages(self) -> int:
         return self.num_pages - 1  # page 0 is the null page
 
-    def kv_bytes(self) -> int:
-        per = (self.num_layers * self.num_pages * self.page_size *
-               self.num_heads * self.head_dim *
-               jnp.dtype(self.dtype).itemsize)
+    def bytes_per_page(self) -> int:
+        """K + V bytes ONE page costs across all layers, scale arrays
+        included — the unit the pool-byte budget is charged in."""
+        per = (self.num_layers * self.page_size * self.kv_heads *
+               self.head_dim * jnp.dtype(self.dtype).itemsize)
+        if self.quantized:
+            # per-token, per-kv-head f32 scales ride with the page
+            per += self.num_layers * self.page_size * self.kv_heads * 4
         return 2 * per
+
+    def kv_bytes(self) -> int:
+        return self.num_pages * self.bytes_per_page()
+
+
+def pages_for_budget(pool_bytes: int, num_layers: int, num_heads: int,
+                     head_dim: int, page_size: int, dtype,
+                     num_kv_heads: Optional[int] = None) -> int:
+    """Total ``num_pages`` (null page included) that fit in a pool byte
+    budget — the knob that makes int8 pages *mean* something: the same
+    ``pool_bytes`` admits ~2x the pages of bf16 and ~4x of f32 (minus
+    the scale-array overhead).  The scheduler charges admission in
+    pages, so capacity gains flow straight into admissible concurrency
+    and prefix-cache headroom."""
+    probe = PagedKVConfig(num_layers=num_layers, num_heads=num_heads,
+                          head_dim=head_dim, page_size=page_size,
+                          num_pages=2, max_pages_per_seq=1,
+                          dtype=resolve_kv_dtype(dtype),
+                          num_kv_heads=num_kv_heads)
+    return max(2, int(pool_bytes) // probe.bytes_per_page())
 
 
 class KVPages(NamedTuple):
     """The device-resident pool: ``k``/``v`` are
-    [num_layers, num_pages, page_size, num_heads, head_dim]."""
+    [num_layers, num_pages, page_size, num_kv_heads, head_dim].  With
+    int8 pages, ``k_scale``/``v_scale`` are the matching per-token,
+    per-kv-head f32 scales [num_layers, num_pages, page_size,
+    num_kv_heads]; None for float pools (the two layouts share every
+    code path through ``is-None`` checks that resolve at trace time)."""
 
     k: jax.Array
     v: jax.Array
+    k_scale: Optional[jax.Array] = None
+    v_scale: Optional[jax.Array] = None
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
 
 
 def init_kv_pages(cfg: PagedKVConfig) -> KVPages:
-    shape = (cfg.num_layers, cfg.num_pages, cfg.page_size, cfg.num_heads,
+    shape = (cfg.num_layers, cfg.num_pages, cfg.page_size, cfg.kv_heads,
              cfg.head_dim)
+    if cfg.quantized:
+        return KVPages(jnp.zeros(shape, jnp.int8),
+                       jnp.zeros(shape, jnp.int8),
+                       jnp.zeros(shape[:-1], jnp.float32),
+                       jnp.zeros(shape[:-1], jnp.float32))
     return KVPages(jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+
+
+def quantize_kv(x: jax.Array):
+    """Symmetric per-token, per-head int8 quantization of K/V rows.
+
+    x: [..., D] float.  Returns ``(q, scale)`` with ``q`` int8 [..., D]
+    and ``scale`` f32 [...] such that ``q * scale`` reconstructs x to
+    within one quantization step of amax/127.  All-zero rows quantize
+    to (0, tiny) — dequant is exactly 0 either way, and the scale never
+    divides by zero."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(amax, 1e-20) / _QMAX
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                 -_QMAX, _QMAX).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q: jax.Array, scale: jax.Array) -> jax.Array:
+    """Exact inverse read of :func:`quantize_kv`'s stored form — the ONE
+    dequant rule the kernel, the gather fallback, and the parity oracle
+    all share, so they can never disagree on what an int8 page means."""
+    return q.astype(jnp.float32) * scale[..., None]
 
 
 def append_token(kv: KVPages, layer: int, k_new: jax.Array, v_new: jax.Array,
                  page_ids: jax.Array, offsets: jax.Array) -> KVPages:
-    """Scatter one decode token per sequence into its current page.
+    """Scatter one K/V row per ragged batch row into its page.
 
-    k_new/v_new: [B, H, D]; page_ids/offsets: [B] int32 (inactive slots
-    pass page_ids == NULL_PAGE — duplicates on the null page are fine,
-    nothing reads it).  Pure; returns the updated pool."""
+    k_new/v_new: [B, H_kv, D]; page_ids/offsets: [B] int32 (inactive
+    rows pass page_ids == NULL_PAGE — duplicates on the null page are
+    fine, nothing reads it).  Quantized pools quantize on write (the
+    scale lands at the same [layer, page, offset, head] address).
+    Pure; returns the updated pool."""
+    if kv.quantized:
+        kq, ks = quantize_kv(k_new)
+        vq, vs = quantize_kv(v_new)
+        return KVPages(
+            kv.k.at[layer, page_ids, offsets].set(kq),
+            kv.v.at[layer, page_ids, offsets].set(vq),
+            kv.k_scale.at[layer, page_ids, offsets].set(ks),
+            kv.v_scale.at[layer, page_ids, offsets].set(vs))
     k = kv.k.at[layer, page_ids, offsets].set(k_new.astype(kv.k.dtype))
     v = kv.v.at[layer, page_ids, offsets].set(v_new.astype(kv.v.dtype))
     return KVPages(k, v)
@@ -117,11 +228,11 @@ def write_prompt(kv: KVPages, layer: int, k_seq: jax.Array, v_seq: jax.Array,
                  dest_pages: jax.Array, offsets: jax.Array) -> KVPages:
     """Scatter a whole (padded) prompt into pages at prefill.
 
-    k_seq/v_seq: [T, H, D]; dest_pages/offsets: [T] int32, with padded
-    positions (t >= true length) steered to NULL_PAGE by the caller."""
-    k = kv.k.at[layer, dest_pages, offsets].set(k_seq.astype(kv.k.dtype))
-    v = kv.v.at[layer, dest_pages, offsets].set(v_seq.astype(kv.v.dtype))
-    return KVPages(k, v)
+    k_seq/v_seq: [T, H_kv, D]; dest_pages/offsets: [T] int32, with
+    padded positions (t >= true length) steered to NULL_PAGE by the
+    caller.  Same quantize-on-write rule as :func:`append_token` (the
+    scatter shape is identical — one row per position)."""
+    return append_token(kv, layer, k_seq, v_seq, dest_pages, offsets)
 
 
 def zero_pages(kv: KVPages, page_ids: jax.Array) -> KVPages:
@@ -131,14 +242,20 @@ def zero_pages(kv: KVPages, page_ids: jax.Array) -> KVPages:
     leaves inf/NaN K/V in the pages it wrote; freed and re-granted,
     those stale values would poison the NEXT owner through masked
     attention reads (softmax weight 0 times inf is NaN).  Scrubbing on
-    the failure path keeps the pool finite-by-construction."""
-    k = kv.k.at[:, page_ids].set(0.0)
-    v = kv.v.at[:, page_ids].set(0.0)
+    the failure path keeps the pool finite-by-construction.  (int8
+    pools can't store non-finite VALUES, but their scale arrays can —
+    both are scrubbed.)"""
+    k = kv.k.at[:, page_ids].set(jnp.zeros((), kv.k.dtype))
+    v = kv.v.at[:, page_ids].set(jnp.zeros((), kv.v.dtype))
+    if kv.quantized:
+        return KVPages(k, v, kv.k_scale.at[:, page_ids].set(0.0),
+                       kv.v_scale.at[:, page_ids].set(0.0))
     return KVPages(k, v)
 
 
 def fork_page(kv: KVPages, src: jax.Array, dst: jax.Array) -> KVPages:
-    """Copy one page's K/V across every layer (the copy-on-write fork).
+    """Copy one page's K/V (and scales) across every layer — the
+    copy-on-write fork.
 
     src/dst: scalar int32 page ids.  The forked page becomes a private
     replica of a shared cached page, so a sequence whose tail must write
@@ -146,6 +263,10 @@ def fork_page(kv: KVPages, src: jax.Array, dst: jax.Array) -> KVPages:
     the other holders.  Pure; returns the updated pool."""
     k = kv.k.at[:, dst].set(kv.k[:, src])
     v = kv.v.at[:, dst].set(kv.v[:, src])
+    if kv.quantized:
+        return KVPages(k, v,
+                       kv.k_scale.at[:, dst].set(kv.k_scale[:, src]),
+                       kv.v_scale.at[:, dst].set(kv.v_scale[:, src]))
     return KVPages(k, v)
 
 
@@ -153,15 +274,21 @@ def gather_kv(kv: KVPages, layer: int, page_table: jax.Array):
     """Linearize page tables into contiguous K/V.
 
     page_table: [B, max_pages_per_seq] int32.  Returns (k, v) each
-    [B, max_pages_per_seq * page_size, H, D] — positions beyond a
-    sequence's length hold whatever the referenced pages contain (callers
-    mask by length; this is the oracle/fallback read path)."""
+    [B, max_pages_per_seq * page_size, H_kv, D] — positions beyond a
+    sequence's length hold whatever the referenced pages contain
+    (callers mask by length; this is the oracle/fallback read path).
+    Quantized pools are dequantized here with the shared
+    :func:`dequantize_kv` rule, so the fallback reads the SAME stored
+    values the kernel does and parity stays pinned."""
     kl, vl = kv.k[layer], kv.v[layer]
     b, pm = page_table.shape
     _, page, h, d = kl.shape
-    k = kl[page_table].reshape(b, pm * page, h, d)
-    v = vl[page_table].reshape(b, pm * page, h, d)
-    return k, v
+    k = kl[page_table]
+    v = vl[page_table]
+    if kv.quantized:
+        k = dequantize_kv(k, kv.k_scale[layer][page_table])
+        v = dequantize_kv(v, kv.v_scale[layer][page_table])
+    return (k.reshape(b, pm * page, h, d), v.reshape(b, pm * page, h, d))
 
 
 @dataclass

@@ -51,6 +51,13 @@ class ServingMetrics:
         self.ticks = 0
         self.tokens_generated = 0
         self.prefill_tokens = 0       # tokens actually forwarded at prefill
+        # unified-step shape (round 12): dispatches and row mix — the
+        # whole point of the ragged kernel is fewer dispatches per unit
+        # of work, so the bench reads these directly
+        self.step_dispatches = 0      # unified-step device dispatches
+        self.decode_rows = 0          # decode rows shipped across steps
+        self.prefill_rows = 0         # prefill-chunk rows shipped (padded)
+        self.prefill_pad_rows = 0     # of the bucket, padding/alignment
         # prefix caching (round 9)
         self.prefix_requested_tokens = 0  # cache_tokens summed at admission
         self.prefill_tokens_saved = 0     # of those, served from the cache
@@ -76,6 +83,18 @@ class ServingMetrics:
 
     def on_prefill(self, n_tokens: int) -> None:
         self.prefill_tokens += n_tokens
+
+    def on_step(self, n_decode: int, n_prefill_rows: int,
+                n_pad_rows: int) -> None:
+        """One unified-step dispatch: how many decode rows and (padded)
+        prefill rows rode it, and how much of the prefill bucket was
+        padding.  ``fuse_tick=False`` (the v1 two-dispatch control)
+        calls this twice per busy tick — the dispatch-count delta IS
+        the A/B."""
+        self.step_dispatches += 1
+        self.decode_rows += n_decode
+        self.prefill_rows += n_prefill_rows
+        self.prefill_pad_rows += max(0, n_pad_rows)
 
     def on_prefix(self, requested: int, saved: int) -> None:
         """One admission's prefix-cache outcome: ``requested`` tokens
@@ -177,6 +196,10 @@ class ServingMetrics:
             "queue_wait_ms_p95": round(self.queue_wait_ms_p95(), 3),
             "tokens_generated": self.tokens_generated,
             "prefill_tokens": self.prefill_tokens,
+            "step_dispatches": self.step_dispatches,
+            "decode_rows": self.decode_rows,
+            "prefill_rows": self.prefill_rows,
+            "prefill_pad_rows": self.prefill_pad_rows,
             "prefix_hit_rate": round(self.prefix_hit_rate(), 4),
             "prefill_tokens_saved": self.prefill_tokens_saved,
             "cow_forks": self.cow_forks,

@@ -392,6 +392,39 @@ class ContinuousBatchingScheduler:
         return len(self.queue)
 
 
+def pack_prefill_chunks(prefilling: List[Request], chunk: int, align: int,
+                        budget: int) -> Tuple[List[Tuple[Request, int, int,
+                                                         int]], int]:
+    """Select which prefill chunks ride in THIS tick's unified step.
+
+    Each prefilling request contributes one chunk of at most ``chunk``
+    tokens (0 = its whole remainder), padded up to ``align`` rows (the
+    ragged kernel's one-sequence-per-block packing; 1 on the reference
+    path).  Chunks pack greedily in the given order until ``budget``
+    rows — the engine orders candidates oldest-progress-first, so a
+    request crowded out this tick is first in line next tick and the
+    per-tick prefill row count (hence the jit bucket) stays bounded.
+    The FIRST chunk always packs even if it alone exceeds the budget
+    (``bucket_for`` rounds the oversize up), so progress is guaranteed.
+
+    Returns ``([(request, start, n_tokens, n_rows)], total_rows)``;
+    this is scheduling policy, so it lives here with the rest of it.
+    """
+    out: List[Tuple[Request, int, int, int]] = []
+    total = 0
+    for req in prefilling:
+        remaining = len(req.cache_tokens) - req.cache_len
+        if remaining <= 0:
+            continue
+        n = remaining if chunk <= 0 else min(chunk, remaining)
+        rows = -(-n // align) * align
+        if out and total + rows > budget:
+            break
+        out.append((req, req.cache_len, n, rows))
+        total += rows
+    return out, total
+
+
 def bucket_for(length: int, buckets: Tuple[int, ...], max_len: int) -> int:
     """Smallest bucket >= length; lengths beyond the ladder round up to
     the next page-agnostic multiple of the largest bucket, capped at

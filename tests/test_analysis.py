@@ -559,7 +559,14 @@ def test_audit_off_is_plain_jit():
 
 
 @pytest.mark.serving
-def test_serving_decode_compiles_once_per_bucket_steady_state(audit, rng):
+def test_serving_step_compiles_once_per_bucket_pair_steady_state(audit, rng):
+    """The unified step (round 12) compiles exactly once per
+    (decode_bucket, prefill_bucket) pair — the decode bucket is the
+    fixed max_slots row count, so the ladder is one jit per prefill
+    bucket plus the decode-only pb=0 — and a SEALED mixed
+    prefill+decode steady state never compiles again.  The v1
+    serving.decode / serving.prefill / serving.chunk_prefill sites are
+    retired; serving.step is their one successor."""
     from paddle_tpu.serving import DecoderLM, ServingEngine
 
     old_bf16 = FLAGS.use_bf16
@@ -570,26 +577,40 @@ def test_serving_decode_compiles_once_per_bucket_steady_state(audit, rng):
         params = model.init_params(jax.random.PRNGKey(0))
         eng = ServingEngine(model, params, eos_id=1, page_size=4,
                             num_pages=40, max_pages_per_seq=10,
-                            max_slots=4, buckets=(4, 8, 16))
-        # warmup traffic hitting TWO prefill buckets (<=4 and <=8)
-        prompts = [rng.randint(2, 50, size=n).tolist()
-                   for n in (3, 4, 7, 6, 2)]
-        for p in prompts:
-            eng.submit(p, max_tokens=8)
+                            max_slots=4, buckets=(4, 8, 16),
+                            prefill_chunk=8)
+        # warm the pair ladder deterministically: a lone short prompt
+        # (pb=4), then decode-only ticks (pb=0) to completion...
+        eng.submit(rng.randint(2, 50, size=3).tolist(), max_tokens=8)
+        eng.run(max_ticks=100)
+        # ...then a MIXED steady state: a long prompt chunks (8-row
+        # chunks -> pb=8) while short batchmates decode in the same
+        # fused dispatch
+        eng.submit(rng.randint(2, 50, size=4).tolist(), max_tokens=12)
+        eng.step()
+        eng.submit(rng.randint(2, 50, size=20).tolist(), max_tokens=8)
         eng.run(max_ticks=300)
-        assert audit.compile_count("serving.decode") == 1
-        assert audit.compile_count("serving.prefill") == 2  # one per bucket
-        # steady state: same bucket shapes must not compile AGAIN
+        pairs = audit.compile_count("serving.step")
+        assert pairs == len(eng._step_fns)    # exactly one compile per pair
+        assert pairs == 3                     # pb in {0, 4, 8}
+        assert audit.compile_count("serving.decode") == 0   # site retired
+        assert audit.compile_count("serving.prefill") == 0
+        assert audit.compile_count("serving.chunk_prefill") == 0
+        # steady state: same pair shapes must not compile AGAIN (the
+        # same arrival pattern, so the packer reproduces the same
+        # buckets — a new pattern could legitimately mint a new pair)
         audit.seal()
-        for p in [rng.randint(2, 50, size=n).tolist() for n in (2, 5, 8)]:
-            eng.submit(p, max_tokens=8)
+        eng.submit(rng.randint(2, 50, size=2).tolist(), max_tokens=8)
+        eng.run(max_ticks=100)
+        eng.submit(rng.randint(2, 50, size=4).tolist(), max_tokens=12)
+        eng.step()
+        eng.submit(rng.randint(2, 50, size=17).tolist(), max_tokens=8)
         eng.run(max_ticks=300)
-        audit.assert_budget("serving.decode", 1)
-        audit.assert_budget("serving.prefill", 2)
+        audit.assert_budget("serving.step", pairs)
         audit.assert_no_retraces()
         snap = audit.snapshot()
-        assert snap["serving.decode"]["calls"] > \
-            snap["serving.decode"]["compiles"]
+        assert snap["serving.step"]["calls"] > \
+            snap["serving.step"]["compiles"]
     finally:
         FLAGS.use_bf16 = old_bf16
 

@@ -457,8 +457,9 @@ def _steady_traffic(eng, clock, n=6):
 
 def test_obs_off_adds_zero_compiles_to_sealed_decode(small_model, audit):
     """FLAGS.obs_trace off: the engine runs on the NULL_TRACER, records
-    nothing, and a sealed steady-state decode stays at EXACTLY one
-    compile with zero retraces — the same budget the pre-obs engine
+    nothing, and a sealed steady-state run of the unified step stays
+    at EXACTLY one compile per (decode_bucket, prefill_bucket) pair
+    with zero retraces — the same per-pair budget the pre-obs engine
     pinned.  Then the same traffic with tracing ON still holds the
     budget and produces token-identical outputs: instrumentation adds
     zero compiles and zero host syncs to the tick either way (the
@@ -471,9 +472,11 @@ def test_obs_off_adds_zero_compiles_to_sealed_decode(small_model, audit):
     assert eng._tracer is NULL_TRACER
     assert eng.pool.tracer is None and eng.scheduler.tracer is None
     out_off = _steady_traffic(eng, clk)
+    pairs = audit.compile_count("serving.step")
+    assert pairs == len(eng._step_fns)       # one compile per pair
     audit.seal()
     out_off += _steady_traffic(eng, clk)     # steady state: no compiles
-    audit.assert_budget("serving.decode", 1)
+    audit.assert_budget("serving.step", pairs)
     audit.assert_no_retraces()
     assert NULL_TRACER.events == [] and len(NULL_TRACER.ring) == 0
 
@@ -483,9 +486,11 @@ def test_obs_off_adds_zero_compiles_to_sealed_decode(small_model, audit):
     eng2 = make_engine(model, params, clk2, prefix_cache=False,
                        tracer=tracer)
     out_on = _steady_traffic(eng2, clk2)
+    pairs_on = auditor().compile_count("serving.step")
     auditor().seal()
     out_on += _steady_traffic(eng2, clk2)
-    auditor().assert_budget("serving.decode", 1)
+    assert pairs_on == pairs
+    auditor().assert_budget("serving.step", pairs_on)
     auditor().assert_no_retraces()
     assert out_on == out_off
     assert any(e.name == "decode_tick" for e in tracer.events)
@@ -522,9 +527,9 @@ def test_auditor_compiles_land_on_the_timeline(small_model, audit):
     eng.run()
     sites = [e.args["site"] for e in tracer.events
              if e.name == "jit_compile"]
-    assert "serving.decode" in sites
-    assert audit.compile_count("serving.decode") == \
-        sites.count("serving.decode")
+    assert "serving.step" in sites
+    assert audit.compile_count("serving.step") == \
+        sites.count("serving.step")
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,561 @@
+"""Ragged paged attention v2 (round 12): one pallas kernel for mixed
+prefill+decode batches, GQA head-group packing, int8-quantized KV pages.
+
+Covers the kernel/reference parity matrix (mixed batches, ragged
+lengths, offset masks, GQA, int8), the single dispatch chooser, the
+bytes-per-page accounting behind ``FLAGS.serving_kv_dtype`` and
+``ServingEngine(pool_bytes=...)``, the unified-step engine (fused vs
+v1-shaped split ticks, token-identical), GQA greedy parity against a
+head-replicated MHA oracle, int8 chaos conservation, and the
+QUANT-DRIFT parity harness the tier-1 ladder greps (exit 7).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.platform.flags import FLAGS
+from paddle_tpu.serving import (BLOCK_ROWS, DecoderLM, FaultPlan,
+                                ManualClock, PagedKVConfig, Request,
+                                RequestStatus, ServingEngine,
+                                attention_path, greedy_decode_reference,
+                                pack_prefill_chunks, pages_for_budget,
+                                quantize_kv, ragged_paged_attention,
+                                ragged_paged_attention_reference)
+from paddle_tpu.serving.decode_attention import (QUANT_DRIFT_BOUND,
+                                                 _ragged_pallas,
+                                                 check_quant_drift,
+                                                 quant_parity_error)
+from paddle_tpu.ops.attention import mha_reference
+
+from conftest import assert_serving_drained as assert_drained  # noqa: E402
+
+ragged = pytest.mark.ragged
+serving = pytest.mark.serving
+
+
+@pytest.fixture(autouse=True)
+def f32():
+    old = FLAGS.use_bf16
+    FLAGS.use_bf16 = False
+    yield
+    FLAGS.use_bf16 = old
+
+
+# ---------------------------------------------------------------------------
+# mixed-batch construction helpers
+# ---------------------------------------------------------------------------
+
+
+def _build_mixed(rng, seqs, page, pm, num_pages, kvh, d, h):
+    """Build a sequence-packed mixed batch.  ``seqs`` is a list of
+    (kv_len, q_rows, q_start): q_rows == 1 models a decode slot (its
+    query sits at position kv_len-1), q_rows > 1 a prefill chunk whose
+    rows occupy positions q_start..q_start+q_rows-1 (so kv_len ==
+    q_start + q_rows).  Rows are padded per-sequence to BLOCK_ROWS (the
+    kernel's packing contract).  Returns (q, k_pages, v_pages, table,
+    kv_lens, row_seq, qpos, contig_k, contig_v)."""
+    s = len(seqs)
+    kc = rng.randn(s, pm * page, kvh, d).astype(np.float32)
+    vc = rng.randn(s, pm * page, kvh, d).astype(np.float32)
+    kp = rng.randn(num_pages, page, kvh, d).astype(np.float32)  # garbage
+    vp = rng.randn(num_pages, page, kvh, d).astype(np.float32)
+    table = np.zeros((s, pm), np.int32)
+    free = list(range(1, num_pages))
+    rng.shuffle(free)
+    for i, (n, _, _) in enumerate(seqs):
+        for j in range(-(-int(n) // page)):
+            pg = free.pop()
+            table[i, j] = pg
+            kp[pg] = kc[i, j * page:(j + 1) * page]
+            vp[pg] = vc[i, j * page:(j + 1) * page]
+    rows, row_seq, qpos = [], [], []
+    for i, (n, qr, qs) in enumerate(seqs):
+        blocks = -(-qr // BLOCK_ROWS)
+        pos = [qs + r for r in range(qr)] if qr > 1 else [n - 1]
+        pos += [-1] * (blocks * BLOCK_ROWS - qr)
+        qpos += pos
+        row_seq += [i] * blocks * BLOCK_ROWS
+        rows.append(blocks * BLOCK_ROWS)
+    t = sum(rows)
+    q = rng.randn(t, h, d).astype(np.float32)
+    return (q, kp, vp, table, np.asarray([n for n, _, _ in seqs], np.int32),
+            np.asarray(row_seq, np.int32), np.asarray(qpos, np.int32),
+            kc, vc)
+
+
+def _oracle(q, kc, vc, kv_lens, row_seq, qpos, h):
+    """Per-row mha_reference oracle over the CONTIGUOUS ground-truth
+    K/V (never touches pages), with the causal/offset mask expressed as
+    a kv-length slice per row."""
+    t = q.shape[0]
+    out = np.zeros_like(q)
+    for r in range(t):
+        if qpos[r] < 0:
+            continue
+        s = row_seq[r]
+        upto = qpos[r] + 1          # row sees tokens 0..qpos inclusive
+        o = mha_reference(jnp.asarray(q[r:r + 1][:, None]),
+                          jnp.asarray(kc[s][None, :upto]),
+                          jnp.asarray(vc[s][None, :upto]))
+        out[r] = np.asarray(o)[0, 0]
+    return out
+
+
+MIXED_CASES = [
+    # (kv_len, q_rows, q_start) per sequence; page=8, pm=4
+    [(13, 1, 0), (9, 5, 4), (20, 1, 0)],          # decode + offset chunk
+    [(8, 8, 0), (1, 1, 0), (32, 1, 0)],           # page-exact chunk, len-1
+    [(27, 11, 16), (5, 1, 0), (17, 17, 0)],       # multi-block chunks
+]
+
+
+@ragged
+@serving
+@pytest.mark.parametrize("kvh,h", [(2, 2), (2, 4)])   # MHA and GQA
+@pytest.mark.parametrize("case", MIXED_CASES)
+def test_ragged_mixed_batch_matches_oracle(rng, case, kvh, h):
+    page, pm, num_pages, d = 8, 4, 32, 16
+    q, kp, vp, table, kv_lens, row_seq, qpos, kc, vc = _build_mixed(
+        rng, case, page, pm, num_pages, kvh, d, h)
+    want = _oracle(q, kc, vc, kv_lens, row_seq, qpos, h)
+    real = qpos >= 0
+
+    ref = np.asarray(ragged_paged_attention_reference(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(table), jnp.asarray(kv_lens), jnp.asarray(row_seq),
+        jnp.asarray(qpos)))
+    np.testing.assert_allclose(ref[real], want[real], rtol=2e-5, atol=2e-5)
+
+    ker = np.asarray(_ragged_pallas(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp), None, None,
+        jnp.asarray(table), jnp.asarray(kv_lens), jnp.asarray(row_seq),
+        jnp.asarray(qpos), float(d) ** -0.5, True))
+    np.testing.assert_allclose(ker[real], want[real], rtol=2e-5, atol=2e-5)
+
+    # public entry, kernel forced (interpret on CPU)
+    pub = np.asarray(ragged_paged_attention(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(table), jnp.asarray(kv_lens), jnp.asarray(row_seq),
+        jnp.asarray(qpos), use_kernel=True))
+    np.testing.assert_allclose(pub[real], want[real], rtol=2e-5, atol=2e-5)
+
+
+@ragged
+@serving
+def test_blocked_reference_matches_oracle(rng):
+    """The engine's row-blocked fallback (bounded per-row K/V gather)
+    is the oracle applied blockwise — identical results on a row stack
+    spanning several blocks, pad rows included."""
+    from paddle_tpu.serving.decode_attention import \
+        _ragged_reference_blocked
+    page, pm, num_pages, kvh, h, d = 8, 4, 32, 2, 4, 16
+    q, kp, vp, table, kv_lens, row_seq, qpos, _, _ = _build_mixed(
+        rng, MIXED_CASES[2], page, pm, num_pages, kvh, d, h)
+    args = (jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(table), jnp.asarray(kv_lens),
+            jnp.asarray(row_seq), jnp.asarray(qpos))
+    want = np.asarray(ragged_paged_attention_reference(*args))
+    got = np.asarray(_ragged_reference_blocked(*args, block=16))
+    real = qpos >= 0
+    np.testing.assert_allclose(got[real], want[real], rtol=1e-6, atol=1e-6)
+
+
+@ragged
+@serving
+def test_cancel_from_chunk_callback_skips_batchmate_chunk(rng):
+    """A request cancelled by a BATCHMATE's on_token (fired from the
+    same unified step's chunk walk) must not have its own chunk results
+    applied: no cache insert on released pages, no resurrection of the
+    terminal status, and conservation holds at drain."""
+    model = DecoderLM(vocab_size=50, num_layers=1, num_heads=2, head_dim=8,
+                      max_positions=128)
+    params = model.init_params(jax.random.PRNGKey(0))
+    eng = _engine(model, params)
+    victim_rid = {}
+
+    def assassin(tok):
+        eng.cancel(victim_rid["b"])
+
+    # both prompts fit one chunk, so both finish prefill — and emit
+    # their first token through the chunk walk — in the SAME tick;
+    # slot order makes A's callback run before B's chunk bookkeeping
+    a = eng.submit(rng.randint(2, 50, size=5).tolist(), max_tokens=4,
+                   on_token=assassin)
+    b = eng.submit(rng.randint(2, 50, size=6).tolist(), max_tokens=4)
+    victim_rid["b"] = b
+    eng.step()
+    assert eng.status(b) is RequestStatus.CANCELLED
+    assert eng.status(a) is RequestStatus.RUNNING
+    eng.run(max_ticks=100)
+    assert eng.status(a) is RequestStatus.COMPLETED
+    assert eng.status(b) is RequestStatus.CANCELLED
+    assert eng.result(b) is None        # never resurrected to COMPLETED
+    assert_drained(eng)
+
+
+@ragged
+@serving
+def test_ragged_kernel_int8_reads_what_reference_reads(rng):
+    """Kernel and gather-fallback dequantize the SAME stored int8
+    values — their outputs agree to float tolerance (the quantization
+    error itself cancels out of this comparison)."""
+    page, pm, num_pages, kvh, h, d = 8, 4, 32, 2, 4, 16
+    q, kp, vp, table, kv_lens, row_seq, qpos, _, _ = _build_mixed(
+        rng, MIXED_CASES[0], page, pm, num_pages, kvh, d, h)
+    kq, ks = quantize_kv(jnp.asarray(kp))
+    vq, vs = quantize_kv(jnp.asarray(vp))
+    args = (jnp.asarray(table), jnp.asarray(kv_lens), jnp.asarray(row_seq),
+            jnp.asarray(qpos))
+    ref = np.asarray(ragged_paged_attention_reference(
+        jnp.asarray(q), kq, vq, *args, k_scale=ks, v_scale=vs))
+    ker = np.asarray(_ragged_pallas(
+        jnp.asarray(q), kq, vq, ks, vs, *args, float(d) ** -0.5, True))
+    real = qpos >= 0
+    np.testing.assert_allclose(ker[real], ref[real], rtol=2e-5, atol=2e-5)
+
+
+@ragged
+@serving
+def test_int8_quant_parity_harness_within_bound(rng):
+    """THE QUANT-DRIFT gate: the int8 roundtrip must stay inside its
+    logit-error bound on a mixed ragged batch.  If quantization ever
+    regresses (wrong scale axis, missing dequant, clipped range), this
+    raises with the grep-able QUANT-DRIFT tag and tools_tier1.sh exits
+    7."""
+    page, pm, num_pages, kvh, h, d = 8, 4, 32, 2, 4, 16
+    q, kp, vp, table, kv_lens, row_seq, qpos, _, _ = _build_mixed(
+        rng, MIXED_CASES[2], page, pm, num_pages, kvh, d, h)
+    err = check_quant_drift(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(table), jnp.asarray(kv_lens), jnp.asarray(row_seq),
+        jnp.asarray(qpos))
+    assert 0.0 <= err <= QUANT_DRIFT_BOUND
+    # and the tag actually fires when the bound is violated (an
+    # impossible bound stands in for a broken quant path; pytest.raises
+    # swallows the message so the tier-1 grep never sees a passing run)
+    with pytest.raises(AssertionError, match="QUANT-DRIFT"):
+        check_quant_drift(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(table), jnp.asarray(kv_lens),
+            jnp.asarray(row_seq), jnp.asarray(qpos), bound=0.0)
+    assert quant_parity_error(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(table), jnp.asarray(kv_lens), jnp.asarray(row_seq),
+        jnp.asarray(qpos)) == err
+
+
+# ---------------------------------------------------------------------------
+# the single dispatch chooser
+# ---------------------------------------------------------------------------
+
+
+@ragged
+@serving
+def test_attention_path_single_chooser():
+    # forced answers win over everything
+    assert attention_path(7, 3, use_kernel=True) == "kernel"
+    assert attention_path(128, 128, use_kernel=False) == "reference"
+    # interpret (the CPU default) rides the reference path
+    assert attention_path(128, 128, interpret=True) == "reference"
+    # native gate: lane-aligned head dim, sublane-aligned pages
+    assert attention_path(128, 128, interpret=False) == "kernel"
+    assert attention_path(96, 128, interpret=False) == "reference"
+    assert attention_path(128, 12, interpret=False) == "reference"
+    # int8 additionally wants lane-aligned pages for its scale vectors
+    assert attention_path(128, 128, quantized=True,
+                          interpret=False) == "kernel"
+    assert attention_path(128, 64, quantized=True,
+                          interpret=False) == "reference"
+    # mismatched head grouping falls back
+    assert attention_path(128, 128, num_heads=6, num_kv_heads=4,
+                          interpret=False) == "reference"
+    assert attention_path(128, 128, num_heads=8, num_kv_heads=4,
+                          interpret=False) == "kernel"
+
+
+# ---------------------------------------------------------------------------
+# bytes-per-page accounting + pool byte budgets (serving_kv_dtype)
+# ---------------------------------------------------------------------------
+
+
+def _cfg(dtype, kvh=None):
+    return PagedKVConfig(num_layers=2, num_heads=4, head_dim=16,
+                         page_size=8, num_pages=10, max_pages_per_seq=4,
+                         dtype=dtype, num_kv_heads=kvh)
+
+
+@ragged
+@serving
+def test_bytes_per_page_accounting():
+    f32, bf16, i8 = (_cfg(jnp.float32), _cfg(jnp.bfloat16), _cfg(jnp.int8))
+    # exact arithmetic: 2 (K+V) * L * page * H_kv * D * itemsize
+    assert f32.bytes_per_page() == 2 * 2 * 8 * 4 * 16 * 4
+    assert bf16.bytes_per_page() == f32.bytes_per_page() // 2
+    # int8 = 1 byte/elem + one f32 scale per (layer, token, kv head)
+    assert i8.bytes_per_page() == 2 * 2 * 8 * 4 * (16 * 1 + 4)
+    assert f32.kv_bytes() == 10 * f32.bytes_per_page()
+    # GQA halves the pool bytes when kv heads halve
+    assert _cfg(jnp.float32, kvh=2).bytes_per_page() == \
+        f32.bytes_per_page() // 2
+    # the acceptance arithmetic: at one byte budget, int8 admits the
+    # pages the smaller footprint buys — >= 1.8x f32 even with the
+    # scale overhead (exactly 3.2x at D=16)
+    budget = 1 << 20
+    pages = {d: pages_for_budget(budget, 2, 4, 16, 8, d)
+             for d in ("float32", "bfloat16", "int8")}
+    assert pages["int8"] >= int(1.8 * pages["float32"])
+    assert pages["bfloat16"] == 2 * pages["float32"]
+    assert pages["int8"] == int(budget // _cfg(jnp.int8).bytes_per_page())
+
+
+@ragged
+@serving
+def test_bf16_kv_pool_via_flag_and_param(rng):
+    """Satellite: serving_kv_dtype plumbs through the cache config —
+    bf16 KV works end to end even without int8."""
+    model = DecoderLM(vocab_size=50, num_layers=1, num_heads=2, head_dim=8,
+                      max_positions=64)
+    params = model.init_params(jax.random.PRNGKey(0))
+    old = FLAGS.serving_kv_dtype
+    try:
+        FLAGS.serving_kv_dtype = "bfloat16"
+        eng = ServingEngine(model, params, eos_id=1, page_size=4,
+                            num_pages=20, max_pages_per_seq=5, max_slots=2,
+                            buckets=(4, 8))
+    finally:
+        FLAGS.serving_kv_dtype = old
+    assert eng.kv_cfg.dtype == jnp.bfloat16
+    assert eng._kv.k.dtype == jnp.bfloat16 and eng._kv.k_scale is None
+    rid = eng.submit(rng.randint(2, 50, size=6).tolist(), max_tokens=6)
+    res = eng.run(max_ticks=100)
+    assert eng.status(rid) is RequestStatus.COMPLETED and len(res[rid]) >= 1
+    assert eng.healthz()["kv_dtype"] == "bfloat16"
+    assert_drained(eng)
+    # explicit param wins over the flag
+    eng2 = ServingEngine(model, params, eos_id=1, page_size=4,
+                         num_pages=20, max_pages_per_seq=5, max_slots=2,
+                         buckets=(4, 8), kv_dtype="int8")
+    assert eng2.kv_cfg.quantized and eng2._kv.k_scale is not None
+
+
+@ragged
+@serving
+def test_pool_bytes_budget_doubles_int8_admission(rng):
+    """The scheduler charges admission in pages, so the int8 page
+    multiplier IS an admission multiplier: at the same pool_bytes the
+    int8 engine owns >= 1.8x the f32 pages."""
+    model = DecoderLM(vocab_size=50, num_layers=1, num_heads=2, head_dim=8,
+                      max_positions=64)
+    params = model.init_params(jax.random.PRNGKey(0))
+    budget = 64 * 1024
+    engines = {d: ServingEngine(model, params, eos_id=1, page_size=4,
+                                num_pages=None, pool_bytes=budget,
+                                max_pages_per_seq=5, max_slots=2,
+                                buckets=(4, 8), kv_dtype=d)
+               for d in ("float32", "int8")}
+    f32p = engines["float32"].pool.num_usable
+    i8p = engines["int8"].pool.num_usable
+    assert i8p >= int(1.8 * f32p)
+    hz = engines["int8"].healthz()
+    assert hz["pages_total"] == i8p and hz["kv_dtype"] == "int8"
+
+
+# ---------------------------------------------------------------------------
+# packer policy
+# ---------------------------------------------------------------------------
+
+
+def _fake_req(n_tokens, done=0):
+    r = Request(prompt=list(range(2, 2 + n_tokens)), max_tokens=4)
+    r.cache_len = done
+    return r
+
+
+@ragged
+@serving
+def test_pack_prefill_chunks_budget_align_and_oversize():
+    a, b, c = _fake_req(20), _fake_req(20), _fake_req(4)
+    sel, total = pack_prefill_chunks([a, b, c], chunk=8, align=8, budget=16)
+    # greedy in order until the budget: a and b fit, c is crowded out
+    assert [(r.rid, s, n, rows) for r, s, n, rows in sel] == \
+        [(a.rid, 0, 8, 8), (b.rid, 0, 8, 8)]
+    assert total == 16
+    # alignment pads partial chunks to whole blocks
+    sel, total = pack_prefill_chunks([c], chunk=8, align=8, budget=16)
+    assert sel == [(c, 0, 4, 8)] and total == 8
+    # the first chunk packs even when it alone exceeds the budget
+    big = _fake_req(40)
+    sel, total = pack_prefill_chunks([big], chunk=0, align=1, budget=16)
+    assert sel == [(big, 0, 40, 40)] and total == 40
+    # resume point honors prior progress; finished requests are skipped
+    sel, _ = pack_prefill_chunks([_fake_req(20, done=17),
+                                  _fake_req(6, done=6)],
+                                 chunk=8, align=1, budget=16)
+    assert [(s, n) for _, s, n, _ in sel] == [(17, 3)]
+
+
+# ---------------------------------------------------------------------------
+# unified-step engine: kernel parity, fused-vs-split, GQA, int8
+# ---------------------------------------------------------------------------
+
+
+def _mixed_traffic(eng, rng_seed=0):
+    """Mixed long-prefill/heavy-decode traffic: long prompts chunking
+    while short ones decode — the shape the v1 tick interleave handled
+    worst.  Deterministic; returns outputs in submit order."""
+    rng = np.random.RandomState(rng_seed)
+    prompts = [rng.randint(2, 50, size=n).tolist()
+               for n in (3, 26, 5, 19, 2, 11)]
+    rids = []
+    for i, p in enumerate(prompts):
+        rids.append(eng.submit(p, max_tokens=10 if len(p) < 8 else 4))
+        if i % 2:
+            eng.step()              # interleave arrivals with ticks
+    eng.run(max_ticks=400)
+    return prompts, rids, [eng.result(r) for r in rids]
+
+
+def _engine(model, params, **kw):
+    kw.setdefault("eos_id", 1)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("num_pages", 60)
+    kw.setdefault("max_pages_per_seq", 10)
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("buckets", (8, 16, 32))
+    kw.setdefault("prefill_chunk", 8)
+    return ServingEngine(model, params, **kw)
+
+
+@ragged
+@serving
+def test_engine_kernel_fallback_parity_mixed(rng):
+    """CPU fallback parity for the ragged kernel at ENGINE level: the
+    same mixed prefill+decode traffic (ragged lengths, offset masks via
+    chunked prefill) through use_kernel=True (pallas, interpret on CPU)
+    and the reference path produces token-identical outputs, and both
+    match the non-paged oracle."""
+    model = DecoderLM(vocab_size=50, num_layers=2, num_heads=2, head_dim=8,
+                      max_positions=128)
+    params = model.init_params(jax.random.PRNGKey(0))
+    prompts, _, out_ref = _mixed_traffic(_engine(model, params,
+                                                 use_kernel=False))
+    _, _, out_ker = _mixed_traffic(_engine(model, params, use_kernel=True))
+    assert out_ker == out_ref
+    for p, toks in zip(prompts, out_ref):
+        mt = 10 if len(p) < 8 else 4
+        assert toks == greedy_decode_reference(model, params, p, mt, 1)
+
+
+@ragged
+@serving
+def test_fused_vs_split_tick_token_identical(rng):
+    """fuse_tick=False reproduces the v1 two-dispatch tick shape as the
+    bench A/B control: token-identical outputs, strictly more
+    dispatches for the same work."""
+    model = DecoderLM(vocab_size=50, num_layers=2, num_heads=2, head_dim=8,
+                      max_positions=128)
+    params = model.init_params(jax.random.PRNGKey(0))
+    fused = _engine(model, params)
+    split = _engine(model, params, fuse_tick=False)
+    _, _, out_f = _mixed_traffic(fused)
+    _, _, out_s = _mixed_traffic(split)
+    assert out_f == out_s
+    assert split.metrics.step_dispatches > fused.metrics.step_dispatches
+    assert fused.metrics.prefill_rows == split.metrics.prefill_rows
+    assert_drained(fused)
+    assert_drained(split)
+
+
+@ragged
+@serving
+def test_gqa_engine_parity_vs_head_replicated_mha_oracle(rng):
+    """Satellite: a GQA DecoderLM (num_kv_heads < num_heads) decodes
+    token-identically to (a) the non-paged greedy oracle on its own
+    weights and (b) an MHA DecoderLM whose K/V projections replicate
+    each KV head across its query group — the algebraic identity GQA
+    packing must preserve."""
+    gqa = DecoderLM(vocab_size=50, num_layers=2, num_heads=4, head_dim=8,
+                    num_kv_heads=2, max_positions=128)
+    gp = gqa.init_params(jax.random.PRNGKey(3))
+    assert gp["l0.wk"].shape == (32, 16)          # E x (H_kv * D)
+    # head-replicated MHA twin: KV head g serves query heads 2g, 2g+1
+    mha = DecoderLM(vocab_size=50, num_layers=2, num_heads=4, head_dim=8,
+                    max_positions=128)
+    mp = dict(gp)
+    group = gqa.num_heads // gqa.num_kv_heads
+    for l in range(2):
+        for w in ("wk", "wv"):
+            m = gp[f"l{l}.{w}"].reshape(32, gqa.num_kv_heads, 8)
+            mp[f"l{l}.{w}"] = jnp.repeat(m, group, axis=1).reshape(32, 32)
+    prompts = [np.random.RandomState(7).randint(2, 50, size=n).tolist()
+               for n in (3, 9, 14)]
+    for p in prompts:
+        want = greedy_decode_reference(mha, mp, p, 8, 1)
+        assert greedy_decode_reference(gqa, gp, p, 8, 1) == want
+    eng = _engine(gqa, gp)
+    rids = [eng.submit(p, max_tokens=8) for p in prompts]
+    res = eng.run(max_ticks=200)
+    for p, rid in zip(prompts, rids):
+        assert res[rid] == greedy_decode_reference(mha, mp, p, 8, 1)
+    # the pool really stores only the KV heads
+    assert eng._kv.k.shape[3] == 2
+    assert_drained(eng)
+
+
+@ragged
+@serving
+def test_int8_engine_completes_with_prefix_cow_and_conservation(rng):
+    """int8 pages through the full engine: chunked prefill, prefix
+    cache hits, a COW fork (scales must fork with the values), and the
+    REF-LEAK/PAGE-LEAK conservation checks at drain.  Determinism:
+    resubmitting an identical prompt (now a full-cover cache hit that
+    decodes from forked int8 pages) reproduces the first answer
+    token-for-token."""
+    model = DecoderLM(vocab_size=50, num_layers=2, num_heads=2, head_dim=8,
+                      max_positions=128)
+    params = model.init_params(jax.random.PRNGKey(0))
+    eng = _engine(model, params, kv_dtype="int8")
+    sys_p = rng.randint(2, 50, size=12).tolist()
+    a = eng.submit(sys_p, max_tokens=6)
+    eng.run(max_ticks=100)
+    b = eng.submit(sys_p, max_tokens=6)          # full-cover hit -> COW
+    c = eng.submit(sys_p + [9, 8], max_tokens=6)  # partial hit
+    res = eng.run(max_ticks=200)
+    assert res[b] == res[a]
+    assert eng.metrics.cow_forks >= 1
+    assert eng.metrics.prefill_tokens_saved > 0
+    assert len(res[c]) >= 1
+    assert_drained(eng)
+
+
+@ragged
+@serving
+@pytest.mark.faults
+def test_int8_chaos_keeps_conservation_and_terminal_statuses(rng):
+    """Acceptance: 0 PAGE-LEAK / REF-LEAK under the chaos plan with
+    int8 pages enabled — pressure, transient decode errors, a NaN rid,
+    preemption and eviction all running over quantized pages."""
+    model = DecoderLM(vocab_size=50, num_layers=1, num_heads=2, head_dim=8,
+                      max_positions=128)
+    params = model.init_params(jax.random.PRNGKey(0))
+    clock = ManualClock(tick_s=0.02)
+    plan = FaultPlan(seed=0, clock=clock, decode_error_rate=0.1,
+                     page_pressure=(3, 12, 10))
+    # eos outside the vocab: every request really decodes its full
+    # max_tokens, so the poisoned rid is guaranteed to meet the NaN
+    # injection at a decode tick (a first token emitted straight from
+    # prefill could otherwise complete it before poisoning applies)
+    eng = _engine(model, params, kv_dtype="int8", num_pages=24,
+                  max_pages_per_seq=8, faults=plan, watchdog_ticks=32,
+                  eos_id=51)
+    prompts = [rng.randint(2, 50, size=rng.randint(2, 14)).tolist()
+               for _ in range(8)]
+    rids = [eng.submit(p, max_tokens=8) for p in prompts]
+    plan.poison_nan(rids[3])
+    eng.run(max_ticks=500)
+    assert eng.status(rids[3]) is RequestStatus.FAILED
+    for r in rids:
+        assert eng.status(r).terminal
+    assert_drained(eng)

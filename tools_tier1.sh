@@ -36,6 +36,17 @@ if grep -aq 'REF-LEAK' /tmp/_t1.log; then
     print_postmortems
     exit 4
 fi
+# int8 KV quantization parity (round 12): the parity harness
+# (serving/decode_attention.py check_quant_drift, exercised by the
+# ragged suite) stamps QUANT-DRIFT into any failure where the int8
+# roundtrip exceeds its logit-error bound — a quantization regression
+# is a loud, distinct failure (exit 7 extends the ladder), not one
+# more red test to skim past
+if grep -aq 'QUANT-DRIFT' /tmp/_t1.log; then
+    echo 'QUANT-DRIFT: int8 KV parity exceeded its logit-error bound (see log above)'
+    print_postmortems
+    exit 7
+fi
 # repo-invariant linter (paddle_tpu.analysis.lint): wall-clock in
 # serving/master, unseeded global RNG, per-tick host syncs, mutable
 # defaults, import-time FLAGS reads.  Findings print a LINT-FAIL tag;
