@@ -20,6 +20,13 @@ Three cooperating passes (the compile-first contract a TPU stack needs:
   per-tick serving loops, mutable default args, import-time FLAGS reads),
   allowlistable via inline ``# lint: allow(<rule>)`` and runnable as
   ``python -m paddle_tpu.analysis lint``.
+- :mod:`paddle_tpu.analysis.xla` — jaxpr-level compiled-path auditor
+  over the captured ``audit_jit`` sites: donation contracts, dtype
+  promotion drift, host transfers/callbacks, const-captured weights,
+  collective placement, and per-site memory/FLOP budgets declared via
+  :class:`~paddle_tpu.analysis.retrace.SiteContract` next to the jit
+  call.  Runs as ``python -m paddle_tpu.analysis xla`` (tier-1 ladder
+  exit 8 on ``XLA-AUDIT`` findings).
 
 This ``__init__`` stays import-light on purpose: the serving engine and
 trainer import :func:`audit_jit` from here on their hot construction
@@ -28,6 +35,7 @@ import of the package.
 """
 
 from paddle_tpu.analysis.diagnostics import Diagnostic, Severity
-from paddle_tpu.analysis.retrace import audit_jit, auditor
+from paddle_tpu.analysis.retrace import SiteContract, audit_jit, auditor
 
-__all__ = ["Diagnostic", "Severity", "audit_jit", "auditor"]
+__all__ = ["Diagnostic", "Severity", "SiteContract", "audit_jit",
+           "auditor"]

@@ -11,6 +11,16 @@
     package).  Findings print one per line; a nonzero count ends with a
     ``LINT-FAIL`` tagged line and exit 1 — ``tools_tier1.sh`` greps the
     tag and turns it into exit code 5.
+
+``xla [--rule NAME ...] [--strict]``
+    Drive the sealed mixed serving steady state (int8 KV, prefix cache
+    on) plus one trainer step under ``FLAGS.jit_audit``, then audit the
+    jaxpr of every captured ``audit_jit`` site against its declared
+    :class:`~paddle_tpu.analysis.retrace.SiteContract` (donation, dtype
+    drift, host transfers, const capture, collectives, memory/FLOP
+    budgets).  Exit 0 = clean, 1 = XLA-AUDIT findings, 2 = the auditor
+    itself crashed — ``tools_tier1.sh`` branches on the exit status and
+    turns 1/2 into ladder exit 8.
 """
 
 from __future__ import annotations
@@ -78,6 +88,39 @@ def cmd_lint(args) -> int:
     return 0
 
 
+def cmd_xla(args) -> int:
+    from paddle_tpu.analysis.diagnostics import Severity
+    from paddle_tpu.analysis.xla import RULES, run_compiled_path_audit
+
+    unknown = [r for r in (args.rule or []) if r not in RULES]
+    if unknown:
+        print(f"unknown rule(s) {unknown}; known: {sorted(RULES)}",
+              file=sys.stderr)
+        return 2
+    try:
+        # --rule restricts which rules RUN, so printed findings, the
+        # summary and the exit status all agree (RETRACE diagnostics
+        # from the sealed replay are always folded in)
+        reports, diags = run_compiled_path_audit(
+            rules=args.rule or None)
+    except Exception as e:      # crash != findings: distinct exit code
+        print(f"xla audit crashed: {e!r}")
+        return 2
+    errs = [d for d in diags if d.severity is Severity.ERROR]
+    if errs or (args.strict and diags):
+        strict_note = ""
+        if args.strict and len(diags) > len(errs):
+            strict_note = (f" + {len(diags) - len(errs)} non-ERROR "
+                           "finding(s) failing under --strict")
+        print(f"XLA-AUDIT: {len(errs)} ERROR finding(s){strict_note} "
+              f"across {len(reports)} audited site(s) — fix the site, "
+              "or declare the intent in its SiteContract")
+        return 1
+    print(f"xla audit ok: {len(reports)} site(s), 0 ERROR findings "
+          f"({len(diags)} informational)")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
@@ -106,6 +149,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--rule", action="append", default=[],
                    help="restrict to the named rule(s); repeatable")
     p.set_defaults(fn=cmd_lint)
+
+    p = sub.add_parser(
+        "xla", help="audit the compiled jaxprs of every audit_jit site "
+                    "over a sealed serving steady state + one train step")
+    p.add_argument("--rule", action="append", default=[],
+                   help="restrict the audit to the named rule(s); "
+                        "repeatable (RETRACE diagnostics from the "
+                        "sealed replay are always included)")
+    p.add_argument("--strict", action="store_true",
+                   help="exit 1 on ANY diagnostic, not just ERRORs")
+    p.set_defaults(fn=cmd_xla)
 
     args = parser.parse_args(argv)
     return args.fn(args)

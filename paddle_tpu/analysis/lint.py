@@ -17,11 +17,15 @@ Every rule encodes a repo contract that tests cannot easily enforce:
 - ``host-sync``        — ``.item()``, ``np.asarray``/``np.array``/
   ``jnp.asarray``/``jax.device_get`` calls — and ``float()``/``int()``
   over a jax expression — lexically inside a ``for``/``while`` loop in
-  serving or obs code: a per-tick loop that syncs per element
-  serializes the device pipeline (one sync per *tick* is the engine's
-  documented budget, and instrumentation must add ZERO to it — obs is
-  covered so a tracer hook can never smuggle a readback into the
-  tick).
+  serving, obs or platform code: a per-tick loop that syncs per
+  element serializes the device pipeline (one sync per *tick* is the
+  engine's documented budget, and instrumentation must add ZERO to it
+  — obs is covered so a tracer hook can never smuggle a readback into
+  the tick).  ``block_until_ready`` (method or ``jax.`` function form)
+  is flagged at ANY depth, loop or not: it stalls on the WHOLE
+  pipeline, so the only sanctioned uses are deliberate end-of-window
+  timing syncs (``platform/stats.py``'s ``timer(block=...)``), each
+  carrying a justified ``# lint: allow(host-sync)``.
 - ``mutable-default``  — mutable default argument values (list/dict/set
   literals or constructors), the classic shared-state trap.
 - ``import-time-flags``— reading ``FLAGS.<name>`` at module import time
@@ -167,6 +171,21 @@ class _LoopSyncVisitor(ast.NodeVisitor):
     visit_While = _visit_loop
 
     def visit_Call(self, node: ast.Call):
+        # block_until_ready is flagged at ANY depth (not just loops):
+        # it drains the whole dispatch pipeline, which serving/obs/
+        # platform layers may only do as a deliberate, annotated
+        # end-of-timing-window sync.  Covers both the method form
+        # (x.block_until_ready()) and jax.block_until_ready(x).
+        chain = _attr_chain(node.func)
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "block_until_ready") \
+                or (len(chain) == 2 and chain[0] == "jax"
+                    and chain[1] == "block_until_ready"):
+            self.findings.append(
+                (node.lineno, "block_until_ready() stalls on the whole "
+                 "device pipeline — sync at most once per window, and "
+                 "annotate a deliberate timing sync with "
+                 "`# lint: allow(host-sync)`"))
         if self.loop_depth > 0:
             if isinstance(node.func, ast.Attribute) \
                     and node.func.attr == "item":
@@ -266,8 +285,9 @@ RULES: Dict[str, Rule] = {
         lambda parts: True, _check_unseeded_random),
     "host-sync": Rule(
         "host-sync",
-        "per-element device syncs inside serving/obs loops",
-        _in_dirs("serving", "obs"), _check_host_sync),
+        "per-element device syncs inside serving/obs/platform loops "
+        "(+ block_until_ready anywhere in those layers)",
+        _in_dirs("serving", "obs", "platform"), _check_host_sync),
     "mutable-default": Rule(
         "mutable-default", "mutable default argument values",
         lambda parts: True, _check_mutable_default),

@@ -45,12 +45,49 @@ from __future__ import annotations
 import functools
 import threading
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from paddle_tpu.analysis.diagnostics import Diagnostic, Severity
 
-__all__ = ["audit_jit", "auditor", "RetraceAuditor", "RetraceError",
-           "abstract_signature"]
+__all__ = ["audit_jit", "auditor", "CapturedCall", "RetraceAuditor",
+           "RetraceError", "SiteContract", "abstract_signature"]
+
+
+@dataclass(frozen=True)
+class SiteContract:
+    """Declared compiled-path contract for an ``audit_jit`` site,
+    checked by the jaxpr auditor (:mod:`paddle_tpu.analysis.xla`) —
+    the budget/donation declarations live NEXT TO the jit call so the
+    contract and the code it binds cannot drift apart.
+
+    - ``donate``: positional argnums that MUST appear in the jit's
+      *requested* ``donate_argnums``.  Checked against the requested
+      kwargs, not the backend behavior, so a CPU tier-1 run still
+      verifies the TPU donation contract (CPU cannot donate; see
+      :func:`audit_jit`'s backend strip).
+    - ``per_tick``: this site runs on the serving hot path — host
+      callbacks and collectives inside it are ERRORs, not INFO.
+    - ``allow_collectives``: collectives are the POINT of this site
+      (ZeRO placement, sharded train steps) — report INFO, never ERROR.
+    - ``allow_upcast``: source dtype names ("bfloat16", "int8") whose
+      promotion into f32 matmuls/reductions is intentional (the
+      int8-dequant path, f32 loss/norm reductions under use_bf16,
+      attn_pv_f32) — anything else narrow feeding an f32 sink is drift.
+    - ``peak_bytes`` / ``flops``: per-signature budgets for the
+      abstract live-set / FLOP estimator; None = unbudgeted.
+    - ``big_arg_bytes`` / ``const_bytes``: per-site overrides for the
+      donation-candidate and const-capture thresholds (None = the
+      ``FLAGS.xla_audit_*`` process defaults).
+    """
+
+    donate: Tuple[int, ...] = ()
+    per_tick: bool = False
+    allow_collectives: bool = False
+    allow_upcast: Tuple[str, ...] = ()
+    peak_bytes: Optional[int] = None
+    flops: Optional[float] = None
+    big_arg_bytes: Optional[int] = None
+    const_bytes: Optional[int] = None
 
 
 class RetraceError(AssertionError):
@@ -75,8 +112,38 @@ def abstract_signature(args: Tuple, kwargs: Dict) -> Tuple:
 
 
 @dataclass
+class CapturedCall:
+    """One audited signature, self-contained for the jaxpr auditor:
+    the RAW python callable that traced it, the *requested* jit kwargs
+    (donation contract intact even where the backend strips it), the
+    :class:`SiteContract` declared at that wrap, and the abstract
+    ``(args, kwargs)`` (array leaves collapsed to
+    ``jax.ShapeDtypeStruct`` — the ARGS hold no device buffers, so
+    donation is unaffected).  Note the raw callable itself may close
+    over its owner (the engine's step closes over the engine, KV pool
+    included), so audit mode keeps wrapped owners alive while their
+    captures exist — ``auditor().reset()`` clears captures AND the
+    per-site fn references, which is the reclamation path for a
+    long-running audited fleet that replaces replicas.  Carried PER
+    CAPTURE, not per site: two engines sharing a site name (a
+    heterogeneous fleet, two engines in one test) wrap different
+    closures, and each signature must replay through the closure that
+    actually traced it."""
+
+    fn: Callable
+    jit_kwargs: Dict[str, object]
+    contract: Optional[SiteContract]
+    args: Tuple
+    kwargs: Dict
+
+
+@dataclass
 class SiteRecord:
-    """Per-site call/compile history."""
+    """Per-site call/compile history, plus — under ``FLAGS.jit_audit``
+    — one :class:`CapturedCall` per distinct signature for the jaxpr
+    auditor.  ``jit_kwargs``/``contract`` mirror the LATEST wrap at
+    this site (the inspection/scrape convenience); the auditor reads
+    the per-capture copies."""
 
     name: str
     calls: int = 0
@@ -86,6 +153,10 @@ class SiteRecord:
     # happened even without sealing)
     compiled_sigs: Dict[Tuple, int] = field(default_factory=dict)
     _pending_sig: Optional[Tuple] = None
+    fn: Optional[Callable] = None
+    jit_kwargs: Dict[str, object] = field(default_factory=dict)
+    contract: Optional[SiteContract] = None
+    captured: Dict[Tuple, CapturedCall] = field(default_factory=dict)
 
 
 class RetraceAuditor:
@@ -125,10 +196,14 @@ class RetraceAuditor:
                     name, sealed=self._sealed_all)
             return rec
 
-    def _on_call(self, rec: SiteRecord, sig: Tuple) -> None:
+    def _on_call(self, rec: SiteRecord, sig: Tuple,
+                 capture: Optional[Callable[[], "CapturedCall"]] = None
+                 ) -> None:
         with self._lock:
             rec.calls += 1
             rec._pending_sig = sig
+            if capture is not None and sig not in rec.captured:
+                rec.captured[sig] = capture()
 
     def _on_compile(self, rec: SiteRecord) -> None:
         if self.tracer is not None:
@@ -199,7 +274,11 @@ class RetraceAuditor:
         live ``audit_jit`` wrappers hold references to their SiteRecord,
         so replacing the dict would orphan them and every later count
         would silently read 0 while the wrappers kept incrementing the
-        discarded records."""
+        discarded records.  Captures AND the per-site fn/kwargs
+        references are dropped too: the captured closures can pin their
+        owning engine (KV pool included), so reset() is also the memory
+        reclamation path — live wrappers re-capture on their next call.
+        """
         self.tracer = None
         with self._lock:
             self._sealed_all = False
@@ -209,7 +288,32 @@ class RetraceAuditor:
                 rec.sealed = False
                 rec.compiled_sigs.clear()
                 rec._pending_sig = None
+                rec.captured.clear()
+                rec.fn = None
+                rec.jit_kwargs = {}
+                rec.contract = None
             self.diagnostics.clear()
+
+    def publish(self, registry, **labels) -> None:
+        """Land per-site compile/call counts on a unified
+        :class:`~paddle_tpu.obs.registry.MetricsRegistry` as
+        ``jit_compiles_total{site=...}`` / ``jit_calls_total{site=...}``
+        — before this, compiles existed only as ``jit_compile`` trace
+        instants (:meth:`attach_tracer`), invisible to a Prometheus
+        scraper.  ``ServingEngine.healthz`` calls it whenever the
+        auditor has sites, so the engine's scrape surface carries the
+        compile ladder next to the serving counters."""
+        with self._lock:
+            counts = [(name, rec.calls, rec.compiles)
+                      for name, rec in self.sites.items()]
+        compiles = registry.gauge(
+            "jit_compiles_total",
+            "cumulative XLA compiles per audited jit site")
+        calls = registry.gauge(
+            "jit_calls_total", "cumulative calls per audited jit site")
+        for name, n_calls, n_compiles in counts:
+            compiles.labels(site=name, **labels).set(n_compiles)
+            calls.labels(site=name, **labels).set(n_calls)
 
     def snapshot(self) -> Dict[str, Dict[str, int]]:
         """{site: {calls, compiles, distinct_signatures}} — one dict an
@@ -230,34 +334,89 @@ def auditor() -> RetraceAuditor:
     return _AUDITOR
 
 
-def audit_jit(fn, *, site: str, **jit_kwargs):
+def _backend_jit_kwargs(jit_kwargs: Dict) -> Dict:
+    """Donation is a CONTRACT declaration even on backends that cannot
+    honor it: strip ``donate_argnums``/``donate_argnames`` before the
+    underlying ``jax.jit`` on CPU (which would only warn and ignore
+    them), so call sites declare the TPU donation contract
+    unconditionally and tier-1 CPU runs stay warning-free while the
+    jaxpr auditor checks the *requested* kwargs — the old per-backend
+    gate in the engine left donation contracts untested under tier-1."""
+    if not (jit_kwargs.get("donate_argnums")
+            or jit_kwargs.get("donate_argnames")):
+        return jit_kwargs
+    import jax
+
+    if jax.default_backend() != "cpu":
+        return jit_kwargs
+    kw = dict(jit_kwargs)
+    kw.pop("donate_argnums", None)
+    kw.pop("donate_argnames", None)
+    return kw
+
+
+def _abstract_call(args: Tuple, kwargs: Dict) -> Tuple:
+    """(args, kwargs) with array leaves collapsed to ShapeDtypeStruct —
+    re-traceable through jax.make_jaxpr without holding device buffers
+    (a donated arg must not be kept alive by the audit capture)."""
+    import jax
+
+    def leaf(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+        return x
+
+    return jax.tree.map(leaf, (args, kwargs))
+
+
+def audit_jit(fn, *, site: str, xla_contract: Optional[SiteContract] = None,
+              **jit_kwargs):
     """``jax.jit`` with retrace accounting under ``FLAGS.jit_audit``.
 
     With the flag off this IS ``jax.jit(fn, **jit_kwargs)`` — zero
-    overhead, zero behavior change.  With it on, every call records its
+    overhead, zero behavior change (modulo the CPU donation strip,
+    which only removes a warning).  With it on, every call records its
     abstract signature and every actual trace of ``fn`` counts as a
     compile at ``site`` (jax only executes the python body when
-    tracing, so the count is exact, not inferred from signatures).
+    tracing, so the count is exact, not inferred from signatures); the
+    site also captures one abstract ``(args, kwargs)`` per signature
+    plus the requested jit kwargs, which is everything the jaxpr
+    auditor (``python -m paddle_tpu.analysis xla``) needs to
+    re-materialize and rule-check the compiled program.
+
+    ``xla_contract`` declares the site's compiled-path contract
+    (:class:`SiteContract`: donation, budgets, allowlists) right next
+    to the jit call; it is inert unless the auditor runs.
     """
     import jax
 
     from paddle_tpu.platform.flags import FLAGS
 
     if not getattr(FLAGS, "jit_audit", False):
-        return jax.jit(fn, **jit_kwargs)
+        return jax.jit(fn, **_backend_jit_kwargs(jit_kwargs))
 
     rec = _AUDITOR.site(site)
+    rec.fn = fn
+    rec.jit_kwargs = dict(jit_kwargs)        # REQUESTED, pre-strip
+    if xla_contract is not None:
+        rec.contract = xla_contract
 
     @functools.wraps(fn)
     def traced(*args, **kwargs):
         _AUDITOR._on_compile(rec)
         return fn(*args, **kwargs)
 
-    jitted = jax.jit(traced, **jit_kwargs)
+    jitted = jax.jit(traced, **_backend_jit_kwargs(jit_kwargs))
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
-        _AUDITOR._on_call(rec, abstract_signature(args, kwargs))
+        def capture() -> CapturedCall:
+            a, k = _abstract_call(args, kwargs)
+            return CapturedCall(fn=fn, jit_kwargs=dict(jit_kwargs),
+                                contract=xla_contract, args=a, kwargs=k)
+
+        _AUDITOR._on_call(rec, abstract_signature(args, kwargs),
+                          capture=capture)
         return jitted(*args, **kwargs)
 
     wrapper._audit_site = site

@@ -256,9 +256,13 @@ def _identity_jit(sharding, site: str):
     """One compiled identity per (sharding, site) — per-call wrappers
     would re-trace an identical signature every call (a real retrace the
     audit sites would rightly flag)."""
-    from paddle_tpu.analysis.retrace import audit_jit
+    from paddle_tpu.analysis.retrace import SiteContract, audit_jit
 
-    return audit_jit(lambda a: a, site=site, out_shardings=sharding)
+    # collectives (the resharding all-gather/scatter the out_shardings
+    # lower into) are the POINT of a placement site — the jaxpr auditor
+    # reports them as INFO, never ERROR
+    return audit_jit(lambda a: a, site=site, out_shardings=sharding,
+                     xla_contract=SiteContract(allow_collectives=True))
 
 
 def _constrain(x, sharding):

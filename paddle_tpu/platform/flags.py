@@ -262,6 +262,22 @@ FLAGS.define("jit_audit", False,
              "Checked at wrap time: set it BEFORE constructing the "
              "engine/trainer being audited.  Off = bare jax.jit, zero "
              "overhead.")
+FLAGS.define("xla_audit_const_bytes", 65536,
+             "const-capture threshold for the jaxpr auditor (python -m "
+             "paddle_tpu.analysis xla): an array larger than this many "
+             "bytes baked into an audited site's executable as a jaxpr "
+             "const (instead of an argument) is an XLA-AUDIT error — "
+             "consts are re-baked on every compile, duplicated per "
+             "specialization, and invisible to donation. Per-site "
+             "override: SiteContract(const_bytes=...).", parser=int)
+FLAGS.define("xla_audit_big_arg_bytes", 1048576,
+             "donation-candidate threshold for the jaxpr auditor: a "
+             "non-donated argument larger than this many bytes whose "
+             "avals all match unclaimed outputs is reported (WARNING) "
+             "as a donation candidate — if the caller overwrites it "
+             "with the result (the repo's step idiom), donating saves "
+             "a full copy. Per-site override: "
+             "SiteContract(big_arg_bytes=...).", parser=int)
 FLAGS.define("save_dir", "./output", "default checkpoint output directory")
 FLAGS.define("log_level", "INFO", "logging level")
 FLAGS.define("prealloc_mem", False, "let XLA preallocate the whole HBM arena")

@@ -6,8 +6,9 @@ Here: a context-manager/decorator timer aggregating into a global table, plus
 hooks into the jax profiler for trace windows (the cudaProfiler analog).
 
 Note on semantics: JAX dispatch is async — a timer around a jitted call
-measures dispatch unless the caller blocks. ``timer(..., block=True)`` calls
-``block_until_ready`` on the result for honest device timings.
+measures dispatch unless the caller blocks. ``timer(..., block=<result
+pytree or zero-arg callable>)`` calls ``block_until_ready`` on it before
+the clock stops, for honest device timings.
 """
 
 from __future__ import annotations
@@ -43,11 +44,33 @@ class StatSet:
         self._lock = threading.Lock()
 
     @contextlib.contextmanager
-    def timer(self, name: str):
+    def timer(self, name: str, block=None):
+        """Time a window into the named entry.  JAX dispatch is async,
+        so a bare timer measures dispatch latency; pass ``block=`` (an
+        array/pytree, or a zero-arg callable returning one — the result
+        usually doesn't exist yet at ``with`` time) to sync on it
+        before the clock stops, recording honest device time::
+
+            with stats.timer("train_step", block=lambda: out[0]):
+                out[0] = step_fn(params, batch)
+        """
         start = time.perf_counter()
+        ok = False
         try:
             yield
+            ok = True
         finally:
+            # sync ONLY when the body completed: on an exception the
+            # result usually doesn't exist, and evaluating block()
+            # would raise from the finally clause and MASK the real
+            # error (the elapsed dispatch time is still recorded)
+            if ok and block is not None:
+                import jax
+
+                # the POINT of block=: ONE deliberate end-of-window
+                # sync so the recorded time covers device execution
+                jax.block_until_ready(   # lint: allow(host-sync)
+                    block() if callable(block) else block)
             elapsed = time.perf_counter() - start
             with self._lock:
                 self._entries.setdefault(name, StatEntry()).add(elapsed)
@@ -114,9 +137,11 @@ class StatSet:
 _GLOBAL = StatSet()
 
 
-def timer(name: str):
-    """``with timer('forwardBackward'): ...`` — aggregates into the global set."""
-    return _GLOBAL.timer(name)
+def timer(name: str, block=None):
+    """``with timer('forwardBackward'): ...`` — aggregates into the
+    global set; ``block=`` as in :meth:`StatSet.timer` (sync on the
+    result for honest device timings)."""
+    return _GLOBAL.timer(name, block=block)
 
 
 def add_sample(name: str, seconds: float) -> None:

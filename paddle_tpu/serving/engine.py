@@ -75,7 +75,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from paddle_tpu.analysis.retrace import audit_jit, auditor
+from paddle_tpu.analysis.retrace import SiteContract, audit_jit, auditor
 from paddle_tpu.obs.registry import MetricsRegistry
 from paddle_tpu.obs.trace import NULL_TRACER, tracer_for
 from paddle_tpu.ops.attention import mha_reference
@@ -252,7 +252,9 @@ class ServingEngine:
                  prefill_chunk: Optional[int] = None,
                  faults: Optional[FaultPlan] = None,
                  time_fn: Optional[Callable[[], float]] = None,
-                 tracer=None, registry: Optional[MetricsRegistry] = None):
+                 tracer=None, registry: Optional[MetricsRegistry] = None,
+                 xla_peak_bytes: Optional[int] = None,
+                 xla_flops: Optional[float] = None):
         self.model = model
         self.params = params
         self.eos_id = int(eos_id)
@@ -367,9 +369,48 @@ class ServingEngine:
         # donate the incoming KV pool: every call overwrites self._kv
         # with the returned pool, so XLA may update pages in place —
         # without this the decode tick copies the whole pool and peak
-        # HBM doubles the documented cost.  CPU doesn't support donation
-        # (it would just warn), hence the gate.
-        self._donate_kv = (1,) if jax.default_backend() != "cpu" else ()
+        # HBM doubles the documented cost.  Declared UNCONDITIONALLY:
+        # audit_jit strips donation before the underlying jax.jit on
+        # CPU (which can't donate and would only warn), so a CPU tier-1
+        # run still declares — and the jaxpr auditor still verifies —
+        # the TPU donation contract.  The old per-backend gate here left
+        # the contract invisible (and untested) on CPU.
+        self._donate_kv = (1,)
+        # compiled-path contracts, declared next to the jit sites they
+        # bind (checked by `python -m paddle_tpu.analysis xla`): the KV
+        # pool must be donated and alias back out, per-tick sites must
+        # not host-sync or pay collectives, narrow KV dtypes may
+        # intentionally dequantize into f32 attention math, and the
+        # per-signature footprint stays under an order-of-magnitude
+        # budget — generous slack constants make the budgets guardrails
+        # against asymptotic surprises (a duplicated pool, an O(B*S^2)
+        # broadcast), not cycle predictions.  Callers with exact models
+        # tighten them via ServingEngine(xla_peak_bytes=, xla_flops=).
+        param_bytes = param_count = 0
+        for leaf in jax.tree.leaves(params):
+            if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+                n = int(np.prod(leaf.shape)) if leaf.shape else 1
+                param_count += n
+                param_bytes += n * jnp.dtype(leaf.dtype).itemsize
+        rows = max_slots + self._prefill_budget
+        e = model.num_heads * model.head_dim
+        kv_bytes = self.kv_cfg.kv_bytes()
+        act_bytes = 4 * rows * (8 * e * model.num_layers
+                                + model.vocab_size)
+        kv_name = jnp.dtype(self.kv_cfg.dtype).name
+        allow_upcast = (kv_name,) if kv_name != "float32" else ()
+        if FLAGS.attn_pv_f32:
+            allow_upcast += ("bfloat16",)
+        self._step_contract = SiteContract(
+            per_tick=True, donate=(1,), allow_upcast=allow_upcast,
+            peak_bytes=xla_peak_bytes if xla_peak_bytes is not None else
+            2 * kv_bytes + 8 * param_bytes + 16 * act_bytes + (1 << 26),
+            flops=xla_flops if xla_flops is not None else
+            64.0 * rows * (param_count
+                           + self.kv_cfg.max_seq_len * e) + 1e9)
+        kv_contract = SiteContract(
+            per_tick=True, donate=(0,),
+            peak_bytes=2 * kv_bytes + (1 << 24))
         # audit_jit == jax.jit unless FLAGS.jit_audit is on, in which
         # case each named site's compiles are counted by the retrace
         # auditor (paddle_tpu.analysis.retrace): the unified step must
@@ -378,13 +419,13 @@ class ServingEngine:
         # ladder is one entry per prefill bucket plus the decode-only 0
         self._step_fns: Dict[int, Callable] = {}
         # COW fork + failure scrub: kv is argument 0 in both (same
-        # donation gate as above)
+        # donation contract as above)
         self._fork_fn = audit_jit(
-            fork_page, site="serving.fork_page",
-            donate_argnums=(0,) if self._donate_kv else ())
+            fork_page, site="serving.fork_page", donate_argnums=(0,),
+            xla_contract=kv_contract)
         self._zero_fn = audit_jit(
-            zero_pages, site="serving.zero_pages",
-            donate_argnums=(0,) if self._donate_kv else ())
+            zero_pages, site="serving.zero_pages", donate_argnums=(0,),
+            xla_contract=kv_contract)
         self._results: Dict[int, List[int]] = {}
         self._requests: Dict[int, Request] = {}
         # terminal rids in retirement order; oldest evicted past
@@ -528,7 +569,8 @@ class ServingEngine:
             return logits[:b], logits[b:], kv
 
         fn = audit_jit(raw, site="serving.step",
-                       donate_argnums=self._donate_kv)
+                       donate_argnums=self._donate_kv,
+                       xla_contract=self._step_contract)
         self._step_fns[pb] = fn
         return fn
 
@@ -848,6 +890,18 @@ class ServingEngine:
         # then hand back the registry's flat snapshot so one healthz
         # probe reads the same numbers a scraper would
         self.metrics.publish(self.registry, **self._reg_labels)
+        # retrace-auditor compile counts ride the same scrape surface
+        # (jit_compiles_total{site=...}): before this they existed only
+        # as jit_compile trace instants, invisible to a scraper.  Gated
+        # on the auditor actually having sites, so audit-off engines
+        # pay nothing and publish nothing.  Published WITHOUT the
+        # per-engine labels: the auditor is process-global (every
+        # replica's compiles land on ONE SiteRecord per site name), so
+        # stamping replica labels on the shared sums would make each
+        # replica appear to own the whole fleet's compiles — in a
+        # shared-registry fleet the publishes are idempotent instead.
+        if auditor().sites:
+            auditor().publish(self.registry)
         return {
             "ok": not leak,
             "metrics": self.registry.snapshot(),

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu import event as v2_event
-from paddle_tpu.analysis.retrace import audit_jit
+from paddle_tpu.analysis.retrace import SiteContract, audit_jit
 from paddle_tpu.obs.registry import default_registry
 from paddle_tpu.data_feeder import DataFeeder
 from paddle_tpu.optimizer import Optimizer
@@ -168,7 +168,31 @@ class SGD:
         # step SPMD automatically — XLA inserts the grad psum (the
         # MultiGradientMachine ring / pserver addGradient analog).
         return audit_jit(step, site="trainer.train_step",
-                         donate_argnums=(0, 1, 2))
+                         donate_argnums=(0, 1, 2),
+                         xla_contract=self._step_contract())
+
+    def _step_contract(self, donate=(0, 1, 2)) -> SiteContract:
+        """Compiled-path contract for the train/test steps, checked by
+        the jaxpr auditor: params/opt-state/model-state must actually
+        ride the requested donation (verified from the REQUESTED jit
+        kwargs, so CPU tier-1 runs still check the TPU contract);
+        collectives are the point of a sharded step (grad psum, ZeRO
+        reduce-scatter/all-gather); bf16 operands deliberately reduce
+        losses/norm statistics in f32 (the repo's precision model, see
+        MIGRATION "The bf16 precision model").  The peak-bytes budget
+        is a guardrail — activations scale with the batch, which the
+        trainer cannot see at build time, so the budget is a generous
+        multiple of the weights plus fixed slack, catching only
+        duplicated-state-sized regressions."""
+        param_bytes = 0
+        for v in self.parameters.as_dict().values():
+            if hasattr(v, "shape") and hasattr(v, "dtype"):
+                n = int(np.prod(v.shape)) if v.shape else 1
+                param_bytes += n * jnp.dtype(v.dtype).itemsize
+        return SiteContract(
+            donate=tuple(donate), allow_collectives=True,
+            allow_upcast=("bfloat16",),
+            peak_bytes=16 * param_bytes + (1 << 28))
 
     def _build_test(self):
         topo = self.topology
@@ -185,7 +209,8 @@ class SGD:
                            zip(metric_names, outs[n_costs:])}
             return total, metric_vals
 
-        return audit_jit(test_step, site="trainer.test_step")
+        return audit_jit(test_step, site="trainer.test_step",
+                         xla_contract=self._step_contract(donate=()))
 
     def _place_on_mesh(self, slots_too: bool = True) -> None:
         """(Re)commit params — and optimizer state mirroring them — to
@@ -759,8 +784,14 @@ class MultiTaskTrainer:
             new_params.update(new_sub)
             return loss, new_params, new_opt, new_mstate
 
+        # only the task's opt-state is donated (params fan into every
+        # task's graph, so the caller keeps them); same collective /
+        # f32-reduction allowances as the SGD step
         return audit_jit(step, site=f"trainer.task.{name}",
-                         donate_argnums=(1,))
+                         donate_argnums=(1,),
+                         xla_contract=SiteContract(
+                             donate=(1,), allow_collectives=True,
+                             allow_upcast=("bfloat16",)))
 
     def step(self, name: str, feeds: Dict[str, Any]) -> float:
         """Run one optimization step of the named task; other tasks'

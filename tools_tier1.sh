@@ -87,4 +87,23 @@ elif [ "$fleet_rc" -ne 0 ]; then
     print_postmortems
     exit 6
 fi
+# jaxpr compiled-path audit (paddle_tpu.analysis.xla): drives a sealed
+# mixed serving steady state (int8 KV, prefix cache on) plus one train
+# step under FLAGS.jit_audit, then rule-checks every captured site's
+# ClosedJaxpr — donation contracts, dtype promotion drift, host
+# callbacks, const-captured weights, collective placement, per-site
+# memory/FLOP budgets.  Exit 8 extends the ladder (3/4/5/6/7); same
+# contract as the lint/fleet gates: branch on the auditor's OWN exit
+# status (findings=1, crash=2), never on a grep of the shared log.
+env JAX_PLATFORMS=cpu python -m paddle_tpu.analysis xla 2>&1 | tee -a /tmp/_t1.log
+xla_rc=${PIPESTATUS[0]}
+if [ "$xla_rc" -eq 1 ]; then
+    echo 'XLA-AUDIT: compiled-path contract violated (see log above)'
+    print_postmortems
+    exit 8
+elif [ "$xla_rc" -ne 0 ]; then
+    echo "XLA-AUDIT: jaxpr auditor itself exited $xla_rc without running to completion"
+    print_postmortems
+    exit 8
+fi
 exit $rc
