@@ -27,6 +27,14 @@ Three cooperating passes (the compile-first contract a TPU stack needs:
   :class:`~paddle_tpu.analysis.retrace.SiteContract` next to the jit
   call.  Runs as ``python -m paddle_tpu.analysis xla`` (tier-1 ladder
   exit 8 on ``XLA-AUDIT`` findings).
+- :mod:`paddle_tpu.analysis.sharding` — static GSPMD
+  sharding-propagation auditor: infers placements through each
+  captured site's jaxpr from the ``PartitionSpec`` contract declared
+  next to the jit (``SiteContract(in_specs=/out_specs=/mesh_axes=``)
+  and reports contract mismatches, implicit all-gathers, accidental
+  replication, axis collisions and collective-byte budget violations
+  as ``SHARD-AUDIT`` findings.  Runs as ``python -m paddle_tpu.analysis
+  sharding`` (tier-1 ladder exit 9).
 
 This ``__init__`` stays import-light on purpose: the serving engine and
 trainer import :func:`audit_jit` from here on their hot construction

@@ -21,6 +21,16 @@
     budgets).  Exit 0 = clean, 1 = XLA-AUDIT findings, 2 = the auditor
     itself crashed — ``tools_tier1.sh`` branches on the exit status and
     turns 1/2 into ladder exit 8.
+
+``sharding [--rule NAME ...] [--strict]``
+    Static GSPMD sharding-propagation audit: the same sealed serving +
+    trainer steady states as the xla gate plus the ZeRO placement jits
+    on a virtual-8 mesh (``FLAGS.shard_audit_virtual_devices`` forced
+    before backend init), checked against each site's declared
+    ``PartitionSpec`` contract — contract-mismatch, implicit
+    all-gathers, accidental replication, axis collisions, and the
+    collective-bytes budget (``SHARD-AUDIT`` findings).  Exit 0 =
+    clean, 1 = findings, 2 = crash — ladder exit 9.
 """
 
 from __future__ import annotations
@@ -121,6 +131,44 @@ def cmd_xla(args) -> int:
     return 0
 
 
+def cmd_sharding(args) -> int:
+    # virtual devices FIRST: the ZeRO placement drive needs a real
+    # multi-device data axis, and XLA_FLAGS only counts before the
+    # first backend initialization
+    from paddle_tpu.analysis.sharding import (RULE_NAMES,
+                                              ensure_virtual_devices)
+
+    unknown = [r for r in (args.rule or []) if r not in RULE_NAMES]
+    if unknown:
+        print(f"unknown rule(s) {unknown}; known: {sorted(RULE_NAMES)}",
+              file=sys.stderr)
+        return 2
+    from paddle_tpu.platform.flags import FLAGS
+
+    ensure_virtual_devices(int(FLAGS.shard_audit_virtual_devices))
+    from paddle_tpu.analysis.diagnostics import Severity
+    from paddle_tpu.analysis.sharding import run_sharding_audit
+
+    try:
+        reports, diags = run_sharding_audit(rules=args.rule or None)
+    except Exception as e:      # crash != findings: distinct exit code
+        print(f"sharding audit crashed: {e!r}")
+        return 2
+    errs = [d for d in diags if d.severity is Severity.ERROR]
+    if errs or (args.strict and diags):
+        strict_note = ""
+        if args.strict and len(diags) > len(errs):
+            strict_note = (f" + {len(diags) - len(errs)} non-ERROR "
+                           "finding(s) failing under --strict")
+        print(f"SHARD-AUDIT: {len(errs)} ERROR finding(s){strict_note} "
+              f"across {len(reports)} audited site(s) — fix the plan, "
+              "or declare the intent in the site's SiteContract")
+        return 1
+    print(f"sharding audit ok: {len(reports)} site(s), 0 ERROR findings "
+          f"({len(diags)} informational)")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
@@ -160,6 +208,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--strict", action="store_true",
                    help="exit 1 on ANY diagnostic, not just ERRORs")
     p.set_defaults(fn=cmd_xla)
+
+    p = sub.add_parser(
+        "sharding", help="static GSPMD sharding-propagation audit over "
+                         "every audit_jit site's declared PartitionSpec "
+                         "contract, with collective-cost budgets")
+    p.add_argument("--rule", action="append", default=[],
+                   help="restrict the audit to the named rule(s); "
+                        "repeatable (RETRACE diagnostics from the "
+                        "sealed replay are always included)")
+    p.add_argument("--strict", action="store_true",
+                   help="exit 1 on ANY diagnostic, not just ERRORs")
+    p.set_defaults(fn=cmd_sharding)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -50,7 +50,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 from paddle_tpu.analysis.diagnostics import Diagnostic, Severity
 
 __all__ = ["audit_jit", "auditor", "CapturedCall", "RetraceAuditor",
-           "RetraceError", "SiteContract", "abstract_signature"]
+           "RetraceError", "SiteContract", "abstract_signature",
+           "declare_site"]
 
 
 @dataclass(frozen=True)
@@ -78,6 +79,26 @@ class SiteContract:
     - ``big_arg_bytes`` / ``const_bytes``: per-site overrides for the
       donation-candidate and const-capture thresholds (None = the
       ``FLAGS.xla_audit_*`` process defaults).
+
+    Sharding contract (checked by :mod:`paddle_tpu.analysis.sharding`):
+
+    - ``in_specs`` / ``out_specs``: declared ``PartitionSpec``-style
+      placements, one tuple entry per positional argument / flattened
+      output — each entry None (undeclared), ``()`` (replicated) or a
+      tuple of per-dim mesh-axis names aligned to the LEADING dims
+      (``("data",)`` = dim 0 sharded over ``data``).  A length-1 tuple
+      broadcasts to every argument/output.  A spec applies to an array
+      leaf only when the leaf has enough dims and every sharded dim
+      divides by the axis size; other leaves are treated replicated.
+    - ``mesh_axes``: ``((axis_name, size), ...)`` — the mesh the specs
+      refer to, so the static walk can cost collectives without a live
+      mesh object.
+    - ``comm_bytes``: per-signature budget for the estimated collective
+      bytes moved over the interconnect (the 2112.09017 cost model);
+      None = unbudgeted (the estimate is reported INFO).
+    - ``expect_sharded``: argnums that MUST carry at least one mesh
+      axis in their effective input spec — a weight the plan shards
+      arriving replicated is the accidental-replication failure.
     """
 
     donate: Tuple[int, ...] = ()
@@ -88,6 +109,11 @@ class SiteContract:
     flops: Optional[float] = None
     big_arg_bytes: Optional[int] = None
     const_bytes: Optional[int] = None
+    in_specs: Optional[Tuple] = None
+    out_specs: Optional[Tuple] = None
+    mesh_axes: Tuple[Tuple[str, int], ...] = ()
+    comm_bytes: Optional[float] = None
+    expect_sharded: Tuple[int, ...] = ()
 
 
 class RetraceError(AssertionError):
@@ -157,6 +183,10 @@ class SiteRecord:
     jit_kwargs: Dict[str, object] = field(default_factory=dict)
     contract: Optional[SiteContract] = None
     captured: Dict[Tuple, CapturedCall] = field(default_factory=dict)
+    # stamped by the sharding auditor (max estimated collective bytes
+    # per call across audited signatures); published as
+    # ``comm_bytes_total{site=...}`` next to the compile counters
+    comm_bytes: Optional[float] = None
 
 
 class RetraceAuditor:
@@ -292,6 +322,7 @@ class RetraceAuditor:
                 rec.fn = None
                 rec.jit_kwargs = {}
                 rec.contract = None
+                rec.comm_bytes = None
             self.diagnostics.clear()
 
     def publish(self, registry, **labels) -> None:
@@ -304,16 +335,26 @@ class RetraceAuditor:
         auditor has sites, so the engine's scrape surface carries the
         compile ladder next to the serving counters."""
         with self._lock:
-            counts = [(name, rec.calls, rec.compiles)
+            counts = [(name, rec.calls, rec.compiles, rec.comm_bytes)
                       for name, rec in self.sites.items()]
         compiles = registry.gauge(
             "jit_compiles_total",
             "cumulative XLA compiles per audited jit site")
         calls = registry.gauge(
             "jit_calls_total", "cumulative calls per audited jit site")
-        for name, n_calls, n_compiles in counts:
+        comm = None
+        for name, n_calls, n_compiles, n_comm in counts:
             compiles.labels(site=name, **labels).set(n_compiles)
             calls.labels(site=name, **labels).set(n_calls)
+            if n_comm is not None:
+                # sharding-audit estimate: collective bytes per call at
+                # this site (lazy gauge: only exists once an audit ran)
+                if comm is None:
+                    comm = registry.gauge(
+                        "comm_bytes_total",
+                        "estimated collective bytes per call at each "
+                        "audited jit site (paddle_tpu.analysis sharding)")
+                comm.labels(site=name, **labels).set(n_comm)
 
     def snapshot(self) -> Dict[str, Dict[str, int]]:
         """{site: {calls, compiles, distinct_signatures}} — one dict an
@@ -332,6 +373,18 @@ _AUDITOR = RetraceAuditor()
 def auditor() -> RetraceAuditor:
     """The process-global auditor all ``audit_jit`` sites report to."""
     return _AUDITOR
+
+
+def declare_site(name: str, contract: SiteContract) -> SiteRecord:
+    """Register a contract-bearing site WITHOUT wrapping a jit — for
+    sites whose compiled path does not exist yet (the pipeline/MoE
+    stubs).  A declared site that captures nothing makes the sharding
+    auditor print its loud 'contract NOT audited' notice instead of
+    silently skipping the site, so the build-out starts checkable.
+    Re-declaring an existing site only updates its contract."""
+    rec = _AUDITOR.site(name)
+    rec.contract = contract
+    return rec
 
 
 def _backend_jit_kwargs(jit_kwargs: Dict) -> Dict:

@@ -1,0 +1,25 @@
+"""jax version shims shared by the parallel modules."""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map                      # jax >= 0.8
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+__all__ = ["no_rep_check_kw", "shard_map"]
+
+
+def no_rep_check_kw() -> dict:
+    """The kwarg that disables shard_map's replication-type checking,
+    under whichever name this jax spells it (``check_vma`` on new
+    releases, ``check_rep`` before) — passing the wrong one is a
+    TypeError that used to fail the whole EP/sparse/local-SGD paths on
+    older jax."""
+    import inspect
+
+    params = inspect.signature(shard_map).parameters
+    for name in ("check_vma", "check_rep"):
+        if name in params:
+            return {name: False}
+    return {}

@@ -34,6 +34,9 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
 
+from paddle_tpu.parallel.compat import no_rep_check_kw
+
+
 def _tree_norm(tree) -> jax.Array:
     leaves = jax.tree.leaves(tree)
     return jnp.sqrt(sum(jnp.sum(jnp.square(l)) for l in leaves))
@@ -112,5 +115,5 @@ class LocalSGD:
         fn = shard_map(local, mesh=self.mesh,
                        in_specs=(P(axis), P(), P(axis)),
                        out_specs=(P(axis), P()),
-                       check_vma=False)
+                       **no_rep_check_kw())
         return jax.jit(fn)

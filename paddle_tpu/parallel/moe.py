@@ -18,6 +18,7 @@ through as zeros — callers add the residual), the Switch convention.
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple, Optional
 
 import jax
@@ -26,10 +27,25 @@ from jax.sharding import PartitionSpec as P
 
 from paddle_tpu.platform.enforce import enforce_that
 
-try:
-    from jax import shard_map                      # jax >= 0.8
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from paddle_tpu.parallel.compat import no_rep_check_kw, shard_map
+
+# the audited compiled-path site every expert-parallel dispatch runs
+# through (see parallel/pipeline.py for the stub-contract rationale)
+MOE_SITE = "parallel.moe"
+
+
+def stub_contract(axis: str = "expert"):
+    """Declared sharding contract for the EP dispatch: tokens shard
+    their leading dim over ``axis``, the router replicates, expert
+    weights shard their leading E dim, outputs come back token-sharded
+    with a replicated aux loss; the two all_to_alls and the stats
+    pmean are the point."""
+    from paddle_tpu.analysis.retrace import SiteContract
+
+    return SiteContract(
+        allow_collectives=True,
+        in_specs=((axis,), (), (axis,), (axis,), (axis,), (axis,)),
+        out_specs=((axis,), ()))
 
 
 class MoEParams(NamedTuple):
@@ -143,9 +159,21 @@ def moe_ffn(mesh, x: jax.Array, params: MoEParams, axis: str = "expert",
 
     t_loc = t // n
     cap = max(1, math.ceil(t_loc / e * capacity_factor))
+    fn = _moe_jit(mesh, axis, e, cap, act)
+    return fn(x, params.router, params.w1, params.b1, params.w2,
+              params.b2)
+
+
+@functools.lru_cache(maxsize=64)
+def _moe_jit(mesh, axis: str, e: int, cap: int, act):
+    """One audited jit per (mesh, axis, experts, capacity, activation)
+    — the zero.py identity idiom; bounded + stable-callable caveats as
+    ``_pipeline_jit`` (``act`` keys by identity)."""
+    n = mesh.shape[axis]
 
     def local(xl, router_w, w1, b1, w2, b2):
         # xl [T_loc, D]; w1 [E_loc, D, H] (this shard's experts)
+        d = xl.shape[1]
         expert, gate, probs = _route(xl, router_w)
         disp = _dispatch_mask(expert, e, cap)              # [T_loc, E, C]
         buf = jnp.einsum("tec,td->ecd", disp,
@@ -177,5 +205,8 @@ def moe_ffn(mesh, x: jax.Array, params: MoEParams, axis: str = "expert",
         in_specs=(P(axis, None), P(None, None), P(axis, None, None),
                   P(axis, None), P(axis, None, None), P(axis, None)),
         out_specs=(P(axis, None), P()),
-        check_vma=False)
-    return fn(x, params.router, params.w1, params.b1, params.w2, params.b2)
+        **no_rep_check_kw())
+
+    from paddle_tpu.analysis.retrace import audit_jit
+
+    return audit_jit(fn, site=MOE_SITE, xla_contract=stub_contract(axis))

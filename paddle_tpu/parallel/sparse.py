@@ -34,6 +34,8 @@ try:
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
+from paddle_tpu.parallel.compat import no_rep_check_kw
+
 
 # ---------------------------------------------------------------------------
 # SelectedRows — the sparse gradient representation (selected_rows.h analog)
@@ -154,7 +156,7 @@ def sharded_lookup(mesh, table: jax.Array, ids: jax.Array,
     fn = shard_map(local, mesh=mesh,
                    in_specs=(P(axis, None), id_spec),
                    out_specs=id_spec,
-                   check_vma=False)
+                   **no_rep_check_kw())
     return fn(table, ids)
 
 
@@ -178,7 +180,7 @@ def sharded_row_update(mesh, table: jax.Array, grad: SelectedRows,
     fn = shard_map(local, mesh=mesh,
                    in_specs=(P(axis, None), P(), P()),
                    out_specs=P(axis, None),
-                   check_vma=False)
+                   **no_rep_check_kw())
     return fn(table, grad.ids, grad.rows)
 
 
@@ -211,7 +213,7 @@ def alltoall_lookup(mesh, table: jax.Array, ids: jax.Array,
     fn = shard_map(local, mesh=mesh,
                    in_specs=(P(axis, None), P(axis)),
                    out_specs=P(axis),
-                   check_vma=False)
+                   **no_rep_check_kw())
     return fn(table, ids)
 
 

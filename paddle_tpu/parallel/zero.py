@@ -119,23 +119,38 @@ class ZeroPlan:
     # -- placement-side (init / checkpoint resume) -------------------------
 
     def _host_full(self, v) -> np.ndarray:
-        """Full host copy of ``v``; multi-process safe: an array spanning
-        non-addressable devices is first replicated with a compiled
-        all-gather (np.asarray alone would raise)."""
+        """Full host copy of ``v``.  A SHARDED device array (the
+        gather-on-save path walking flat 1/N slot shards) goes through
+        the compiled ``zero.replicate`` identity — one XLA all-gather
+        then a single host read, instead of np.asarray's per-shard
+        host copies — which also covers the multi-process case where
+        np.asarray on non-addressable devices would raise.  Replicated
+        or single-device arrays read straight through."""
         import jax
 
-        if isinstance(v, jax.Array) and not v.is_fully_addressable:
-            v = _identity_jit(self.replicated_sharding(),
-                              "zero.replicate")(v)
+        sh = self.replicated_sharding()
+        if isinstance(v, jax.Array) and \
+                (not v.is_fully_addressable
+                 or (not v.is_fully_replicated and _mesh_spanning(v, sh))):
+            v = _identity_jit(sh, "zero.replicate",
+                              in_spec=(self.axis,))(v)
             return np.asarray(v.addressable_data(0))
         return np.asarray(v)
 
     def place_flat(self, name: str, v):
         """Place a host/device array (full-shape OR already-flat) into the
         flat sharded layout on the mesh."""
+        import jax
+
         e = self.entries[name]
         if not e.sharded:
             return v
+        if isinstance(v, jax.Array) and tuple(v.shape) == (e.padded,):
+            # already-flat device state being RE-placed (a resume, or
+            # _place_on_mesh over live slots): one compiled reshard
+            # identity instead of gathering to host and scattering back
+            # per tensor — the re-place the sharding auditor flagged
+            return _constrain(v, self.flat_sharding())
         host = self._host_full(v)
         if host.shape != (e.padded,):
             enforce_that(host.size == e.size,
@@ -252,30 +267,54 @@ def opt_state_bytes_per_device(tree) -> int:
 
 
 @functools.lru_cache(maxsize=None)
-def _identity_jit(sharding, site: str):
-    """One compiled identity per (sharding, site) — per-call wrappers
-    would re-trace an identical signature every call (a real retrace the
-    audit sites would rightly flag)."""
+def _identity_jit(sharding, site: str, in_spec=None):
+    """One compiled identity per (sharding, site, declared-input-spec) —
+    per-call wrappers would re-trace an identical signature every call
+    (a real retrace the audit sites would rightly flag)."""
     from paddle_tpu.analysis.retrace import SiteContract, audit_jit
 
     # collectives (the resharding all-gather/scatter the out_shardings
     # lower into) are the POINT of a placement site — the jaxpr auditor
-    # reports them as INFO, never ERROR
+    # reports them as INFO and the sharding auditor costs them against
+    # the declared specs: out = the target sharding's spec; in = the
+    # caller-declared source placement (None = unknown, costed 0)
+    spec = getattr(sharding, "spec", ())
     return audit_jit(lambda a: a, site=site, out_shardings=sharding,
-                     xla_contract=SiteContract(allow_collectives=True))
+                     xla_contract=SiteContract(
+                         allow_collectives=True,
+                         in_specs=(in_spec,) if in_spec is not None
+                         else None,
+                         out_specs=(tuple(spec),),
+                         mesh_axes=tuple(
+                             (str(a), int(n)) for a, n in
+                             dict(sharding.mesh.shape).items())
+                         if getattr(sharding, "mesh", None) is not None
+                         else ()))
+
+
+def _mesh_spanning(v, sharding) -> bool:
+    """True when the compiled identity may consume ``v`` directly: the
+    array is either not fully addressable (multi-process — put_global
+    could not even read it) or already lives on exactly the target
+    mesh's devices.  A committed array on SOME OTHER device set (a
+    single-device checkpoint staging buffer, a sub-mesh) would make the
+    jit raise 'incompatible devices', so it takes the host path."""
+    if not v.is_fully_addressable:
+        return True
+    return set(v.sharding.device_set) == set(sharding.mesh.devices.flat)
 
 
 def _constrain(x, sharding):
     """Sharding constraint that works both under trace (the in-step
-    reduce-scatter / all-gather) and eagerly (placement — multi-process
-    safe)."""
+    reduce-scatter / all-gather) and eagerly (placement — the compiled
+    reshard identity keeps mesh-resident device arrays on device and is
+    multi-process safe; host values and off-mesh arrays go through
+    put_global)."""
     import jax
 
     if isinstance(x, jax.core.Tracer):
         return jax.lax.with_sharding_constraint(x, sharding)
-    if isinstance(x, jax.Array) and not x.is_fully_addressable:
-        # already-committed global array (multi-host init): reshard with a
-        # compiled identity — put_global's host round trip can't read it
+    if isinstance(x, jax.Array) and _mesh_spanning(x, sharding):
         return _identity_jit(sharding, "zero.reshard")(x)
     return _put_global(x, sharding)
 

@@ -278,6 +278,15 @@ FLAGS.define("xla_audit_big_arg_bytes", 1048576,
              "with the result (the repo's step idiom), donating saves "
              "a full copy. Per-site override: "
              "SiteContract(big_arg_bytes=...).", parser=int)
+FLAGS.define("shard_audit_virtual_devices", 8,
+             "virtual CPU device count the sharding-audit CLI (python "
+             "-m paddle_tpu.analysis sharding) forces before backend "
+             "init, so its ZeRO placement drive runs on a real "
+             "multi-device 'data' axis without TPU hardware (the "
+             "tests/conftest.py trick). Only effective when the jax "
+             "backend has not initialized yet; <=1 disables the "
+             "forcing and the placement drive degrades to a loud "
+             "'not audited' notice.", parser=int)
 FLAGS.define("save_dir", "./output", "default checkpoint output directory")
 FLAGS.define("log_level", "INFO", "logging level")
 FLAGS.define("prealloc_mem", False, "let XLA preallocate the whole HBM arena")

@@ -254,7 +254,8 @@ class ServingEngine:
                  time_fn: Optional[Callable[[], float]] = None,
                  tracer=None, registry: Optional[MetricsRegistry] = None,
                  xla_peak_bytes: Optional[int] = None,
-                 xla_flops: Optional[float] = None):
+                 xla_flops: Optional[float] = None,
+                 xla_comm_bytes: Optional[float] = None):
         self.model = model
         self.params = params
         self.eos_id = int(eos_id)
@@ -401,16 +402,30 @@ class ServingEngine:
         allow_upcast = (kv_name,) if kv_name != "float32" else ()
         if FLAGS.attn_pv_f32:
             allow_upcast += ("bfloat16",)
+        # sharding baseline (checked by `python -m paddle_tpu.analysis
+        # sharding`): the engine is single-mesh/single-replica TODAY, so
+        # the contract pins every argument and output REPLICATED with a
+        # zero collective-byte budget per tick — derived from pool+model
+        # the same way xla_peak_bytes is (a replicated plan moves 0
+        # bytes over links; any inferred collective busts the budget).
+        # This is the explicit baseline the tensor-parallel serving PR
+        # flips to a `model`-axis spec + a derived all-gather/psum
+        # budget; callers experimenting early override via
+        # ServingEngine(xla_comm_bytes=).
+        comm_budget = xla_comm_bytes if xla_comm_bytes is not None \
+            else 0.0
         self._step_contract = SiteContract(
             per_tick=True, donate=(1,), allow_upcast=allow_upcast,
             peak_bytes=xla_peak_bytes if xla_peak_bytes is not None else
             2 * kv_bytes + 8 * param_bytes + 16 * act_bytes + (1 << 26),
             flops=xla_flops if xla_flops is not None else
             64.0 * rows * (param_count
-                           + self.kv_cfg.max_seq_len * e) + 1e9)
+                           + self.kv_cfg.max_seq_len * e) + 1e9,
+            in_specs=((),), out_specs=((),), comm_bytes=comm_budget)
         kv_contract = SiteContract(
             per_tick=True, donate=(0,),
-            peak_bytes=2 * kv_bytes + (1 << 24))
+            peak_bytes=2 * kv_bytes + (1 << 24),
+            in_specs=((),), out_specs=((),), comm_bytes=comm_budget)
         # audit_jit == jax.jit unless FLAGS.jit_audit is on, in which
         # case each named site's compiles are counted by the retrace
         # auditor (paddle_tpu.analysis.retrace): the unified step must

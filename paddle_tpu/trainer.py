@@ -171,7 +171,8 @@ class SGD:
                          donate_argnums=(0, 1, 2),
                          xla_contract=self._step_contract())
 
-    def _step_contract(self, donate=(0, 1, 2)) -> SiteContract:
+    def _step_contract(self, donate=(0, 1, 2),
+                       test: bool = False) -> SiteContract:
         """Compiled-path contract for the train/test steps, checked by
         the jaxpr auditor: params/opt-state/model-state must actually
         ride the requested donation (verified from the REQUESTED jit
@@ -183,16 +184,47 @@ class SGD:
         is a guardrail — activations scale with the batch, which the
         trainer cannot see at build time, so the budget is a generous
         multiple of the weights plus fixed slack, catching only
-        duplicated-state-sized regressions."""
+        duplicated-state-sized regressions.
+
+        Sharding contract (the `analysis sharding` gate): on a mesh,
+        feeds shard their batch dim over ``data`` (matching
+        ``_shard_feeds``), params/model-state/rng replicate, and under
+        ZeRO the flat optimizer slots arrive 1/N-sharded —
+        ``expect_sharded`` pins that the plan actually reached them.
+        The comm budget covers the worst of the two layouts: a full
+        replicated-DP gradient psum (2x param bytes over the ring) or
+        ZeRO's reduce-scatter + all-gather pair, with fixed slack for
+        the loss/metric scalar reductions."""
         param_bytes = 0
         for v in self.parameters.as_dict().values():
             if hasattr(v, "shape") and hasattr(v, "dtype"):
                 n = int(np.prod(v.shape)) if v.shape else 1
                 param_bytes += n * jnp.dtype(v.dtype).itemsize
+        mesh = self.mesh
+        mesh_axes: tuple = ()
+        in_specs = None
+        expect: tuple = ()
+        if mesh is not None:
+            mesh_axes = tuple(
+                (str(a), int(s))
+                for a, s in zip(mesh.axis_names, mesh.devices.shape))
+            feed = ("data",) if "data" in mesh.axis_names else ()
+            plan = getattr(self, "_zero_plan", None)
+            opt = (plan.axis,) if plan is not None else ()
+            if test:
+                in_specs = ((), (), feed)        # params, mstate, feeds
+            else:
+                # params, opt_state, model_state, rng, feeds
+                in_specs = ((), opt, (), (), feed)
+                if plan is not None:
+                    expect = (1,)
         return SiteContract(
             donate=tuple(donate), allow_collectives=True,
             allow_upcast=("bfloat16",),
-            peak_bytes=16 * param_bytes + (1 << 28))
+            peak_bytes=16 * param_bytes + (1 << 28),
+            in_specs=in_specs, mesh_axes=mesh_axes,
+            expect_sharded=expect,
+            comm_bytes=6.0 * param_bytes + (1 << 20))
 
     def _build_test(self):
         topo = self.topology
@@ -210,7 +242,8 @@ class SGD:
             return total, metric_vals
 
         return audit_jit(test_step, site="trainer.test_step",
-                         xla_contract=self._step_contract(donate=()))
+                         xla_contract=self._step_contract(donate=(),
+                                                          test=True))
 
     def _place_on_mesh(self, slots_too: bool = True) -> None:
         """(Re)commit params — and optimizer state mirroring them — to

@@ -1,0 +1,539 @@
+"""Static GSPMD sharding-propagation auditor (paddle_tpu.analysis.
+sharding): one seeded-bad jaxpr per rule class — declared/inferred spec
+mismatch, one-side-sharded contraction, accidental replication of
+weight-shaped consts/args, mesh-axis double consumption, busted
+collective budget — plus exact closed-form pins for the 2112.09017
+cost model (reduce-scatter/all-gather pair, psum-at-output, the ZeRO
+placement all-gather on virtual-8), clean-run pins over the real zero
+placement / mesh+ZeRO train step / sealed serving.step (f32 AND int8
+pools), the pipeline/MoE stub-contract notices, and the
+``comm_bytes_total`` registry publish.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.analysis import sharding as S
+from paddle_tpu.analysis.diagnostics import Severity
+from paddle_tpu.analysis.retrace import (SiteContract, audit_jit, auditor,
+                                         declare_site)
+from paddle_tpu.platform.flags import FLAGS
+
+pytestmark = [pytest.mark.shard, pytest.mark.analysis]
+
+AX8 = (("data", 8),)
+
+
+@pytest.fixture
+def audit():
+    old = FLAGS.jit_audit
+    FLAGS.jit_audit = True
+    auditor().reset()
+    yield auditor()
+    FLAGS.jit_audit = old
+    auditor().reset()
+
+
+@pytest.fixture
+def mesh8():
+    return Mesh(np.asarray(jax.devices()[:8]).reshape(8), ("data",))
+
+
+def _report(site, rules=None):
+    reps = S.audit_sharding_sites(sites=[site], rules=rules)
+    assert site in reps, f"site {site} captured nothing"
+    return reps[site]
+
+
+def _errors(rep):
+    return [d for d in rep.diagnostics if d.severity is Severity.ERROR]
+
+
+# ---------------------------------------------------------------------------
+# spec plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_normalize_spec_accepts_partition_spec_and_tuples():
+    assert S.normalize_spec(P("data", None)) == ("data", None)
+    assert S.normalize_spec(("data",)) == ("data",)
+    assert S.normalize_spec(()) == ()
+    assert S.normalize_spec(None) is None
+    assert S.normalize_spec((("x", "y"), None)) == ("x", None)
+
+
+def test_apply_spec_divisibility_and_ndim_fall_back_to_replicated():
+    axes = dict(AX8)
+    vs, probs = S.apply_spec(("data",), (16, 4), axes)
+    assert vs.dims == ("data", None) and probs == []
+    # non-divisible leading dim: replicated, no error (the broadcast-
+    # over-leaves semantics — optimizer scalars must not explode)
+    vs, probs = S.apply_spec(("data",), (15, 4), axes)
+    assert vs.dims == (None, None) and probs == []
+    vs, probs = S.apply_spec(("data",), (), axes)
+    assert vs.dims == () and probs == []
+    # unknown axis IS a contract error
+    _, probs = S.apply_spec(("model",), (16,), axes)
+    assert probs and probs[0][0] == "contract-mismatch"
+    # one axis for two dims IS a collision
+    _, probs = S.apply_spec(("data", "data"), (16, 16), axes)
+    assert probs and probs[0][0] == "axis-collision"
+
+
+# ---------------------------------------------------------------------------
+# seeded-bad jaxprs, one per rule class
+# ---------------------------------------------------------------------------
+
+
+def test_contract_mismatch_flagged(audit):
+    f = audit_jit(lambda x: x * 2, site="t.mismatch",
+                  xla_contract=SiteContract(in_specs=(("data",),),
+                                            out_specs=((),),
+                                            mesh_axes=AX8))
+    f(jnp.ones((16, 4)))
+    errs = _errors(_report("t.mismatch"))
+    assert len(errs) == 1
+    msg = errs[0].message
+    assert "contract-mismatch" in msg and "t.mismatch" in msg
+    assert "SHARD-AUDIT" in str(errs[0])
+
+
+def test_contract_mismatch_on_unknown_mesh_axis(audit):
+    f = audit_jit(lambda x: x + 1, site="t.badaxis",
+                  xla_contract=SiteContract(in_specs=(("model",),),
+                                            mesh_axes=AX8))
+    f(jnp.ones((16,)))
+    errs = _errors(_report("t.badaxis"))
+    assert len(errs) == 1 and "mesh_axes" in errs[0].message
+
+
+def test_implicit_all_gather_on_one_side_sharded_contraction(audit):
+    f = audit_jit(lambda x, w: x @ w, site="t.gather",
+                  xla_contract=SiteContract(
+                      in_specs=((None, "data"), ()), mesh_axes=AX8))
+    f(jnp.ones((4, 16)), jnp.ones((16, 4)))
+    rep = _report("t.gather")
+    errs = _errors(rep)
+    assert len(errs) == 1
+    msg = errs[0].message
+    assert "implicit-all-gather" in msg and "eqn" in msg
+    assert "dot_general" in msg and "t.gather" in msg
+    # the materialized bytes ride the message AND the comm estimate:
+    # 4*16*4 = 256 bytes, all-gather cost 256 * 7/8 = 224
+    assert "224" in msg
+    assert rep.comm_bytes == 224.0
+
+
+def test_implicit_all_gather_on_conflicting_elementwise(audit):
+    f = audit_jit(lambda a, b: a + b, site="t.conflict",
+                  xla_contract=SiteContract(
+                      in_specs=(("data", None), (None, "data")),
+                      mesh_axes=AX8))
+    f(jnp.ones((8, 8)), jnp.ones((8, 8)))
+    errs = _errors(_report("t.conflict"))
+    assert len(errs) == 1 and "implicit-all-gather" in errs[0].message
+
+
+def test_implicit_all_gather_on_sharded_reshape_split(audit):
+    f = audit_jit(lambda x: x.reshape(4, 4, 8), site="t.reshape",
+                  xla_contract=SiteContract(in_specs=(("data",),),
+                                            mesh_axes=AX8))
+    f(jnp.ones((16, 8)))
+    errs = _errors(_report("t.reshape"))
+    assert len(errs) == 1
+    assert "implicit-all-gather" in errs[0].message
+    assert "reshape" in errs[0].message
+
+
+def test_accidental_replication_expect_sharded(audit):
+    f = audit_jit(lambda x: x + 1, site="t.repl",
+                  xla_contract=SiteContract(in_specs=((),),
+                                            expect_sharded=(0,),
+                                            mesh_axes=AX8))
+    f(jnp.ones((16,)))
+    errs = _errors(_report("t.repl"))
+    assert len(errs) == 1
+    assert "accidental-replication" in errs[0].message
+
+
+def test_accidental_replication_weight_shaped_const(audit):
+    weights = jnp.ones((512, 512))                 # 1 MiB const
+    f = audit_jit(lambda x: x @ weights, site="t.const",
+                  xla_contract=SiteContract(
+                      in_specs=(("data", None),), mesh_axes=AX8,
+                      big_arg_bytes=65536))
+    f(jnp.ones((16, 512)))
+    errs = _errors(_report("t.const"))
+    assert any("accidental-replication" in d.message
+               and "const" in d.message for d in errs)
+    # the same const in a site that shards NOTHING is not a finding
+    # (the xla const-capture rule owns the plain capture case)
+    g = audit_jit(lambda x: x @ weights, site="t.const_ok",
+                  xla_contract=SiteContract(in_specs=((),),
+                                            big_arg_bytes=65536))
+    g(jnp.ones((16, 512)))
+    assert not any("accidental-replication" in d.message
+                   for d in _report("t.const_ok").diagnostics)
+
+
+def test_axis_collision_in_contraction(audit):
+    f = audit_jit(lambda x, y: x @ y, site="t.collide",
+                  xla_contract=SiteContract(
+                      in_specs=(("data", None), (None, "data")),
+                      mesh_axes=AX8))
+    f(jnp.ones((8, 4)), jnp.ones((4, 8)))
+    errs = _errors(_report("t.collide"))
+    assert len(errs) == 1
+    msg = errs[0].message
+    assert "axis-collision" in msg and "eqn" in msg and "data" in msg
+
+
+def test_axis_collision_in_declared_spec(audit):
+    f = audit_jit(lambda x: x + 1, site="t.dupspec",
+                  xla_contract=SiteContract(in_specs=(("data", "data"),),
+                                            mesh_axes=AX8))
+    f(jnp.ones((16, 16)))
+    errs = _errors(_report("t.dupspec"))
+    assert len(errs) == 1 and "axis-collision" in errs[0].message
+
+
+def test_comm_budget_busted_and_within(audit, mesh8):
+    flat = NamedSharding(mesh8, P("data"))
+
+    def fn(x):
+        return jax.lax.with_sharding_constraint(x, flat)
+
+    # replicated -> sharded is a free slice; sharded -> replicated on
+    # the way OUT via out_shardings costs the all-gather
+    f = audit_jit(fn, site="t.commbust",
+                  out_shardings=NamedSharding(mesh8, P()),
+                  xla_contract=SiteContract(
+                      allow_collectives=True, in_specs=((),),
+                      mesh_axes=AX8, comm_bytes=10.0))
+    f(jnp.ones((64,)))
+    errs = _errors(_report("t.commbust"))
+    assert len(errs) == 1
+    assert "comm-budget" in errs[0].message
+    assert "exceed" in errs[0].message
+
+    g = audit_jit(fn, site="t.commok",
+                  out_shardings=NamedSharding(mesh8, P()),
+                  xla_contract=SiteContract(
+                      allow_collectives=True, in_specs=((),),
+                      mesh_axes=AX8, comm_bytes=1000.0))
+    g(jnp.ones((64,)))
+    rep = _report("t.commok")
+    assert _errors(rep) == []
+    assert any("within the declared" in d.message
+               for d in rep.diagnostics)
+
+
+def test_rule_restriction_filters_findings(audit):
+    f = audit_jit(lambda x: x * 2, site="t.filter",
+                  xla_contract=SiteContract(in_specs=(("data",),),
+                                            out_specs=((),),
+                                            mesh_axes=AX8))
+    f(jnp.ones((16,)))
+    rep = _report("t.filter", rules=["axis-collision"])
+    assert rep.diagnostics == []           # mismatch filtered out
+    rep = _report("t.filter", rules=["contract-mismatch"])
+    assert len(_errors(rep)) == 1
+
+
+# ---------------------------------------------------------------------------
+# collective cost model: exact closed-form pins (virtual-8)
+# ---------------------------------------------------------------------------
+
+
+def test_cost_model_closed_forms():
+    assert S.all_gather_bytes(256, 8) == 224.0       # b*(n-1)/n
+    assert S.reduce_scatter_bytes(256, 8) == 224.0
+    assert S.all_reduce_bytes(256, 8) == 448.0       # 2*b*(n-1)/n
+    assert S.all_to_all_bytes(256, 8) == 224.0
+
+
+def test_zero_rs_ag_pair_pinned_to_closed_form(audit, mesh8):
+    """THE ZeRO shape: grad contraction over the sharded batch dim
+    (partial sums) -> flat constraint (reduce-scatter) -> elementwise
+    update -> replicated constraint (all-gather).  64 floats = 256
+    bytes; rs + ag = 2 * 256 * 7/8 = 448 exactly."""
+    flat = NamedSharding(mesh8, P("data"))
+    repl = NamedSharding(mesh8, P())
+
+    def zero_like_step(x, m):
+        g = x.T @ x                                   # [8,8] partials
+        gf = jax.lax.with_sharding_constraint(g.reshape(-1), flat)
+        m2 = 0.9 * m + gf
+        w = jax.lax.with_sharding_constraint(m2 * 0.1, repl)
+        return w, m2
+
+    f = audit_jit(zero_like_step, site="t.zero",
+                  xla_contract=SiteContract(
+                      allow_collectives=True,
+                      in_specs=(("data",), ("data",)),
+                      mesh_axes=AX8, comm_bytes=1000.0))
+    f(jnp.ones((16, 8)), jnp.zeros((64,)))
+    rep = _report("t.zero")
+    assert _errors(rep) == []
+    assert rep.comm_bytes == 448.0
+
+
+def test_pending_psum_materializes_at_output(audit):
+    """A partial sum that reaches the outputs un-constrained is a full
+    all-reduce: 2 * 256 * 7/8 = 448 (the replicated-DP grad psum)."""
+    f = audit_jit(lambda x: x.T @ x, site="t.psum",
+                  xla_contract=SiteContract(
+                      allow_collectives=True, in_specs=(("data",),),
+                      mesh_axes=AX8))
+    f(jnp.ones((16, 8)))
+    assert _report("t.psum").comm_bytes == 448.0
+
+
+# ---------------------------------------------------------------------------
+# clean-run pins over the REAL sites
+# ---------------------------------------------------------------------------
+
+
+def test_zero_placement_compiles_and_audits_clean(audit):
+    """The gather-on-save / re-place paths go through the compiled
+    zero.replicate / zero.reshard identities on virtual-8 and audit
+    with zero ERRORs; the replicate all-gather is pinned to the closed
+    form (w: 64 floats -> 256 bytes * 7/8 = 224)."""
+    plan = S.drive_zero_placement()
+    assert plan is not None
+    reps = S.audit_sharding_sites()
+    assert {"zero.replicate", "zero.reshard"} <= set(reps)
+    for name in ("zero.replicate", "zero.reshard"):
+        assert _errors(reps[name]) == [], name
+    assert reps["zero.replicate"].comm_bytes == 224.0
+    assert reps["zero.reshard"].comm_bytes == 0.0     # free local slice
+    assert auditor().compile_count("zero.replicate") >= 1
+    assert auditor().compile_count("zero.reshard") >= 1
+
+
+def test_zero_place_flat_handles_off_mesh_committed_arrays(audit):
+    """A flat state tensor committed to ONE device (a checkpoint
+    staging buffer) must not crash the compiled-reshard fast path with
+    'incompatible devices' — off-mesh arrays take the host placement
+    path, mesh-resident ones the compiled identity."""
+    from paddle_tpu.parallel.mesh import make_mesh
+    from paddle_tpu.parallel.zero import _identity_jit, build_zero_plan
+
+    _identity_jit.cache_clear()    # earlier tests' cached wrappers
+    devs = jax.devices()
+    mesh = make_mesh((8,), ("data",), devs[:8])
+    plan = build_zero_plan(mesh, {"w": np.zeros((8, 8), np.float32)})
+    e = plan.entries["w"]
+    staged = jax.device_put(jnp.ones((e.padded,)), devs[3])
+    placed = plan.place_flat("w", staged)            # must not raise
+    assert placed.shape == (e.padded,)
+    np.testing.assert_allclose(np.asarray(placed), 1.0)
+    # and a mesh-resident flat array still rides the compiled reshard
+    plan.place_flat("w", placed)
+    assert auditor().compile_count("zero.reshard") >= 1
+
+
+def test_mesh_zero_train_step_audits_clean(audit, mesh8):
+    """One real ZeRO train pass on virtual-8: the sharding walk sees
+    the grad partial sums turn into reduce-scatters and the weight
+    gather into all-gathers, with ZERO error findings and the comm
+    estimate pinned to the closed form: 7 bytes/padded-element over
+    rs+ag (2 * 4 * 7/8) for the 200 padded params, plus the 7-byte
+    loss-scalar psum."""
+    import paddle_tpu as paddle
+    from paddle_tpu import layer, optimizer, trainer as trainer_mod
+
+    x = layer.data(name="x", type=paddle.data_type.dense_vector(8))
+    y = layer.data(name="y", type=paddle.data_type.integer_value(3))
+    h = layer.fc(x, size=16, act="relu")
+    logits = layer.fc(h, size=3)
+    cost = layer.classification_cost(input=logits, label=y)
+    params = paddle.Parameters.from_topology(
+        paddle.topology.Topology([cost]), seed=0)
+    sgd = trainer_mod.SGD(cost=cost, parameters=params, mesh=mesh8,
+                          zero=1, update_equation=optimizer.Momentum(
+                              momentum=0.9, learning_rate=0.05))
+    rng = np.random.RandomState(0)
+    data = [(rng.randn(8).astype(np.float32) * 0.1,
+             int(rng.randint(0, 3))) for _ in range(32)]
+    sgd.train(paddle.batch(lambda: iter(data), 16), num_passes=1)
+    rep = _report("trainer.train_step")
+    assert _errors(rep) == [], [str(d) for d in _errors(rep)]
+    padded = sum(e.padded for e in sgd._zero_plan.entries.values())
+    assert padded == 200                     # 128 + 16 + 48 + pad(3->8)
+    assert rep.comm_bytes == 7.0 * padded + 7.0
+    # the estimate lands under the trainer's derived budget
+    contract = auditor().sites["trainer.train_step"].contract
+    assert contract.comm_bytes is not None
+    assert rep.comm_bytes <= contract.comm_bytes
+
+
+@pytest.mark.serving
+def test_sealed_serving_step_audits_clean_int8(audit):
+    """The acceptance pin: the sealed mixed steady state (int8 KV,
+    prefix cache, COW fork, poison scrub) audits with zero ERRORs at
+    every serving site and ZERO estimated collective bytes — the
+    explicit replicated baseline contract the TP PR will flip."""
+    from paddle_tpu.analysis.xla import drive_serving_steady_state
+
+    old_bf16 = FLAGS.use_bf16
+    FLAGS.use_bf16 = False
+    try:
+        drive_serving_steady_state(kv_dtype="int8", seal=True)
+    finally:
+        FLAGS.use_bf16 = old_bf16
+    reps = S.audit_sharding_sites()
+    assert {"serving.step", "serving.fork_page",
+            "serving.zero_pages"} <= set(reps)
+    for name, rep in reps.items():
+        assert _errors(rep) == [], \
+            f"{name}: {[str(d) for d in _errors(rep)]}"
+        assert rep.comm_bytes == 0.0, name
+    assert auditor().diagnostics == []       # sealed replay: 0 RETRACE
+
+
+@pytest.mark.serving
+def test_serving_step_audits_clean_f32(audit):
+    """Same pin on a float32 pool (shorter unsealed drive)."""
+    from paddle_tpu.serving import DecoderLM, ServingEngine
+
+    model = DecoderLM(vocab_size=32, num_layers=1, num_heads=2,
+                      head_dim=8, max_positions=64)
+    params = model.init_params(jax.random.PRNGKey(0))
+    eng = ServingEngine(model, params, eos_id=1, page_size=4,
+                        num_pages=16, max_pages_per_seq=4, max_slots=2,
+                        buckets=(4, 8), prefill_chunk=4,
+                        kv_dtype="float32")
+    eng.submit([3, 4, 5, 6, 7], max_tokens=4)
+    eng.run(max_ticks=50)
+    rep = _report("serving.step")
+    assert _errors(rep) == []
+    assert rep.comm_bytes == 0.0
+    contract = auditor().sites["serving.step"].contract
+    assert contract.comm_bytes == 0.0        # the derived baseline
+
+
+# ---------------------------------------------------------------------------
+# pipeline / MoE stubs: contracts + loud notice + real capture
+# ---------------------------------------------------------------------------
+
+
+def test_stub_contracts_declared_and_noticed(audit, capsys):
+    S.declare_stub_contracts()
+    for site in ("parallel.pipeline", "parallel.moe"):
+        rec = auditor().sites[site]
+        assert rec.contract is not None
+        assert rec.contract.allow_collectives
+        assert not rec.captured
+    # the gate prints the loud notice for exactly these sites (the
+    # notice logic lives in run_sharding_audit; replicate its scan)
+    uncaptured = [name for name, rec in auditor().sites.items()
+                  if rec.contract is not None and not rec.captured]
+    assert set(uncaptured) == {"parallel.pipeline", "parallel.moe"}
+
+
+def test_pipeline_capture_audits_with_collective_costs(audit, mesh8):
+    from paddle_tpu.parallel.mesh import make_mesh
+    from paddle_tpu.parallel.pipeline import pipeline_apply
+
+    mesh = make_mesh((4,), ("stage",), jax.devices()[:4])
+    p = [{"w": jnp.eye(4) * (i + 1)} for i in range(4)]
+    from paddle_tpu.parallel.pipeline import stack_stage_params
+
+    stacked = stack_stage_params(p, mesh, "stage")
+    mbs = jnp.ones((3, 2, 4))
+    out = pipeline_apply(mesh, lambda prm, x: x @ prm["w"], stacked, mbs)
+    assert out.shape == (3, 2, 4)
+    rep = _report("parallel.pipeline")
+    assert _errors(rep) == []                # allow_collectives stub
+    assert rep.comm_bytes > 0                # ppermute/psum hops costed
+
+
+def test_moe_capture_audits_clean(audit, mesh8):
+    from paddle_tpu.parallel.moe import init_moe_params, moe_ffn
+
+    x = jnp.asarray(np.random.RandomState(0).randn(16, 8),
+                    jnp.float32)
+    params = init_moe_params(jax.random.PRNGKey(0), 8, 16, 8)
+    y, aux = moe_ffn(mesh8, x, params, axis="data", capacity_factor=8.0)
+    assert y.shape == (16, 8)
+    rep = _report("parallel.moe")
+    assert _errors(rep) == []
+    assert rep.comm_bytes > 0                # the two all_to_alls
+
+
+# ---------------------------------------------------------------------------
+# obs satellite: comm bytes on the scrape surface
+# ---------------------------------------------------------------------------
+
+
+def test_comm_bytes_published_to_registry(audit):
+    from paddle_tpu.obs.registry import MetricsRegistry
+
+    f = audit_jit(lambda x: x.T @ x, site="t.pub",
+                  xla_contract=SiteContract(
+                      allow_collectives=True, in_specs=(("data",),),
+                      mesh_axes=AX8))
+    f(jnp.ones((16, 8)))
+    S.audit_sharding_sites()                 # stamps rec.comm_bytes
+    reg = MetricsRegistry()
+    auditor().publish(reg)
+    snap = reg.snapshot()
+    assert snap["comm_bytes_total{site=t.pub}"] == 448.0
+    # the gauge is lazy: a fresh auditor with no audit publishes none
+    auditor().reset()
+    f(jnp.ones((16, 8)))
+    reg2 = MetricsRegistry()
+    auditor().publish(reg2)
+    assert not any("comm_bytes_total" in k for k in reg2.snapshot())
+
+
+@pytest.mark.serving
+@pytest.mark.obs
+def test_comm_bytes_rides_engine_healthz(audit):
+    from paddle_tpu.serving import DecoderLM, ServingEngine
+
+    model = DecoderLM(vocab_size=32, num_layers=1, num_heads=2,
+                      head_dim=8, max_positions=64)
+    params = model.init_params(jax.random.PRNGKey(0))
+    eng = ServingEngine(model, params, eos_id=1, page_size=4,
+                        num_pages=16, max_pages_per_seq=4, max_slots=2,
+                        buckets=(4, 8), prefill_chunk=0)
+    eng.submit([3, 4, 5], max_tokens=4)
+    eng.run(max_ticks=50)
+    S.audit_sharding_sites(sites=["serving.step"])
+    snap = eng.healthz()["metrics"]
+    assert snap["comm_bytes_total{site=serving.step}"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+
+def test_cli_rejects_unknown_rule():
+    from paddle_tpu.analysis.cli import main
+
+    assert main(["sharding", "--rule", "nope"]) == 2
+
+
+def test_audit_skips_uncaptured_sites(audit):
+    audit_jit(lambda x: x, site="t.never",
+              xla_contract=SiteContract(in_specs=((),)))
+    assert "t.never" not in S.audit_sharding_sites()
+
+
+def test_reset_clears_comm_stamp(audit):
+    f = audit_jit(lambda x: x.T @ x, site="t.stamp",
+                  xla_contract=SiteContract(
+                      allow_collectives=True, in_specs=(("data",),),
+                      mesh_axes=AX8))
+    f(jnp.ones((16, 8)))
+    S.audit_sharding_sites()
+    rec = auditor().sites["t.stamp"]
+    assert rec.comm_bytes == 448.0
+    auditor().reset()
+    assert rec.comm_bytes is None

@@ -106,4 +106,24 @@ elif [ "$xla_rc" -ne 0 ]; then
     print_postmortems
     exit 8
 fi
+# static sharding-propagation audit (paddle_tpu.analysis.sharding):
+# drives the same sealed serving+trainer steady states as the xla gate
+# plus the ZeRO placement jits on a virtual-8 mesh, then checks every
+# captured site's declared PartitionSpec contract — contract mismatch,
+# implicit all-gathers, accidental replication, axis collisions, and
+# the per-tick collective-bytes budget.  Exit 9 extends the ladder
+# (3/4/5/6/7/8); same contract as the lint/fleet/xla gates: branch on
+# the auditor's OWN exit status (findings=1, crash=2), never on a grep
+# of the shared log.
+env JAX_PLATFORMS=cpu python -m paddle_tpu.analysis sharding 2>&1 | tee -a /tmp/_t1.log
+shard_rc=${PIPESTATUS[0]}
+if [ "$shard_rc" -eq 1 ]; then
+    echo 'SHARD-AUDIT: sharding-propagation contract violated (see log above)'
+    print_postmortems
+    exit 9
+elif [ "$shard_rc" -ne 0 ]; then
+    echo "SHARD-AUDIT: sharding auditor itself exited $shard_rc without running to completion"
+    print_postmortems
+    exit 9
+fi
 exit $rc
