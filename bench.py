@@ -1170,6 +1170,147 @@ def worker_serving_mixed():
     print(json.dumps(out), flush=True)
 
 
+def worker_serving_tp():
+    """Tensor-parallel serving A/B (round 13): the mixed long-prefill /
+    heavy-decode Poisson trace replayed THREE times on one injected
+    clock — replicated (mesh=None), tp=2 and tp=4 over a `model` mesh
+    axis of the virtual-8 host — with ``FLAGS.jit_audit`` on so every
+    replay's ``serving.step`` is captured and statically audited by the
+    sharding-propagation auditor (paddle_tpu.analysis.sharding).
+
+    Asserts, not just reports: tp=2 and tp=4 greedy outputs are
+    TOKEN-IDENTICAL to the replicated control, every replay completes
+    everything with 0 page/ref leaks, the audited
+    ``comm_bytes_total{site=serving.step}`` equals the closed-form
+    megatron psum budget (2 row-parallel psums per layer, 2*b*(N-1)/N
+    each) with ZERO sharding-audit errors (no implicit all-gather on
+    the decode hot path), and the same per-chip pool byte budget admits
+    tp x the pages.  Wall-clock tokens/s is CPU PROXY ONLY (GSPMD over
+    virtual CPU devices pays host-thread collectives; the per-chip
+    speedup is a chip number) — the structure is what's pinned."""
+    import numpy as np
+
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.analysis import sharding as shard_audit
+    from paddle_tpu.analysis.retrace import auditor
+    from paddle_tpu.parallel.mesh import make_mesh
+    from paddle_tpu.platform.flags import FLAGS
+    from paddle_tpu.serving import (DecoderLM, FaultPlan, ManualClock,
+                                    RequestStatus, ServingEngine)
+
+    paddle.init()
+    rng = np.random.RandomState(0)
+    vocab, eos = 512, 1
+    model = DecoderLM(vocab_size=vocab, num_layers=2, num_heads=4,
+                      head_dim=16, max_positions=512)
+    params = model.init_params(jax.random.PRNGKey(0))
+    pool_bytes = 96 * _tp_page_bytes(model)       # per-CHIP budget
+
+    system = rng.randint(2, vocab, size=32).tolist()   # 2 shared pages
+    reqs = []
+    for _ in range(6):          # long prefill, short decode
+        tail = rng.randint(2, vocab, size=int(rng.randint(48, 81))).tolist()
+        reqs.append((system + tail, 6))
+    for _ in range(10):         # short prefill, heavy decode
+        reqs.append((rng.randint(2, vocab,
+                                 size=int(rng.randint(4, 13))).tolist(), 16))
+    order = rng.permutation(len(reqs))
+    arrivals = np.cumsum(rng.exponential(1.0 / 40.0, len(reqs)))
+
+    old_audit = FLAGS.jit_audit
+    FLAGS.jit_audit = True
+
+    def replay(tp):
+        auditor().reset()
+        mesh = None if tp == 1 else make_mesh((tp,), ("model",),
+                                              jax.devices()[:tp])
+        clock = ManualClock(tick_s=0.02)
+        eng = ServingEngine(model, params, eos_id=eos, page_size=16,
+                            num_pages=None, pool_bytes=pool_bytes,
+                            max_pages_per_seq=16, max_slots=8,
+                            buckets=(32, 64, 128), prefill_chunk=64,
+                            kv_dtype="float32", prefix_cache=True,
+                            faults=FaultPlan(clock=clock), mesh=mesh)
+        rids = [None] * len(reqs)
+        t0 = time.monotonic()
+        i = 0
+        while i < len(reqs) or eng.has_work:
+            while i < len(reqs) and arrivals[i] <= clock():
+                p, mt = reqs[order[i]]
+                rids[order[i]] = eng.submit(p, max_tokens=mt)
+                i += 1
+            eng.step()
+            assert eng.metrics.ticks < 8000, "tp trace failed to drain"
+        wall = time.monotonic() - t0
+        results = eng.run(max_ticks=1)      # drained: conservation check
+        assert all(eng.status(r) is RequestStatus.COMPLETED for r in rids)
+        assert eng.pool.total_refs == 0, "page refs leaked"
+        reps = shard_audit.audit_sharding_sites(sites=["serving.step"])
+        rep = reps["serving.step"]
+        assert not rep.errors, [d.message for d in rep.errors]
+        rec = auditor().sites["serving.step"]
+        budget = max((eng.tp_step_comm_bytes(cap.args[2].shape[0]
+                                             + cap.args[5].shape[0])
+                      for cap in rec.captured.values()), default=0.0)
+        assert rep.comm_bytes == budget, (rep.comm_bytes, budget)
+        outs = [results[r] for r in rids]
+        snap = eng.metrics.snapshot()
+        return outs, snap, wall, eng.pool.num_usable, rep.comm_bytes
+
+    try:
+        outs_rep, snap_rep, wall_rep, pages_rep, comm_rep = replay(1)
+        outs_tp2, snap_tp2, wall_tp2, pages_tp2, comm_tp2 = replay(2)
+        outs_tp4, snap_tp4, wall_tp4, pages_tp4, comm_tp4 = replay(4)
+    finally:
+        FLAGS.jit_audit = old_audit
+        auditor().reset()
+    assert outs_tp2 == outs_rep, "tp=2 broke greedy parity"
+    assert outs_tp4 == outs_rep, "tp=4 broke greedy parity"
+    assert comm_rep == 0.0
+    assert pages_tp2 >= 2 * pages_rep and pages_tp4 >= 4 * pages_rep
+
+    def per_chip(snap, wall, tp):
+        return round(snap["tokens_generated"] / max(wall, 1e-9) / tp, 2)
+
+    out = {
+        "serving_tp_model": "decoderlm_L2_H4_D16_v512_page16_"
+                            f"{pool_bytes >> 10}KiB_per_chip_slots8",
+        "serving_tp_tokens_per_s_per_chip_rep": per_chip(snap_rep,
+                                                         wall_rep, 1),
+        "serving_tp_tokens_per_s_per_chip_tp2": per_chip(snap_tp2,
+                                                         wall_tp2, 2),
+        "serving_tp_tokens_per_s_per_chip_tp4": per_chip(snap_tp4,
+                                                         wall_tp4, 4),
+        "serving_tp_ttft_ms_p95_rep": snap_rep["ttft_ms_p95"],
+        "serving_tp_ttft_ms_p95_tp2": snap_tp2["ttft_ms_p95"],
+        "serving_tp_ttft_ms_p95_tp4": snap_tp4["ttft_ms_p95"],
+        "serving_tp_comm_bytes_step_rep": comm_rep,
+        "serving_tp_comm_bytes_step_tp2": comm_tp2,
+        "serving_tp_comm_bytes_step_tp4": comm_tp4,
+        "serving_tp_pages_per_chip_budget_rep": pages_rep,
+        "serving_tp_pages_per_chip_budget_tp2": pages_tp2,
+        "serving_tp_pages_per_chip_budget_tp4": pages_tp4,
+        "serving_tp_parity_ok": int(outs_tp2 == outs_rep
+                                    and outs_tp4 == outs_rep),
+        "serving_tp_hit_rate_tp2": snap_tp2["prefix_hit_rate"],
+        "serving_tp_completed": snap_tp4["requests_completed"],
+    }
+    print(json.dumps(out), flush=True)
+
+
+def _tp_page_bytes(model):
+    """f32 bytes one tp=1 page costs for ``model`` at page 16 — the
+    per-chip pool budget unit worker_serving_tp sizes with."""
+    from paddle_tpu.serving.kv_cache import PagedKVConfig
+
+    return PagedKVConfig(num_layers=model.num_layers,
+                         num_heads=model.num_heads,
+                         head_dim=model.head_dim, page_size=16,
+                         num_pages=2, max_pages_per_seq=1).bytes_per_page()
+
+
 def worker_serving_fleet():
     """Fleet-level serving A/B: FOUR ServingEngine replicas behind a
     FleetRouter on one injected clock, a Poisson trace of SIX tenants —
@@ -1493,6 +1634,7 @@ WORKERS = {
     "serving_chaos": worker_serving_chaos,
     "serving_prefix": worker_serving_prefix,
     "serving_mixed": worker_serving_mixed,
+    "serving_tp": worker_serving_tp,
     "serving_fleet": worker_serving_fleet,
     "moe": worker_moe,
 }
@@ -1579,7 +1721,8 @@ def main():
 
     # cheap + hardware-independent first: never starved by a dead tunnel
     for cpu_worker in ("scaling", "zero1", "serving", "serving_chaos",
-                       "serving_prefix", "serving_mixed", "serving_fleet"):
+                       "serving_prefix", "serving_mixed", "serving_tp",
+                       "serving_fleet"):
         out, err = _run_worker(cpu_worker, deadline, cpu=True,
                                attempt_timeout=380, max_attempts=1)
         if out:

@@ -123,14 +123,31 @@ class RetraceError(AssertionError):
 
 def abstract_signature(args: Tuple, kwargs: Dict) -> Tuple:
     """Hashable abstract signature of a call: array leaves collapse to
-    (shape, dtype, weak_type); non-array leaves keep type+repr (they are
-    trace-time constants, so a changed value IS a changed program)."""
+    (shape, dtype, weak_type) — plus the mesh/PartitionSpec for arrays
+    committed to a NamedSharding, since jax.jit keys its cache on input
+    shardings too: a TP engine and a replicated engine sharing one site
+    legitimately compile the same shapes twice, which must not read as
+    a same-signature retrace.  Uncommitted/single-device arrays (no
+    ``.spec``) are unaffected.  Non-array leaves keep type+repr (they
+    are trace-time constants, so a changed value IS a changed
+    program)."""
     import jax
 
     def leaf(x):
         if hasattr(x, "shape") and hasattr(x, "dtype"):
             weak = bool(getattr(x, "weak_type", False))
-            return ("arr", tuple(x.shape), str(x.dtype), weak)
+            sig = ("arr", tuple(x.shape), str(x.dtype), weak)
+            sh = getattr(x, "sharding", None)
+            spec = getattr(sh, "spec", None)
+            mesh = getattr(sh, "mesh", None)
+            if spec is not None and mesh is not None:
+                try:
+                    sig += (str(tuple(spec)),
+                            tuple((str(a), int(n))
+                                  for a, n in dict(mesh.shape).items()))
+                except Exception:
+                    pass
+            return sig
         return ("const", type(x).__name__, repr(x))
 
     leaves, treedef = jax.tree.flatten((args, kwargs))

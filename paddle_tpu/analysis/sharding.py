@@ -84,7 +84,8 @@ from paddle_tpu.analysis.xla import _aval_bytes, _sub_jaxprs
 __all__ = ["audit_sharding_sites", "audit_record_sharding", "ShardReport",
            "RULE_NAMES", "normalize_spec", "apply_spec",
            "all_gather_bytes", "reduce_scatter_bytes", "all_reduce_bytes",
-           "drive_zero_placement", "ensure_virtual_devices",
+           "drive_zero_placement", "drive_serving_tp_steady_state",
+           "replay_serving_tp", "ensure_virtual_devices",
            "run_sharding_audit"]
 
 TAG = "SHARD-AUDIT"
@@ -435,31 +436,93 @@ def _rule_dot_general(st: _Walk, eqn, ins: List[VSpec],
     return [VSpec(tuple(out_dims), pend)]
 
 
+def _reshape_groups(ish: Tuple[int, ...], osh: Tuple[int, ...]):
+    """Contiguous factor groups of a reshape: ``[(in_dims, out_dims)]``
+    pairs with equal products, two-pointer walk.  None when the shapes
+    don't decompose (zero-sized dims etc.) — callers fall back to the
+    conservative gather."""
+    groups: List[Tuple[List[int], List[int]]] = []
+    i = j = 0
+    ni, nj = len(ish), len(osh)
+    while i < ni or j < nj:
+        if i < ni and int(ish[i]) == 1 and (j >= nj or int(osh[j]) != 1):
+            groups.append(([i], []))        # dangling size-1 in dim
+            i += 1
+            continue
+        if j < nj and int(osh[j]) == 1 and (i >= ni or int(ish[i]) != 1):
+            groups.append(([], [j]))        # dangling size-1 out dim
+            j += 1
+            continue
+        if i >= ni or j >= nj:
+            return None
+        pi, pj = int(ish[i]), int(osh[j])
+        di, dj = [i], [j]
+        i += 1
+        j += 1
+        while pi != pj:
+            if pi < pj:
+                if i >= ni:
+                    return None
+                pi *= int(ish[i])
+                di.append(i)
+                i += 1
+            else:
+                if j >= nj:
+                    return None
+                pj *= int(osh[j])
+                dj.append(j)
+                j += 1
+        if pi <= 0:
+            return None
+        groups.append((di, dj))
+    return groups
+
+
 def _rule_reshape(st: _Walk, eqn, ins: List[VSpec],
                   path: str) -> List[VSpec]:
+    """GSPMD-compatible reshape propagation: a sharded dim survives when
+    it is the MAJOR (first >1) dim of its contiguous factor group and
+    the group's major output dim holds a whole number of shards — the
+    shard boundary stays contiguous, so merging ``[H, D] -> [H*D]`` or
+    splitting ``[E] -> [H, D]`` keeps a head-sharded placement (the
+    megatron Q/K/V reshapes).  A sharded dim that is minor in its group,
+    or whose target major dim doesn't divide by the axis size, still
+    forces the all-gather."""
     vs = ins[0]
     if vs.dims is None:
         return [VSpec(None, vs.pending)]
     in_shape = _shape(eqn.invars[0])
     out_shape = _shape(eqn.outvars[0])
     out_dims: List[Optional[str]] = [None] * len(out_shape)
-    for d, ax in enumerate(vs.dims):
-        if ax is None:
-            continue
-        pre = _prod(in_shape[:d])
-        kept = False
-        for od in range(len(out_shape)):
-            if int(out_shape[od]) == int(in_shape[d]) \
-                    and _prod(out_shape[:od]) == pre:
-                out_dims[od] = ax
-                kept = True
-                break
-        if not kept:
-            st.gather(
-                f"reshape {tuple(in_shape)} -> {tuple(out_shape)} "
-                f"splits/merges the {ax!r}-sharded dim {d}",
-                _aval_bytes(eqn.invars[0].aval), ax,
-                where=f"{path} (reshape)")
+    groups = _reshape_groups(tuple(in_shape), tuple(out_shape))
+
+    def lose(d: int, ax: str) -> None:
+        st.gather(
+            f"reshape {tuple(in_shape)} -> {tuple(out_shape)} "
+            f"splits/merges the {ax!r}-sharded dim {d}",
+            _aval_bytes(eqn.invars[0].aval), ax,
+            where=f"{path} (reshape)")
+
+    if groups is None:
+        for d, ax in enumerate(vs.dims):
+            if ax is not None:
+                lose(d, ax)
+        return [VSpec(tuple(out_dims), vs.pending)]
+    for di, dj in groups:
+        major_in = next((d for d in di if int(in_shape[d]) > 1),
+                        di[0] if di else None)
+        major_out = next((d for d in dj if int(out_shape[d]) > 1),
+                         dj[0] if dj else None)
+        for d in di:
+            ax = vs.dims[d]
+            if ax is None:
+                continue
+            n = st.size(ax)
+            if d == major_in and major_out is not None and \
+                    (n is None or int(out_shape[major_out]) % int(n) == 0):
+                out_dims[major_out] = ax
+            else:
+                lose(d, ax)
     return [VSpec(tuple(out_dims), vs.pending)]
 
 
@@ -605,6 +668,8 @@ def _rule_gather(st: _Walk, eqn, ins: List[VSpec],
     if vs.dims is None:
         return [_UNKNOWN]
     dn = eqn.params["dimension_numbers"]
+    in_shape = _shape(eqn.invars[0])
+    slice_sizes = tuple(eqn.params.get("slice_sizes", ()) or ())
     batching = set(getattr(dn, "operand_batching_dims", ()) or ())
     indexed = set(dn.start_index_map) | set(dn.collapsed_slice_dims)
     for d, ax in enumerate(vs.dims):
@@ -616,13 +681,38 @@ def _rule_gather(st: _Walk, eqn, ins: List[VSpec],
                 "(not a batching dim): every shard needs every other "
                 "shard's rows", _aval_bytes(eqn.invars[0].aval), ax,
                 where=f"{path} (gather)")
-    # output layout: batching dims lead the output and keep their
-    # placement; everything else is conservatively unknown-replicated
     out_shape = _shape(eqn.outvars[0])
     out_dims: List[Optional[str]] = [None] * len(out_shape)
+    # batching dims lead the output and keep their placement
     for i, d in enumerate(sorted(batching)):
         if i < len(out_dims) and vs.dims[d] is not None:
             out_dims[i] = vs.dims[d]
+    # window (offset) dims pass the operand placement through when the
+    # slice keeps the WHOLE dim — the paged-KV reads (k_pages[table]:
+    # page/head/head_dim are full-window dims) stay head-sharded, which
+    # is what lets the walk prove the TP decode path reduce-not-gather.
+    # A partial slice of a sharded dim is a real re-layout: gather it.
+    window = [d for d in range(len(in_shape))
+              if d not in dn.collapsed_slice_dims and d not in batching]
+    offset = tuple(dn.offset_dims)
+    for od, d in zip(offset, window):
+        ax = vs.dims[d]
+        if ax is None or d in indexed:
+            # indexed dims were already reported (and charged) above —
+            # an indexed-but-uncollapsed dim is also a window dim, and
+            # double-charging it would inflate the comm estimate 2x
+            continue
+        full = (d < len(slice_sizes)
+                and int(slice_sizes[d]) == int(in_shape[d]))
+        if full and od < len(out_dims) and out_dims[od] is None:
+            out_dims[od] = ax
+        elif not full:
+            st.gather(
+                f"gather slices the {ax!r}-sharded operand dim {d} "
+                f"({in_shape[d]} -> "
+                f"{slice_sizes[d] if d < len(slice_sizes) else '?'})",
+                _aval_bytes(eqn.invars[0].aval), ax,
+                where=f"{path} (gather)")
     return [VSpec(tuple(out_dims), vs.pending)]
 
 
@@ -925,15 +1015,49 @@ def _broadcasts(ish: Tuple[int, ...], osh: Tuple[int, ...]) -> bool:
 # ---------------------------------------------------------------------------
 
 
+def _leaf_path_key(path) -> str:
+    """Pytree key path -> a stable lookup string: dict keys / sequence
+    indices / attr names joined by '/'.  A flat ``{name: array}`` param
+    dict yields exactly ``name``."""
+    parts = []
+    for p in path:
+        for attr in ("key", "idx", "name"):
+            if hasattr(p, attr):
+                parts.append(str(getattr(p, attr)))
+                break
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def _resolve_leaf_specs(arg, spec):
+    """Pairs of (per-leaf spec, leaf) for one positional arg.  A plain
+    spec broadcasts over every leaf (the documented semantics); a DICT
+    spec maps pytree key paths to per-leaf specs — the TP serving step
+    declares its params this way, one megatron placement per weight —
+    with unmatched leaves left None (undeclared, never a finding)."""
+    import jax
+
+    if not isinstance(spec, dict):
+        return [(spec, leaf) for leaf in jax.tree.leaves(arg)]
+    out = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(arg)[0]:
+        key = _leaf_path_key(path)
+        s = spec.get(key)
+        if s is None and "/" in key:
+            s = spec.get(key.rsplit("/", 1)[-1])
+        out.append((s, leaf))
+    return out
+
+
 def _leaf_specs_for_call(st: _Walk, cap: CapturedCall,
                          contract: SiteContract) -> List[VSpec]:
     """Per-invar seed VSpecs: each positional arg's declared spec
-    (broadcast rule) applied to every one of its array leaves, in the
-    same flatten order ``make_jaxpr`` uses; kwargs leaves are unknown.
-    Contract problems (bad axis, duplicate axis, replicated
-    expect_sharded arg) are reported here."""
-    import jax
-
+    (broadcast rule; dict specs resolve per leaf by pytree key) applied
+    to every one of its array leaves, in the same flatten order
+    ``make_jaxpr`` uses; kwargs leaves are unknown.  Contract problems
+    (bad axis, duplicate axis, replicated expect_sharded arg) are
+    reported here."""
     axes = st.axes
     seeds: List[VSpec] = []
     n_args = len(cap.args)
@@ -941,10 +1065,10 @@ def _leaf_specs_for_call(st: _Walk, cap: CapturedCall,
         spec = _spec_for(contract.in_specs, i, n_args)
         any_sharded = False
         has_leaf = False
-        for leaf in jax.tree.leaves(arg):
+        for leaf_spec, leaf in _resolve_leaf_specs(arg, spec):
             if hasattr(leaf, "shape"):
                 has_leaf = True
-                vs, probs = apply_spec(spec, tuple(leaf.shape), axes)
+                vs, probs = apply_spec(leaf_spec, tuple(leaf.shape), axes)
                 for rule, msg in probs:
                     st.report(Severity.ERROR, rule,
                               f"arg {i}: {msg}")
@@ -961,6 +1085,8 @@ def _leaf_specs_for_call(st: _Walk, cap: CapturedCall,
                 "input spec carries no mesh axis — the plan's sharding "
                 "never reached this argument (every device holds a full "
                 "replica)")
+    import jax
+
     for leaf in jax.tree.leaves(cap.kwargs):
         seeds.append(_UNKNOWN)
     return seeds
@@ -968,9 +1094,12 @@ def _leaf_specs_for_call(st: _Walk, cap: CapturedCall,
 
 def _declares_sharding(contract: SiteContract) -> bool:
     for specs in (contract.in_specs, contract.out_specs):
-        if specs:
-            for s in specs:
-                ns = normalize_spec(s)
+        if not specs:
+            continue
+        for s in specs:
+            entries = s.values() if isinstance(s, dict) else (s,)
+            for e in entries:
+                ns = normalize_spec(e)
                 if ns and any(a is not None for a in ns):
                     return True
     return False
@@ -1199,6 +1328,69 @@ def drive_zero_placement(n_devices: Optional[int] = None):
     return plan
 
 
+def drive_serving_tp_steady_state(tp: int = 2, kv_dtype: str = "int8"):
+    """The tensor-parallel serving steady state the gate audits IN
+    ADDITION to the replicated one: a ``model``-axis mesh of ``tp``
+    chips, int8 pool, GQA heads — warmup covers every (decode, prefill)
+    pair bucket the replay uses, a full-cover cache hit exercises the
+    sharded COW fork and a fault-poisoned request the sharded scrub, so
+    ``serving.step``/``fork_page``/``zero_pages`` all capture TP
+    signatures under the flipped model-axis contracts.  The model
+    geometry deliberately differs from the replicated drive's (H4/KVH2
+    vs H2) so the two engines' signatures never collide at the shared
+    sites.  Requires ``FLAGS.jit_audit`` on before the call; returns
+    the engine (None when fewer than ``tp`` devices exist — the CLI's
+    virtual-8 guarantee makes that a test-environment case only)."""
+    import jax
+    import numpy as np
+
+    from paddle_tpu.parallel.mesh import make_mesh
+    from paddle_tpu.serving import DecoderLM, ServingEngine
+    from paddle_tpu.serving.faults import FaultPlan
+
+    devs = jax.devices()
+    if len(devs) < tp:
+        return None
+    mesh = make_mesh((tp,), ("model",), devs[:tp])
+    model = DecoderLM(vocab_size=50, num_layers=2, num_heads=4,
+                      num_kv_heads=2, head_dim=8, max_positions=128)
+    params = model.init_params(jax.random.PRNGKey(1))
+    faults = FaultPlan()
+    eng = ServingEngine(model, params, eos_id=1, page_size=4,
+                        num_pages=64, max_pages_per_seq=12, max_slots=4,
+                        buckets=(4, 8, 16), prefill_chunk=8,
+                        kv_dtype=kv_dtype, faults=faults, mesh=mesh)
+    rng = np.random.RandomState(1)
+    shared = rng.randint(2, 50, size=8).tolist()   # two FULL pages
+    eng.submit(shared, max_tokens=6)
+    eng.run(max_ticks=200)
+    eng.submit(shared, max_tokens=6)               # full-cover hit: fork
+    eng.run(max_ticks=200)
+    eng.submit(rng.randint(2, 50, size=4).tolist(), max_tokens=12)
+    eng.step()
+    eng.submit(rng.randint(2, 50, size=20).tolist(), max_tokens=8)
+    eng.run(max_ticks=300)
+    # poisoned decode: the sharded FAILED scrub (serving.zero_pages)
+    bad = eng.submit(rng.randint(2, 50, size=5).tolist(), max_tokens=6)
+    eng.step()
+    faults.poison_nan(bad)
+    eng.run(max_ticks=200)
+    return eng
+
+
+def replay_serving_tp(eng) -> None:
+    """The sealed steady-state replay for the TP engine — fresh traffic
+    over the same pair buckets, so 'TP adds no compile dimension' is
+    checked by the same RETRACE fold-in as the replicated replay."""
+    import numpy as np
+
+    rng = np.random.RandomState(9)
+    eng.submit(rng.randint(2, 50, size=4).tolist(), max_tokens=10)
+    eng.step()
+    eng.submit(rng.randint(2, 50, size=17).tolist(), max_tokens=6)
+    eng.run(max_ticks=300)
+
+
 def declare_stub_contracts() -> None:
     """Register the (trivial) pipeline/MoE sharding contracts so the
     auditor's 'declared but captured nothing' notice names them — the
@@ -1232,6 +1424,13 @@ def run_sharding_audit(printer: Callable[[str], None] = print,
         eng = drive_serving_steady_state(seal=False)
         drive_trainer_step()
         plan = drive_zero_placement()
+        # the tensor-parallel steady state rides the same gate: its
+        # model-axis contracts (megatron param specs, sharded pool,
+        # closed-form psum budget) audit next to the replicated
+        # baseline, so an implicit all-gather or comm-budget regression
+        # on the TP decode hot path fails tier-1 through the SAME
+        # ladder exit as any other sharding finding
+        tp_eng = drive_serving_tp_steady_state()
         declare_stub_contracts()
         aud.seal()
         import numpy as np
@@ -1241,6 +1440,9 @@ def run_sharding_audit(printer: Callable[[str], None] = print,
         eng.step()
         eng.submit(rng.randint(2, 50, size=17).tolist(), max_tokens=8)
         eng.run(max_ticks=300)
+        if tp_eng is not None:
+            # sealed TP replay: TP must not add a compile dimension
+            replay_serving_tp(tp_eng)
         reports = audit_sharding_sites(aud, rules=rules)
     finally:
         FLAGS.jit_audit = old
@@ -1255,6 +1457,10 @@ def run_sharding_audit(printer: Callable[[str], None] = print,
         printer("== zero placement: <2 devices, nothing shards — the "
                 "ZeRO reduce-scatter/all-gather pair was NOT audited "
                 "(run with virtual devices to cover it)")
+    if tp_eng is None:
+        printer("== serving tp: <2 devices — the tensor-parallel "
+                "serving contracts were NOT audited (run with virtual "
+                "devices to cover them)")
     # a contract-bearing site the drives never compiled is a coverage
     # hole, not a pass — the pipeline/MoE stubs land here by design
     for name, rec in sorted(aud.sites.items()):

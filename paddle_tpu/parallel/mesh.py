@@ -75,5 +75,31 @@ def hybrid_mesh(ici_shape: Sequence[int], dcn_shape: Sequence[int],
     return jax.sharding.Mesh(arr, tuple(axis_names))
 
 
+def mesh_slices(tp: int, axis: str = "model", devices=None,
+                max_slices: Optional[int] = None):
+    """Partition the device set into consecutive ``tp``-chip slices,
+    one 1-D ``axis`` mesh per slice — the serving fleet's replica unit
+    under tensor parallelism: each slice backs ONE
+    ``ServingEngine(mesh=slice)`` replica, so "replica" stops meaning
+    "chip" and starts meaning "enough chips to hold the model".
+    Consecutive devices stay ICI-adjacent under the platform's default
+    ordering, keeping each replica's psums on the fastest links.
+    Leftover devices (count not divisible by ``tp``) are unused."""
+    import jax
+
+    devs = list(devices) if devices is not None else pdevice.devices()
+    tp = int(tp)
+    enforce_that(tp >= 1, f"tp must be >= 1, got {tp}", context="mesh")
+    n = len(devs) // tp
+    enforce_that(n >= 1,
+                 f"{len(devs)} device(s) cannot host even one {tp}-chip "
+                 "slice", context="mesh")
+    if max_slices is not None:
+        n = min(n, int(max_slices))
+    return [jax.sharding.Mesh(
+        np.asarray(devs[i * tp:(i + 1) * tp]).reshape((tp,)), (axis,))
+        for i in range(n)]
+
+
 def mesh_axis_names(mesh) -> Tuple[str, ...]:
     return tuple(mesh.axis_names)

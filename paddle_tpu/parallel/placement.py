@@ -21,9 +21,39 @@ reference's layer placement provided (models too big for one device).
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence, Tuple
 
 from paddle_tpu.attr import ExtraAttr, ParamAttr
+
+
+@dataclass(frozen=True)
+class _PlanSpec:
+    """Adapter so a serving ``shard_plan`` entry plugs into the
+    ``specs[name].attr`` shape :func:`~paddle_tpu.parallel.api.param_sharding`
+    and :func:`~paddle_tpu.parallel.zero.build_zero_plan` consume."""
+
+    attr: ParamAttr
+
+
+def plan_param_attrs(plan: Dict[str, Tuple]) -> Dict[str, _PlanSpec]:
+    """Bridge a model's tensor-parallel ``shard_plan`` ({param name:
+    per-dim axis tuple}) into the explicit-``ParamAttr.sharding`` spec
+    dict the data-parallel/ZeRO machinery takes — the train→serve
+    "one placement story": ``build_zero_plan(mesh, params,
+    specs=plan_param_attrs(model.shard_plan()))`` keeps every
+    TP-sharded weight in its declared megatron layout (explicit
+    sharding wins the precedence rules) while the replicated remainder
+    (embeddings, the vocab head) still gets its optimizer state
+    ZeRO-sharded over the ``data`` axis.  Entries with no real axis are
+    OMITTED rather than declared ``P()`` — an explicit empty spec would
+    opt them out of ZeRO, which is exactly backwards."""
+    out: Dict[str, _PlanSpec] = {}
+    for name, spec in plan.items():
+        dims = tuple(spec)
+        if any(a is not None for a in dims):
+            out[name] = _PlanSpec(attr=ParamAttr(sharding=dims))
+    return out
 
 
 def stage_attrs(part: str, axis: str = "model"):

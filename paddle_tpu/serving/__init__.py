@@ -10,9 +10,9 @@ control and preemption (``scheduler``), and the user-facing
 from paddle_tpu.serving.decode_attention import (
     BLOCK_ROWS, attention_path, paged_decode_attention,
     paged_decode_attention_reference, ragged_paged_attention,
-    ragged_paged_attention_reference)
+    ragged_paged_attention_reference, ragged_paged_attention_tp)
 from paddle_tpu.serving.engine import (DecodeModel, DecoderLM, ServingEngine,
-                                       greedy_decode_reference)
+                                       greedy_decode_reference, validate_tp)
 from paddle_tpu.serving.faults import (FaultPlan, FleetFaultPlan,
                                        InjectedDeviceError, ManualClock,
                                        PageLeakError)
@@ -33,7 +33,8 @@ __all__ = [
     "ServingEngine", "DecodeModel", "DecoderLM", "greedy_decode_reference",
     "paged_decode_attention", "paged_decode_attention_reference",
     "ragged_paged_attention", "ragged_paged_attention_reference",
-    "attention_path", "BLOCK_ROWS",
+    "ragged_paged_attention_tp", "attention_path", "BLOCK_ROWS",
+    "validate_tp",
     "PagedKVConfig", "KVPages", "PagePool", "PrefixCache", "NULL_PAGE",
     "init_kv_pages", "append_token", "write_prompt", "gather_kv",
     "fork_page", "prefix_chain_hashes", "quantize_kv", "dequantize_kv",

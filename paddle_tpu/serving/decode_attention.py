@@ -362,6 +362,71 @@ def ragged_paged_attention(q, k_pages, v_pages, page_table, kv_lens,
         k_scale=k_scale, v_scale=v_scale, sm_scale=sm_scale)
 
 
+def ragged_paged_attention_tp(mesh, axis, q, k_pages, v_pages, page_table,
+                              kv_lens, row_seq, qpos, *, k_scale=None,
+                              v_scale=None, sm_scale: Optional[float] = None,
+                              use_kernel: Optional[bool] = None,
+                              interpret: Optional[bool] = None):
+    """Tensor-parallel ragged attention: the pallas kernel wrapped in a
+    ``shard_map`` over the ``axis`` (``model``) mesh dim.
+
+    Heads are embarrassingly parallel in attention, so each chip runs
+    the UNCHANGED kernel on its local slice — q ``[T, H/TP, D]`` against
+    its ``[P, page, H_kv/TP, D]`` pool shard (scales ride along) — and
+    no collective crosses the region: the psum lives downstream in the
+    row-parallel output projection, exactly the megatron pattern.  A
+    bare ``pallas_call`` under GSPMD would instead force the sharded
+    operands replicated (XLA cannot partition a custom kernel), which
+    is why the TP engine routes its kernel path through here.  The GQA
+    group factor is shard-invariant (``(H/TP) / (H_kv/TP) == H/H_kv``),
+    so head-group packing is untouched.
+
+    Dispatch routes through :func:`attention_path` like every other
+    entry point (the per-SHARD head counts decide): shapes the chooser
+    rejects — odd head dims, tiny pages — fall back to the plain
+    reference path, which needs no ``shard_map`` because GSPMD
+    partitions its gathers/einsums over the head dim natively."""
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.parallel.compat import no_rep_check_kw, shard_map
+
+    if sm_scale is None:
+        sm_scale = float(q.shape[-1]) ** -0.5
+    if interpret is None:
+        interpret = _interpret_default()
+    tp = int(mesh.shape[axis])
+    path = attention_path(q.shape[-1], k_pages.shape[1],
+                          num_heads=q.shape[1] // tp,
+                          num_kv_heads=k_pages.shape[2] // tp,
+                          quantized=k_scale is not None,
+                          use_kernel=use_kernel, interpret=interpret)
+    if path != "kernel" or q.shape[0] % BLOCK_ROWS != 0:
+        return _ragged_reference_blocked(
+            q, k_pages, v_pages, page_table, kv_lens, row_seq, qpos,
+            k_scale=k_scale, v_scale=v_scale, sm_scale=sm_scale)
+    head = P(None, axis, None)
+    pool = P(None, None, axis, None)
+    scale = P(None, None, axis)
+    repl = P()
+    in_specs = [head, pool, pool, repl, repl, repl, repl]
+    if k_scale is not None:
+        in_specs += [scale, scale]
+
+    def local(qs, ks, vs, pt, ln, rs, qp, *scales):
+        kss, vss = scales if scales else (None, None)
+        return _ragged_pallas(qs, ks, vs, kss, vss, pt, ln, rs, qp,
+                              float(sm_scale), bool(interpret))
+
+    fn = shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
+                   out_specs=head, **no_rep_check_kw())
+    args = [q, k_pages, v_pages, page_table.astype(jnp.int32),
+            kv_lens.astype(jnp.int32), row_seq.astype(jnp.int32),
+            qpos.astype(jnp.int32)]
+    if k_scale is not None:
+        args += [k_scale, v_scale]
+    return fn(*args)
+
+
 _REF_ROW_BLOCK = 64   # fallback row-block: bounds the per-row K/V gather
 
 

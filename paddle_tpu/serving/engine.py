@@ -56,6 +56,21 @@ chunk per tick — since round 12 riding the SAME unified dispatch as the
 decode rows rather than a second one — so a long prompt in the queue
 no longer degrades running slots' latency.
 
+Tensor-parallel serving (round 13): ``ServingEngine(mesh=, tp_axis=)``
+places the model megatron-style over a ``model`` mesh axis — attention
+heads (and GQA KV heads) + FFN columns column-parallel, the output/FFN-
+down projections row-parallel with ONE psum each per layer — using the
+model's ``shard_plan()`` as the single placement source of truth, and
+the paged pool shards its KV-head dim the same way
+(``[L, pages, page, H_kv/TP, D]``, int8 scales riding along), so every
+pool byte number becomes per-chip and the same budget admits tp x the
+pages.  The unified step, chunk prefill, ``fork_page``/``zero_pages``
+and the decode kernel (via ``shard_map``) all run on the sharded
+layout; the flipped :class:`SiteContract`s carry the closed-form psum
+budget so ``python -m paddle_tpu.analysis sharding`` proves the decode
+hot path stays reduce-not-gather.  ``mesh=None`` keeps the exact
+replicated engine (and the exact PR 10 ``P()``/comm=0 contracts).
+
 The model plugs in through the small :class:`DecodeModel` contract
 rather than a ``Topology``: serving needs per-layer access to Q/K/V
 *before* attention runs (the cache sits between them), which the opaque
@@ -82,14 +97,14 @@ from paddle_tpu.ops.attention import mha_reference
 from paddle_tpu.platform.flags import FLAGS
 from paddle_tpu.serving.decode_attention import (
     BLOCK_ROWS, _ragged_reference_blocked, attention_path,
-    expand_decode_rows, ragged_paged_attention)
+    expand_decode_rows, ragged_paged_attention, ragged_paged_attention_tp)
 from paddle_tpu.serving.faults import (FaultPlan, InjectedDeviceError,
                                        PageLeakError)
 from paddle_tpu.serving.kv_cache import (NULL_PAGE, KVPages, PagedKVConfig,
                                          PagePool, PrefixCache, append_token,
                                          fork_page, init_kv_pages,
-                                         pages_for_budget, resolve_kv_dtype,
-                                         zero_pages)
+                                         kv_pool_specs, pages_for_budget,
+                                         resolve_kv_dtype, zero_pages)
 from paddle_tpu.serving.metrics import ServingMetrics
 from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
                                           Request, RequestStatus,
@@ -97,7 +112,7 @@ from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
                                           pack_prefill_chunks)
 
 __all__ = ["DecodeModel", "DecoderLM", "ServingEngine",
-           "greedy_decode_reference"]
+           "greedy_decode_reference", "validate_tp"]
 
 
 class DecodeModel:
@@ -119,6 +134,19 @@ class DecodeModel:
       (projection, residual, FFN — whatever the architecture does after
       attention)
     - ``logits(params, x) -> [..., vocab_size]``
+
+    Tensor-parallel serving (``ServingEngine(mesh=...)``) additionally
+    needs:
+
+    - ``shard_plan(axis="model", tp=None) -> {param name: per-dim
+      PartitionSpec tuple}`` — the megatron placement (attention heads +
+      FFN columns over ``axis``, row-parallel down projections); and
+    - ``bind_tp(mesh, axis) -> model`` (optional) — return a TP-bound
+      VIEW of the model whose forward asserts the plan's activation
+      shardings (sharding constraints after each projection) so the
+      row-parallel blocks lower to exactly one psum each.  Must NOT
+      mutate ``self``: the same model object may back a replicated
+      engine in the same process (the A/B benches do exactly that).
     """
 
     num_layers: int
@@ -126,6 +154,50 @@ class DecodeModel:
     head_dim: int
     vocab_size: int
     num_kv_heads: int  # optional on duck-typed models (= num_heads)
+
+
+def validate_tp(model: "DecodeModel", tp: int, axis: str = "model") -> None:
+    """Fail fast — with a fix in the message — on a model whose
+    geometry cannot split ``tp`` ways over ``axis``: attention sharding
+    moves whole query/KV heads per chip and FFN sharding whole columns,
+    so every one of those counts must divide.  Checked at BOTH
+    ``ServingEngine(mesh=...)`` construction and ``shard_plan()``, so a
+    bad plan can't reach placement from either direction."""
+    from paddle_tpu.platform.enforce import enforce_that
+
+    tp = int(tp)
+    enforce_that(tp >= 1, f"tensor-parallel degree must be >= 1, got {tp}",
+                 context="serving-tp")
+    if tp == 1:
+        return
+    h = int(model.num_heads)
+    kvh = int(getattr(model, "num_kv_heads", 0) or h)
+    enforce_that(
+        h % tp == 0,
+        f"num_heads ({h}) is not divisible by the {axis!r} mesh axis "
+        f"size ({tp}): tensor parallelism places whole attention heads "
+        f"per chip — pick a tp that divides {h}, or resize the model",
+        context="serving-tp")
+    enforce_that(
+        tp <= kvh,
+        f"GQA corner: tp={tp} exceeds num_kv_heads ({kvh}) — a KV head "
+        "cannot split below one per chip and this engine does not "
+        f"replicate KV heads across the {axis!r} axis; lower tp to at "
+        f"most {kvh}, or serve a model with more KV heads",
+        context="serving-tp")
+    enforce_that(
+        kvh % tp == 0,
+        f"num_kv_heads ({kvh}) is not divisible by the {axis!r} mesh "
+        f"axis size ({tp}): the paged KV pool shards whole KV heads per "
+        f"chip — pick a tp that divides {kvh}", context="serving-tp")
+    ffn = int(getattr(model, "ffn_dim", 0) or 0)
+    if ffn:
+        enforce_that(
+            ffn % tp == 0,
+            f"FFN width ({ffn}) is not divisible by the {axis!r} mesh "
+            f"axis size ({tp}): the column-parallel up projection places "
+            f"whole FFN columns per chip — pick a tp that divides {ffn}",
+            context="serving-tp")
 
 
 def _rms(x, eps: float = 1e-6):
@@ -155,6 +227,77 @@ class DecoderLM(DecodeModel):
         self.kv_dim = self.num_kv_heads * head_dim
         self.ffn_dim = ffn_mult * self.embed_dim
         self.max_positions = max_positions
+        # tensor-parallel binding (None = unbound; see bind_tp)
+        self._tp_mesh = None
+        self._tp_axis = None
+
+    # ---- tensor-parallel placement (the megatron plan) -------------------
+
+    def shard_plan(self, axis: str = "model",
+                   tp: Optional[int] = None) -> Dict[str, Tuple]:
+        """Megatron-style tensor-parallel placement over ``axis``:
+        Q/K/V and FFN-up projections are COLUMN-parallel (output
+        features — i.e. heads / FFN columns — sharded, no collective on
+        the forward matmul); the attention-output and FFN-down
+        projections are ROW-parallel (input features sharded, the
+        contraction emits ONE psum per block); embeddings, positions
+        and the vocab head stay replicated.  Returns ``{param name:
+        per-dim PartitionSpec tuple}`` — the single source of truth the
+        engine turns into ``NamedSharding``s, the ZeRO composition
+        turns into explicit ``ParamAttr.sharding``s, and the serving
+        :class:`~paddle_tpu.analysis.retrace.SiteContract` declares to
+        the sharding auditor.  ``tp`` (when given) validates
+        divisibility up front with actionable errors."""
+        if tp is not None:
+            validate_tp(self, tp, axis)
+        plan: Dict[str, Tuple] = {"emb": (), "pos": (), "out": ()}
+        for l in range(self.num_layers):
+            plan[f"l{l}.wq"] = (None, axis)
+            plan[f"l{l}.wk"] = (None, axis)
+            plan[f"l{l}.wv"] = (None, axis)
+            plan[f"l{l}.wo"] = (axis, None)
+            plan[f"l{l}.w1"] = (None, axis)
+            plan[f"l{l}.w2"] = (axis, None)
+        return plan
+
+    def bind_tp(self, mesh, axis: str = "model") -> "DecoderLM":
+        """Return a TP-bound VIEW of this model: same config, but the
+        forward asserts the plan's activation placements with sharding
+        constraints — heads sharded after Q/K/V, FFN columns sharded
+        after the up projection, and an explicit replicated constraint
+        after each ROW-parallel matmul, which is the megatron ``g``:
+        GSPMD lowers it to exactly one psum per block instead of
+        deferring partial sums into the nonlinearities.  ``self`` is
+        NOT mutated — the unbound original can keep backing a
+        replicated engine in the same process."""
+        import copy
+
+        m = copy.copy(self)
+        m._tp_mesh, m._tp_axis = mesh, axis
+        return m
+
+    def _tp_sharded(self, x, dim_from_last: int):
+        """Constrain ``x`` sharded over the TP axis on the dim
+        ``dim_from_last`` positions from the end (no-op unbound)."""
+        if self._tp_mesh is None:
+            return x
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        dims = [None] * x.ndim
+        dims[x.ndim - 1 - dim_from_last] = self._tp_axis
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self._tp_mesh, P(*dims)))
+
+    def _tp_psum(self, x):
+        """The megatron ``g`` after a row-parallel matmul: constrain
+        the partial-sum output replicated, forcing the one psum per
+        block (no-op unbound)."""
+        if self._tp_mesh is None:
+            return x
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self._tp_mesh, P()))
 
     def init_params(self, key) -> Dict[str, jax.Array]:
         e, f, v = self.embed_dim, self.ffn_dim, self.vocab_size
@@ -186,13 +329,19 @@ class DecoderLM(DecodeModel):
         q = (xn @ params[f"l{layer}.wq"]).reshape(x.shape[:-1] + (h, d))
         k = (xn @ params[f"l{layer}.wk"]).reshape(x.shape[:-1] + (kvh, d))
         v = (xn @ params[f"l{layer}.wv"]).reshape(x.shape[:-1] + (kvh, d))
-        return q, k, v
+        # TP: heads live sharded over the model axis (no-ops unbound)
+        return (self._tp_sharded(q, 1), self._tp_sharded(k, 1),
+                self._tp_sharded(v, 1))
 
     def attn_out(self, params, layer, ctx, x):
         flat = ctx.reshape(x.shape[:-1] + (self.embed_dim,))
-        a = x + flat @ params[f"l{layer}.wo"]
-        return a + jax.nn.gelu(_rms(a) @ params[f"l{layer}.w1"]) \
-            @ params[f"l{layer}.w2"]
+        # row-parallel output projection: contraction over the sharded
+        # feature dim -> partial sums -> ONE psum (the _tp_psum
+        # constraint), then the replicated residual add
+        a = x + self._tp_psum(flat @ params[f"l{layer}.wo"])
+        up = self._tp_sharded(_rms(a) @ params[f"l{layer}.w1"], 0)
+        # row-parallel FFN-down projection: the block's second psum
+        return a + self._tp_psum(jax.nn.gelu(up) @ params[f"l{layer}.w2"])
 
     def logits(self, params, x):
         return _rms(x) @ params["out"]
@@ -253,11 +402,12 @@ class ServingEngine:
                  faults: Optional[FaultPlan] = None,
                  time_fn: Optional[Callable[[], float]] = None,
                  tracer=None, registry: Optional[MetricsRegistry] = None,
+                 mesh=None, tp_axis: str = "model",
                  xla_peak_bytes: Optional[int] = None,
                  xla_flops: Optional[float] = None,
                  xla_comm_bytes: Optional[float] = None):
-        self.model = model
-        self.params = params
+        from paddle_tpu.platform.enforce import enforce_that
+
         self.eos_id = int(eos_id)
         page_size = int(page_size or FLAGS.serving_page_size)
         max_slots = int(max_slots or FLAGS.serving_max_slots)
@@ -268,15 +418,61 @@ class ServingEngine:
         kv_dtype = resolve_kv_dtype(kv_dtype)
         num_kv_heads = int(getattr(model, "num_kv_heads", 0)
                            or model.num_heads)
+        # tensor-parallel placement (ROADMAP item 1): with a mesh, the
+        # megatron shard_plan places attention heads + FFN columns over
+        # the `model` axis, the paged pool shards its KV-head dim the
+        # same way, and every byte/contract below becomes per-chip.
+        self.mesh = mesh
+        self.tp_axis = str(tp_axis)
+        self.tp = 1
+        self._shard_plan: Optional[Dict[str, Tuple]] = None
+        self.param_sharding = None
+        if mesh is not None:
+            enforce_that(
+                self.tp_axis in mesh.axis_names,
+                f"mesh has no {self.tp_axis!r} axis (axes: "
+                f"{tuple(mesh.axis_names)}) — build one with "
+                "make_mesh((tp,), ('model',))", context="serving-tp")
+            self.tp = int(mesh.shape[self.tp_axis])
+            validate_tp(model, self.tp, self.tp_axis)
+            enforce_that(
+                hasattr(model, "shard_plan"),
+                "ServingEngine(mesh=...) needs the model to expose "
+                "shard_plan(axis, tp) (see the DecodeModel contract); "
+                f"{type(model).__name__} does not", context="serving-tp")
+            enforce_that(
+                isinstance(params, dict),
+                "tensor-parallel placement needs a flat {name: array} "
+                "param dict (the shard_plan key space)",
+                context="serving-tp")
+            self._shard_plan = {k: tuple(v) for k, v in
+                                model.shard_plan(axis=self.tp_axis,
+                                                 tp=self.tp).items()}
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self.param_sharding = {
+                name: NamedSharding(mesh,
+                                    P(*self._shard_plan.get(name, ())))
+                for name in params}
+            params = {name: jax.device_put(v, self.param_sharding[name])
+                      for name, v in params.items()}
+            if hasattr(model, "bind_tp"):
+                # a TP-bound VIEW (bind_tp must not mutate): the bound
+                # forward asserts the activation shardings, so each
+                # row-parallel block lowers to exactly one psum
+                model = model.bind_tp(mesh, self.tp_axis)
+        self.model = model
+        self.params = params
         if num_pages is None and pool_bytes is not None:
-            # size the pool by BYTES: smaller KV dtypes admit
-            # proportionally more pages, which the scheduler charges
-            # against — int8's doubled-and-more page budget is exactly
-            # this arithmetic
+            # size the pool by BYTES — PER CHIP: smaller KV dtypes admit
+            # proportionally more pages, and tensor parallelism tp x
+            # more again (each chip stores 1/tp of every page's KV
+            # heads).  The scheduler charges admission in pages, so both
+            # multipliers flow straight into admissible concurrency.
             num_pages = pages_for_budget(
                 pool_bytes, model.num_layers, model.num_heads,
                 model.head_dim, page_size, kv_dtype,
-                num_kv_heads=num_kv_heads)
+                num_kv_heads=num_kv_heads, tp=self.tp)
         num_pages = int(num_pages or FLAGS.serving_max_pages)
         if max_pages_per_seq is None:
             # default: one sequence may claim up to half the usable pool
@@ -310,8 +506,9 @@ class ServingEngine:
             num_layers=model.num_layers, num_heads=model.num_heads,
             head_dim=model.head_dim, page_size=page_size,
             num_pages=num_pages, max_pages_per_seq=int(max_pages_per_seq),
-            dtype=kv_dtype, num_kv_heads=num_kv_heads)
-        self._kv: KVPages = init_kv_pages(self.kv_cfg)
+            dtype=kv_dtype, num_kv_heads=num_kv_heads, tp=self.tp)
+        self._kv: KVPages = init_kv_pages(self.kv_cfg, mesh=self.mesh,
+                                          axis=self.tp_axis)
         self.pool = PagePool(num_pages)
         if prefix_cache is None:
             prefix_cache = bool(FLAGS.serving_prefix_cache)
@@ -395,25 +592,50 @@ class ServingEngine:
                 param_bytes += n * jnp.dtype(leaf.dtype).itemsize
         rows = max_slots + self._prefill_budget
         e = model.num_heads * model.head_dim
-        kv_bytes = self.kv_cfg.kv_bytes()
+        # peak budgets reason about LOGICAL (global) avals — the xla
+        # auditor's live-set estimator sums full aval bytes and cannot
+        # see GSPMD's per-chip split — so scale the per-chip pool bytes
+        # back up by tp (healthz keeps reporting the per-chip number)
+        kv_bytes = self.kv_cfg.kv_bytes() * self.tp
         act_bytes = 4 * rows * (8 * e * model.num_layers
                                 + model.vocab_size)
         kv_name = jnp.dtype(self.kv_cfg.dtype).name
         allow_upcast = (kv_name,) if kv_name != "float32" else ()
         if FLAGS.attn_pv_f32:
             allow_upcast += ("bfloat16",)
-        # sharding baseline (checked by `python -m paddle_tpu.analysis
-        # sharding`): the engine is single-mesh/single-replica TODAY, so
-        # the contract pins every argument and output REPLICATED with a
-        # zero collective-byte budget per tick — derived from pool+model
-        # the same way xla_peak_bytes is (a replicated plan moves 0
-        # bytes over links; any inferred collective busts the budget).
-        # This is the explicit baseline the tensor-parallel serving PR
-        # flips to a `model`-axis spec + a derived all-gather/psum
-        # budget; callers experimenting early override via
-        # ServingEngine(xla_comm_bytes=).
+        # sharding contract (checked by `python -m paddle_tpu.analysis
+        # sharding`).  Replicated engine (mesh=None): every argument and
+        # output pins P() with a zero collective-byte budget per tick —
+        # a replicated plan moves 0 bytes over links, so any inferred
+        # collective busts the budget.  Tensor-parallel engine: params
+        # carry the shard_plan per leaf, the KV pool (args AND outputs)
+        # shards its head dim over the model axis, and the budget is the
+        # CLOSED-FORM megatron cost — two row-parallel psums per layer,
+        # 2*b*(N-1)/N each over the [rows, E] f32 activation — so the
+        # gate proves the decode hot path stays reduce-not-gather: one
+        # implicit all-gather anywhere and the audited estimate leaves
+        # the closed form.  Override via ServingEngine(xla_comm_bytes=).
         comm_budget = xla_comm_bytes if xla_comm_bytes is not None \
-            else 0.0
+            else self.tp_step_comm_bytes(rows)
+        kv_comm = xla_comm_bytes if xla_comm_bytes is not None else 0.0
+        if self.mesh is None:
+            step_in: Tuple = ((),)
+            step_out: Tuple = ((),)
+            kv_in: Tuple = ((),)
+            kv_out: Tuple = ((),)
+            mesh_axes: Tuple = ()
+            expect = ()
+        else:
+            kvspec = kv_pool_specs(self.tp_axis)
+            # per-leaf param specs (keyed by name: the auditor resolves
+            # dict entries against the pytree path) + the pool spec for
+            # both the donated input and the aliased output
+            step_in = (dict(self._shard_plan), kvspec) + ((),) * 9
+            step_out = ((), ()) + (kvspec,) * 4
+            kv_in = (kvspec, (), ())
+            kv_out = (kvspec,) * 4
+            mesh_axes = ((self.tp_axis, self.tp),)
+            expect = (0, 1)      # params and pool must arrive sharded
         self._step_contract = SiteContract(
             per_tick=True, donate=(1,), allow_upcast=allow_upcast,
             peak_bytes=xla_peak_bytes if xla_peak_bytes is not None else
@@ -421,11 +643,13 @@ class ServingEngine:
             flops=xla_flops if xla_flops is not None else
             64.0 * rows * (param_count
                            + self.kv_cfg.max_seq_len * e) + 1e9,
-            in_specs=((),), out_specs=((),), comm_bytes=comm_budget)
+            in_specs=step_in, out_specs=step_out, mesh_axes=mesh_axes,
+            comm_bytes=comm_budget, expect_sharded=expect)
         kv_contract = SiteContract(
             per_tick=True, donate=(0,),
             peak_bytes=2 * kv_bytes + (1 << 24),
-            in_specs=((),), out_specs=((),), comm_bytes=comm_budget)
+            in_specs=kv_in, out_specs=kv_out, mesh_axes=mesh_axes,
+            comm_bytes=kv_comm)
         # audit_jit == jax.jit unless FLAGS.jit_audit is on, in which
         # case each named site's compiles are counted by the retrace
         # auditor (paddle_tpu.analysis.retrace): the unified step must
@@ -496,6 +720,59 @@ class ServingEngine:
 
     # ---- compiled device functions --------------------------------------
 
+    def tp_step_comm_bytes(self, rows: int) -> float:
+        """Closed-form per-call collective budget for ``serving.step``
+        under ``tp``-way tensor parallelism: each of the model's layers
+        pays exactly TWO row-parallel psums (attention-output and
+        FFN-down projections — the megatron pattern), each moving
+        ``2 * b * (N-1)/N`` bytes over the ``model`` links for the
+        ``[rows, E]`` f32 activation of ``b = 4 * rows * E`` bytes.
+        Attention itself is head-local and the paged pool ops are
+        batching-dim scatters, so NOTHING else may touch the links —
+        the sharding gate checks the audited estimate against exactly
+        this number, which is how "the decode step stays
+        reduce-not-gather" becomes a CI property."""
+        if self.tp <= 1:
+            return 0.0
+        # the residual-stream width: duck-typed models may carry an
+        # embed_dim decoupled from num_heads * head_dim
+        e = int(getattr(self.model, "embed_dim", 0)
+                or self.model.num_heads * self.model.head_dim)
+        psum = 2.0 * (4.0 * rows * e) * (self.tp - 1) / self.tp
+        return float(self.model.num_layers * 2 * psum)
+
+    def _tp_kv(self, kv: KVPages) -> KVPages:
+        """Pin the returned pool to its canonical per-chip layout
+        (``[L, pages, page, H_kv/TP, D]``, THE ``kv_pool_sharding``
+        layout — same source of truth as placement and the contract) so
+        the donated-in/aliased-out pair stays shard-identical across
+        ticks (no-op replicated)."""
+        if self.mesh is None:
+            return kv
+        from paddle_tpu.serving.kv_cache import kv_pool_sharding
+
+        wsc = jax.lax.with_sharding_constraint
+        sh = kv_pool_sharding(self.mesh, self.tp_axis)
+        return KVPages(
+            wsc(kv.k, sh), wsc(kv.v, sh),
+            None if kv.k_scale is None else wsc(kv.k_scale, sh),
+            None if kv.v_scale is None else wsc(kv.v_scale, sh))
+
+    def _tp_ctx(self, ctx):
+        """Re-assert the head sharding on an attention output (no-op on
+        replicated engines).  The reference fallback's row-blocked
+        ``lax.map`` is a scan whose body GSPMD — and the static
+        propagation walk — cannot see through; without this constraint
+        the downstream row-parallel projection would consume an
+        unconstrained operand and the partitioner would be free to
+        all-gather instead of psum."""
+        if self.mesh is None:
+            return ctx
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.lax.with_sharding_constraint(
+            ctx, NamedSharding(self.mesh, P(None, self.tp_axis, None)))
+
     def _attend(self, kv: KVPages, layer: int, q, table, att_lens,
                 row_seq, qpos):
         """One ragged paged attention over the tick's mixed row stack.
@@ -504,15 +781,18 @@ class ServingEngine:
         block (the one-sequence-per-block packing contract) — prefill
         rows are already block-aligned by the packer — and slices the
         context back out.  The expansion touches [B, H, D]-sized data,
-        noise next to the attention itself."""
+        noise next to the attention itself.  Under TP the kernel rides
+        a ``shard_map`` over the model axis (heads are attention-local,
+        so each chip runs the unchanged kernel on its head shard) and
+        both paths re-assert the head sharding on the context."""
         ks = kv.k_scale[layer] if kv.k_scale is not None else None
         vs = kv.v_scale[layer] if kv.v_scale is not None else None
         if not self._ragged_kernel:
             # row-blocked fallback: identical math to the oracle, with
             # the per-row K/V gather bounded to one block of rows
-            return _ragged_reference_blocked(
+            return self._tp_ctx(_ragged_reference_blocked(
                 q, kv.k[layer], kv.v[layer], table, att_lens, row_seq,
-                qpos, k_scale=ks, v_scale=vs)
+                qpos, k_scale=ks, v_scale=vs))
         b, rb = self._max_slots, BLOCK_ROWS
         td = b * rb
         # decode rows expand through THE shared packing helper (one copy
@@ -522,10 +802,16 @@ class ServingEngine:
         qe = jnp.concatenate([qd, q[b:]])
         rs = jnp.concatenate([rsd, row_seq[b:]])
         qp = jnp.concatenate([qpd, qpos[b:]])
-        ctx = ragged_paged_attention(
-            qe, kv.k[layer], kv.v[layer], table, att_lens, rs, qp,
-            k_scale=ks, v_scale=vs, use_kernel=True)
-        return jnp.concatenate([ctx[:td:rb], ctx[td:]])
+        if self.mesh is not None and self.tp > 1:
+            ctx = ragged_paged_attention_tp(
+                self.mesh, self.tp_axis, qe, kv.k[layer], kv.v[layer],
+                table, att_lens, rs, qp, k_scale=ks, v_scale=vs,
+                use_kernel=True)
+        else:
+            ctx = ragged_paged_attention(
+                qe, kv.k[layer], kv.v[layer], table, att_lens, rs, qp,
+                k_scale=ks, v_scale=vs, use_kernel=True)
+        return self._tp_ctx(jnp.concatenate([ctx[:td:rb], ctx[td:]]))
 
     def _step_fn(self, pb: int):
         """The unified per-tick step for prefill bucket ``pb`` (0 =
@@ -581,7 +867,7 @@ class ServingEngine:
             # rows + each slot's chunk-final row (2B rows, not B + pb)
             sel = jnp.concatenate([arange_b, p_last])
             logits = model.logits(params, x[sel])
-            return logits[:b], logits[b:], kv
+            return logits[:b], logits[b:], self._tp_kv(kv)
 
         fn = audit_jit(raw, site="serving.step",
                        donate_argnums=self._donate_kv,
@@ -941,7 +1227,10 @@ class ServingEngine:
             # ServingEngine(pool_bytes=...))
             "pages_total": self.pool.num_usable,
             "kv_dtype": str(jnp.dtype(self.kv_cfg.dtype).name),
+            # per-CHIP pool bytes: under TP each chip holds 1/tp of
+            # every page's KV heads (scales sharded with them)
             "kv_bytes": self.kv_cfg.kv_bytes(),
+            "tp": self.tp,
             # `is not None`, not truthiness: PrefixCache defines __len__,
             # so an empty-but-active cache is falsy
             "cache_hits": self.cache.hits if self.cache is not None else 0,

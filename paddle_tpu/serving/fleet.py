@@ -231,6 +231,38 @@ class FleetRouter:
         # observable JOINING tick first
         self._promote_joining()
 
+    @classmethod
+    def over_mesh_slices(cls, make_engine, tp: int = 1,
+                         axis: str = "model", devices=None,
+                         num_replicas: Optional[int] = None, **kwargs
+                         ) -> "FleetRouter":
+        """Build a fleet whose replica unit is a MESH SLICE, not a chip:
+        the device set is partitioned into ``tp``-chip slices
+        (:func:`~paddle_tpu.parallel.mesh.mesh_slices`) and
+        ``make_engine(idx, time_fn, mesh)`` must return a
+        ``ServingEngine(mesh=mesh, ...)`` on its slice (``mesh`` is
+        None when ``tp == 1`` — plain replicated replicas).  Everything
+        else — prefix-affinity routing, leases, death fencing,
+        resubmission — is unchanged: a slice dies and rejoins as one
+        unit, which is exactly what a multi-chip model replica is.
+        ``num_replicas`` caps the slice count (default: every full
+        slice the devices afford)."""
+        if tp <= 1:
+            slices = None
+            n = num_replicas
+        else:
+            from paddle_tpu.parallel.mesh import mesh_slices
+
+            slices = mesh_slices(tp, axis=axis, devices=devices,
+                                 max_slices=num_replicas)
+            n = len(slices)
+
+        def mk(i: int, time_fn):
+            return make_engine(i, time_fn,
+                               slices[i] if slices is not None else None)
+
+        return cls(mk, n, **kwargs)
+
     # ---- replica lifecycle ------------------------------------------------
 
     def add_replica(self) -> int:

@@ -78,7 +78,14 @@ class PagedKVConfig:
     against one K/V load.  ``dtype=jnp.int8`` turns on quantized pages:
     every write stores amax/127-scaled int8 values plus a per-token,
     per-kv-head f32 scale (see :func:`quantize_kv`), read back by
-    dequantizing in-register — roughly quartering bytes per page."""
+    dequantizing in-register — roughly quartering bytes per page.
+
+    ``tp`` (default 1) is the tensor-parallel degree: the pool's KV-head
+    dim shards over the ``model`` mesh axis, so each chip physically
+    holds ``kv_heads / tp`` heads of every page — and every byte
+    accounting here (:meth:`bytes_per_page`, :meth:`kv_bytes`, hence
+    :func:`pages_for_budget`) is PER CHIP.  The int8 scale arrays shard
+    with their KV heads, so they divide by ``tp`` too."""
 
     num_layers: int
     num_heads: int
@@ -88,6 +95,7 @@ class PagedKVConfig:
     max_pages_per_seq: int   # page-table width (static decode grid bound)
     dtype: jnp.dtype = jnp.float32
     num_kv_heads: Optional[int] = None   # None = MHA (== num_heads)
+    tp: int = 1              # model-axis shards of the KV-head dim
 
     def __post_init__(self):
         enforce_that(self.num_pages >= 2,
@@ -99,6 +107,14 @@ class PagedKVConfig:
         enforce_that(self.num_heads % self.kv_heads == 0,
                      f"num_kv_heads ({self.kv_heads}) must divide "
                      f"num_heads ({self.num_heads})", context="serving")
+        enforce_that(self.tp >= 1, "tp must be >= 1", context="serving")
+        enforce_that(self.kv_heads % self.tp == 0,
+                     f"tensor parallelism tp={self.tp} must divide "
+                     f"num_kv_heads ({self.kv_heads}): the paged pool "
+                     "shards whole KV heads over the model axis, so each "
+                     "chip must own an integer number of them — pick a "
+                     f"tp that divides {self.kv_heads}, or a model with "
+                     "more KV heads", context="serving")
 
     @property
     def kv_heads(self) -> int:
@@ -121,33 +137,43 @@ class PagedKVConfig:
         return self.num_pages - 1  # page 0 is the null page
 
     def bytes_per_page(self) -> int:
-        """K + V bytes ONE page costs across all layers, scale arrays
-        included — the unit the pool-byte budget is charged in."""
-        per = (self.num_layers * self.page_size * self.kv_heads *
+        """K + V bytes ONE page costs PER CHIP across all layers, scale
+        arrays included — the unit the pool-byte budget is charged in.
+        Under tensor parallelism (``tp > 1``) each chip holds only its
+        ``kv_heads / tp`` shard of every page (scales ride with their
+        heads), so the same per-chip budget buys ``tp`` x the pages —
+        the per-chip capacity arithmetic the TP serving plan banks on."""
+        heads_per_chip = self.kv_heads // self.tp
+        per = (self.num_layers * self.page_size * heads_per_chip *
                self.head_dim * jnp.dtype(self.dtype).itemsize)
         if self.quantized:
             # per-token, per-kv-head f32 scales ride with the page
-            per += self.num_layers * self.page_size * self.kv_heads * 4
+            per += self.num_layers * self.page_size * heads_per_chip * 4
         return 2 * per
 
     def kv_bytes(self) -> int:
+        """Whole-pool bytes PER CHIP (the number HBM budgets care
+        about; multiply by ``tp`` for the global pool)."""
         return self.num_pages * self.bytes_per_page()
 
 
 def pages_for_budget(pool_bytes: int, num_layers: int, num_heads: int,
                      head_dim: int, page_size: int, dtype,
-                     num_kv_heads: Optional[int] = None) -> int:
-    """Total ``num_pages`` (null page included) that fit in a pool byte
-    budget — the knob that makes int8 pages *mean* something: the same
-    ``pool_bytes`` admits ~2x the pages of bf16 and ~4x of f32 (minus
-    the scale-array overhead).  The scheduler charges admission in
-    pages, so capacity gains flow straight into admissible concurrency
-    and prefix-cache headroom."""
+                     num_kv_heads: Optional[int] = None,
+                     tp: int = 1) -> int:
+    """Total ``num_pages`` (null page included) that fit in a PER-CHIP
+    pool byte budget — the knob that makes int8 pages *mean* something:
+    the same ``pool_bytes`` admits ~2x the pages of bf16 and ~4x of f32
+    (minus the scale-array overhead), and under ``tp``-way tensor
+    parallelism ``tp`` x the pages again (each chip stores 1/tp of every
+    page's KV heads, scale arrays sharded with them).  The scheduler
+    charges admission in pages, so capacity gains flow straight into
+    admissible concurrency and prefix-cache headroom."""
     probe = PagedKVConfig(num_layers=num_layers, num_heads=num_heads,
                           head_dim=head_dim, page_size=page_size,
                           num_pages=2, max_pages_per_seq=1,
                           dtype=resolve_kv_dtype(dtype),
-                          num_kv_heads=num_kv_heads)
+                          num_kv_heads=num_kv_heads, tp=int(tp))
     return max(2, int(pool_bytes) // probe.bytes_per_page())
 
 
@@ -169,15 +195,52 @@ class KVPages(NamedTuple):
         return self.k_scale is not None
 
 
-def init_kv_pages(cfg: PagedKVConfig) -> KVPages:
+def init_kv_pages(cfg: PagedKVConfig, mesh=None, axis: str = "model"
+                  ) -> KVPages:
+    """Allocate the pool.  With a ``mesh``, every leaf is placed with
+    its KV-head dim sharded over ``axis`` (see :func:`kv_pool_specs`)
+    so the ``[L, pages, page, H_kv/TP, D]`` per-chip layout exists from
+    tick zero — the scatters/gathers of the serving step keep it there
+    (batching-dim ops never move the head dim)."""
     shape = (cfg.num_layers, cfg.num_pages, cfg.page_size, cfg.kv_heads,
              cfg.head_dim)
     if cfg.quantized:
-        return KVPages(jnp.zeros(shape, jnp.int8),
-                       jnp.zeros(shape, jnp.int8),
-                       jnp.zeros(shape[:-1], jnp.float32),
-                       jnp.zeros(shape[:-1], jnp.float32))
-    return KVPages(jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+        kv = KVPages(jnp.zeros(shape, jnp.int8),
+                     jnp.zeros(shape, jnp.int8),
+                     jnp.zeros(shape[:-1], jnp.float32),
+                     jnp.zeros(shape[:-1], jnp.float32))
+    else:
+        kv = KVPages(jnp.zeros(shape, cfg.dtype),
+                     jnp.zeros(shape, cfg.dtype))
+    if mesh is None:
+        return kv
+    sh = kv_pool_sharding(mesh, axis)
+    return KVPages(
+        jax.device_put(kv.k, sh), jax.device_put(kv.v, sh),
+        None if kv.k_scale is None else jax.device_put(kv.k_scale, sh),
+        None if kv.v_scale is None else jax.device_put(kv.v_scale, sh))
+
+
+def kv_pool_specs(axis: str = "model") -> Tuple[Optional[str], ...]:
+    """THE canonical pool layout, as one leading-dims PartitionSpec
+    entry covering every :class:`KVPages` leaf: ``k``/``v`` are 5-d
+    with the KV-head dim at position 3 and the scale arrays 4-d with it
+    at position 3 too, so ``(None, None, None, axis)`` shards exactly
+    the head dim of each (trailing dims replicated).  Single source of
+    truth — the TP :class:`~paddle_tpu.analysis.retrace.SiteContract`s
+    declare it for the pool argument/outputs, :func:`init_kv_pages`
+    places with it, and the engine's per-tick output constraint
+    re-asserts it — so the donated-in/aliased-out layout cannot drift
+    between the three."""
+    return (None, None, None, axis)
+
+
+def kv_pool_sharding(mesh, axis: str = "model"):
+    """:func:`kv_pool_specs` as a ``NamedSharding`` (one object serves
+    every pool leaf: unspecified trailing dims are replicated)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(*kv_pool_specs(axis)))
 
 
 def quantize_kv(x: jax.Array):
