@@ -1557,6 +1557,81 @@ def worker_moe():
     print(json.dumps(out), flush=True)
 
 
+def worker_train_chaos():
+    """Fault-tolerant training runtime under seeded chaos (ISSUE 14,
+    cpu pass): the shared ``resilience.chaos.seeded_chaos`` replay —
+    kill-at-step deaths, a kill between blob write and meta commit,
+    injected NaN gradients (skipped in-graph by the bad-step guard),
+    a slow-step window on the injected clock, step-granular ASYNC
+    checkpoints — restarted by the resume supervisor and pinned
+    bit-identical (final params + optimizer slots + per-step loss
+    trajectory) against an uninterrupted control running the same
+    poison schedule.  Also measures the async-save win directly: the
+    train-loop stall (snapshot + pipeline waits) vs a fully synchronous
+    save of the same state, plus the guarded step's overhead vs the
+    unguarded step."""
+    import shutil
+    import tempfile
+    import time as _t
+
+    _init_paddle()
+    import paddle_tpu as paddle
+    from paddle_tpu import checkpoint as ckpt
+    from paddle_tpu.resilience.chaos import (_build_trainer, _dataset,
+                                             seeded_chaos)
+    from paddle_tpu.resilience.guard import BadStepGuard
+
+    root = tempfile.mkdtemp(prefix="bench_train_chaos_")
+    try:
+        out = seeded_chaos(root + "/chaos")
+        problems = out.pop("problems")
+        out["train_chaos_ok"] = int(not problems)
+        if problems:
+            out["train_chaos_problems"] = problems[:4]
+        print(json.dumps(out), flush=True)  # headline before diagnostics
+
+        # async-save win: stall the loop actually paid vs the same
+        # checkpoint written synchronously
+        sgd = _build_trainer(BadStepGuard())
+        data = _dataset(0, 64)
+        sgd.train(paddle.batch(lambda: iter(data), 8), num_passes=1)
+        t0 = _t.perf_counter()
+        ckpt.save_checkpoint(root + "/sync", 0, sgd.parameters,
+                             opt_state=sgd.opt_state,
+                             model_state=sgd.model_state)
+        sync_s = _t.perf_counter() - t0
+        t0 = _t.perf_counter()
+        host = ckpt.snapshot_checkpoint(sgd.parameters,
+                                        opt_state=sgd.opt_state,
+                                        model_state=sgd.model_state)
+        snap_s = _t.perf_counter() - t0
+        del host
+        out["train_ckpt_sync_save_ms"] = round(sync_s * 1000, 3)
+        out["train_ckpt_snapshot_stall_ms"] = round(snap_s * 1000, 3)
+        out["train_ckpt_async_stall_fraction"] = round(
+            snap_s / max(sync_s, 1e-9), 3)
+        print(json.dumps(out), flush=True)
+
+        # guard overhead: guarded vs unguarded step time on one model
+        def time_train(guard):
+            s = _build_trainer(guard)
+            r = paddle.batch(lambda: iter(data), 8)
+            s.train(r, num_passes=1)          # compile + warm
+            t0 = _t.perf_counter()
+            for _ in range(3):
+                s.train(r, num_passes=1)
+            return (_t.perf_counter() - t0) / (3 * 8)
+
+        guarded = time_train(BadStepGuard())
+        plain = time_train(None)
+        out["train_guard_step_overhead"] = round(
+            guarded / max(plain, 1e-9), 3)
+        out["train_guard_step_us"] = round(guarded * 1e6, 1)
+        print(json.dumps(out), flush=True)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def worker_probe():
     """Fast TPU liveness check: init + one tiny matmul."""
     import jax
@@ -1636,6 +1711,7 @@ WORKERS = {
     "serving_mixed": worker_serving_mixed,
     "serving_tp": worker_serving_tp,
     "serving_fleet": worker_serving_fleet,
+    "train_chaos": worker_train_chaos,
     "moe": worker_moe,
 }
 
@@ -1722,7 +1798,7 @@ def main():
     # cheap + hardware-independent first: never starved by a dead tunnel
     for cpu_worker in ("scaling", "zero1", "serving", "serving_chaos",
                        "serving_prefix", "serving_mixed", "serving_tp",
-                       "serving_fleet"):
+                       "serving_fleet", "train_chaos"):
         out, err = _run_worker(cpu_worker, deadline, cpu=True,
                                attempt_timeout=380, max_attempts=1)
         if out:

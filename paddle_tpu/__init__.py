@@ -34,6 +34,7 @@ from paddle_tpu import minibatch
 from paddle_tpu import parallel
 from paddle_tpu import sequence
 from paddle_tpu import serving
+from paddle_tpu import resilience
 
 from paddle_tpu.minibatch import batch
 from paddle_tpu.parameters import Parameters
@@ -66,6 +67,7 @@ __all__ = [
     "pooling",
     "sequence",
     "serving",
+    "resilience",
     "Parameters",
     "DataFeeder",
     "SequenceBatch",

@@ -676,12 +676,17 @@ def drive_serving_steady_state(kv_dtype: str = "int8", seal: bool = True):
 def drive_trainer_step(batches: int = 2, batch_size: int = 16):
     """One tiny fc-classifier training pass (the ``trainer.train_step``
     site, donation contract (0, 1, 2)) plus one test pass (the
-    ``trainer.test_step`` site).  Requires ``FLAGS.jit_audit`` on
-    before the call.  Returns the SGD trainer."""
+    ``trainer.test_step`` site).  The trainer runs GUARDED
+    (resilience.BadStepGuard, skip policy) so the audited jaxpr is the
+    production fault-tolerant step: the fused bad-step reduction and the
+    skip selects must stay inside the ONE compiled program — no host
+    callback, no extra compile, no donation regression.  Requires
+    ``FLAGS.jit_audit`` on before the call.  Returns the SGD trainer."""
     import numpy as np
 
     import paddle_tpu as paddle
     from paddle_tpu import layer, optimizer, trainer as trainer_mod
+    from paddle_tpu.resilience.guard import BadStepGuard
 
     x = layer.data(name="x", type=paddle.data_type.dense_vector(8))
     y = layer.data(name="y", type=paddle.data_type.integer_value(3))
@@ -692,7 +697,8 @@ def drive_trainer_step(batches: int = 2, batch_size: int = 16):
         paddle.topology.Topology([cost]), seed=0)
     sgd = trainer_mod.SGD(cost=cost, parameters=params,
                           update_equation=optimizer.Momentum(
-                              momentum=0.9, learning_rate=0.05))
+                              momentum=0.9, learning_rate=0.05),
+                          guard=BadStepGuard(policy="skip"))
     rng = np.random.RandomState(0)
     data = [(rng.randn(8).astype(np.float32) * 0.1, int(rng.randint(0, 3)))
             for _ in range(batches * batch_size)]

@@ -304,6 +304,13 @@ class MasterClient:
     def members(self) -> List[int]:
         return self._t.call("members")
 
+    def progress(self) -> dict:
+        """Queue position of the current pass ({pass_no, todo, pending,
+        done}) — the task-queue component of the step-granular
+        checkpoint cursor, and what the resilience CLI reports while a
+        supervised run recovers."""
+        return self._t.call("progress")
+
     # -- pass control --------------------------------------------------------
 
     def begin_pass(self) -> None:

@@ -395,30 +395,82 @@ class Service:
         }
 
     def _snapshot(self) -> None:
+        """Persist the queue state atomically (etcd_client.go:96-129).
+
+        tmp + fsync + rename: the tempfile gets a UNIQUE name (a fixed
+        ``.tmp`` suffix would let two masters pointed at one path — or a
+        snapshot racing a crash-restart's first write — clobber each
+        other mid-write) and is fsynced before the rename, so a kill at
+        ANY point leaves either the previous complete snapshot or the
+        new complete one, never a truncated file.  A kill between write
+        and rename only leaks a stray tempfile."""
         if not self.snapshot_path:
             return
-        tmp = self.snapshot_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self._state(), f)
-        os.replace(tmp, self.snapshot_path)
+        import tempfile
+
+        d = os.path.dirname(os.path.abspath(self.snapshot_path)) or "."
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(self._state(), f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.snapshot_path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
 
     def _recover(self, path: str) -> None:
-        with open(path) as f:
-            st = json.load(f)
+        """Rebuild the queue from a snapshot; a corrupt/torn snapshot
+        (pre-hardening truncation, disk damage) starts CLEAN instead of
+        crashing — the dataset re-partitions on the next set_dataset,
+        exactly like a first boot, and a grep-able line records that
+        recovery discarded state."""
+        try:
+            with open(path) as f:
+                st = json.load(f)
 
-        def mk(d):
-            return Task(id=d["id"], epoch=d["epoch"],
-                        num_failures=d["num_failures"],
-                        chunks=[Chunk(**c) for c in d["chunks"]])
+            def mk(d):
+                return Task(id=d["id"], epoch=d["epoch"],
+                            num_failures=d["num_failures"],
+                            chunks=[Chunk(**c) for c in d["chunks"]])
 
-        # pending tasks at crash time go back to todo (the Go master does
-        # the same on snapshot recovery: leases died with the process)
-        self._todo = [mk(d) for d in st["todo"]] + [mk(d) for d in st["pending"]]
-        self._done = [mk(d) for d in st["done"]]
-        self._dataset_set = st["dataset_set"]
-        self._dataset_paths = st.get("dataset_paths", [])
-        self._next_id = st["next_id"]
-        self._pass_no = st["pass_no"]
+            # pending tasks at crash time go back to todo (the Go master
+            # does the same on snapshot recovery: leases died with the
+            # process)
+            todo = [mk(d) for d in st["todo"]] \
+                + [mk(d) for d in st["pending"]]
+            done = [mk(d) for d in st["done"]]
+            dataset_set = bool(st["dataset_set"])
+            dataset_paths = st.get("dataset_paths", [])
+            next_id = int(st["next_id"])
+            pass_no = int(st["pass_no"])
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            print(f"MASTER-SNAPSHOT-CORRUPT: {path} ({type(e).__name__}: "
+                  f"{e}) — rebuilding the task queue from a clean state",
+                  flush=True)
+            return
+        self._todo = todo
+        self._done = done
+        self._dataset_set = dataset_set
+        self._dataset_paths = dataset_paths
+        self._next_id = next_id
+        self._pass_no = pass_no
+
+    # ---- progress (the step-cursor's task-queue position) ------------------
+
+    def progress(self) -> dict:
+        """Queue position snapshot: how far the current pass has
+        advanced.  The trainer's step-granular checkpoint cursor records
+        this next to (pass, step, rng) so a resume report can show WHERE
+        in the dataset the run died, and the resilience CLI surfaces it."""
+        with self._lock:
+            self._check_timeouts()
+            return {"pass_no": self._pass_no,
+                    "todo": len(self._todo),
+                    "pending": len(self._pending),
+                    "done": len(self._done)}
 
 
 def dispatch(svc: "Service", method, params):
@@ -455,6 +507,8 @@ def dispatch(svc: "Service", method, params):
                              params.get("ttl_s"))
     if method == "members":
         return svc.members()
+    if method == "progress":
+        return svc.progress()
     if method == "ping":
         return "pong"
     raise ValueError(f"unknown method {method!r}")

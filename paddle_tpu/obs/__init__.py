@@ -22,7 +22,7 @@ the same span format, so training and serving traces open in the same
 Perfetto view.
 """
 
-from paddle_tpu.obs.bridge import trainer_event_bridge
+from paddle_tpu.obs.bridge import publish_resilience, trainer_event_bridge
 from paddle_tpu.obs.export import (chrome_trace, dumps_chrome, load_events,
                                    save_chrome_trace, save_events)
 from paddle_tpu.obs.registry import (Counter, Gauge, Histogram,
@@ -33,5 +33,5 @@ __all__ = [
     "Event", "Tracer", "NULL_TRACER", "tracer_for",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "default_registry",
     "chrome_trace", "dumps_chrome", "save_chrome_trace", "save_events",
-    "load_events", "trainer_event_bridge",
+    "load_events", "trainer_event_bridge", "publish_resilience",
 ]

@@ -31,7 +31,7 @@ from typing import Callable, Optional
 
 from paddle_tpu import event as v2_event
 
-__all__ = ["trainer_event_bridge"]
+__all__ = ["trainer_event_bridge", "publish_resilience"]
 
 
 def trainer_event_bridge(tracer, handler: Optional[Callable] = None,
@@ -70,3 +70,52 @@ def trainer_event_bridge(tracer, handler: Optional[Callable] = None,
             handler(ev)
 
     return on_event
+
+
+def publish_resilience(registry, checkpointer=None, report=None) -> None:
+    """Land the fault-tolerant-training numbers on a unified
+    :class:`~paddle_tpu.obs.registry.MetricsRegistry` — the same
+    one-scrape-surface contract ``ServingMetrics.publish`` /
+    ``StatSet.publish`` follow, so a supervised run's recovery history
+    exports next to its serving twin.
+
+    ``checkpointer`` (a ``resilience.AsyncCheckpointer``) contributes
+    the async-save split — ``train_ckpt_stall_seconds_total`` (what the
+    train loop actually waited: snapshot + pipeline waits) vs
+    ``train_ckpt_write_seconds_total`` (background disk time) — plus
+    save/commit counts; ``report`` (a ``resilience.RunReport``)
+    contributes restart counts by kind and the completed flag.  The
+    live per-event counters (``train_bad_steps_total``,
+    ``train_rollbacks_total``, ``train_restarts_total``) are published
+    by the trainer/supervisor as they happen; this call adds the
+    end-of-run aggregates."""
+    # gauges, so the names deliberately avoid the Prometheus counter
+    # `_total` suffix — rate()/increase() tooling keys on that spelling
+    if checkpointer is not None:
+        registry.gauge(
+            "train_ckpt_saves",
+            "checkpoint saves submitted by the async checkpointer"
+        ).set(checkpointer.saves)
+        registry.gauge(
+            "train_ckpt_commits",
+            "checkpoint writes fully committed (meta durable)"
+        ).set(checkpointer.commits)
+        registry.gauge(
+            "train_ckpt_stall_seconds",
+            "train-loop seconds spent waiting on checkpointing "
+            "(device->host snapshot + pipeline waits)"
+        ).set(checkpointer.stall_s)
+        registry.gauge(
+            "train_ckpt_write_seconds",
+            "background seconds spent writing checkpoint blobs"
+        ).set(checkpointer.write_s)
+    if report is not None:
+        g = registry.gauge(
+            "train_supervised_restarts",
+            "restarts observed by the resume supervisor, by kind")
+        g.labels(kind="death").set(report.deaths)
+        g.labels(kind="rollback").set(report.rollbacks)
+        registry.gauge(
+            "train_supervised_completed",
+            "1 when the supervised training fn ran to completion"
+        ).set(1.0 if report.completed else 0.0)

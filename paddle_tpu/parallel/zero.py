@@ -250,6 +250,36 @@ def build_zero_plan(mesh, params: Dict[str, Any], specs=None,
     return ZeroPlan(mesh, axis, entries)
 
 
+def host_tree(tree):
+    """Full host (numpy) copy of a pytree of arrays — the checkpoint
+    snapshot path (``checkpoint.snapshot_checkpoint``).  Replicated and
+    single-device arrays read straight through ``np.asarray``; a
+    physically-sharded mesh-spanning array routes through the compiled
+    ``zero.host_gather`` identity — one XLA all-gather then a single
+    host read instead of per-shard host copies — which also covers the
+    multi-process case where ``np.asarray`` on non-addressable devices
+    would raise (the same contract as :meth:`ZeroPlan._host_full`, made
+    plan-free so params/model-state snapshot through it too)."""
+    import jax
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def leaf(v):
+        if v is None:
+            return None
+        if isinstance(v, jax.Array) and \
+                (not v.is_fully_addressable or not v.is_fully_replicated):
+            mesh = getattr(v.sharding, "mesh", None)
+            if mesh is not None:
+                sh = NamedSharding(mesh, P())
+                if _mesh_spanning(v, sh):
+                    v = _identity_jit(sh, "zero.host_gather")(v)
+                    return np.asarray(v.addressable_data(0))
+        return np.asarray(v)
+
+    return jax.tree.map(leaf, tree)
+
+
 def opt_state_bytes_per_device(tree) -> int:
     """Exact per-device bytes of a (possibly sharded) state pytree — the
     bench/acceptance metric for the N x optimizer-state reduction."""

@@ -287,6 +287,43 @@ FLAGS.define("shard_audit_virtual_devices", 8,
              "backend has not initialized yet; <=1 disables the "
              "forcing and the placement drive degrades to a loud "
              "'not audited' notice.", parser=int)
+FLAGS.define("train_bad_step_policy", "off",
+             "default bad-step guard for trainer.SGD (per-trainer "
+             "override: SGD(guard=BadStepGuard(...))): 'off' = the "
+             "classic unguarded step; 'skip' = fuse a global-norm + "
+             "finiteness check over the gradients into the jitted step "
+             "and skip bad steps in-graph (params, optimizer slots and "
+             "model state untouched, counted lazily — no new per-step "
+             "host sync); 'rollback' = skip, plus K consecutive bad "
+             "steps (train_bad_step_window) dump a flight-recorder "
+             "postmortem and raise BadStepRollback so the resilience "
+             "supervisor restarts from the last verified checkpoint.")
+FLAGS.define("train_bad_step_max_norm", 0.0,
+             "bad-step guard: global gradient-norm ceiling — a FINITE "
+             "step whose grad norm exceeds this is also skipped "
+             "(0 = finiteness check only). Unlike "
+             "gradient_clipping_threshold this does not rescale; it "
+             "refuses the step.", parser=float)
+FLAGS.define("train_bad_step_window", 3,
+             "bad-step guard hysteresis: under policy 'rollback', this "
+             "many CONSECUTIVE bad steps trigger the rollback. Also the "
+             "default host-readback cadence for the on-device "
+             "consecutive counter (BadStepGuard.check_every).",
+             parser=int)
+FLAGS.define("train_ckpt_async", False,
+             "write training checkpoints on a background thread "
+             "(resilience.AsyncCheckpointer): the train loop stalls "
+             "only for the device->host snapshot, never the "
+             "tar/pkl/md5/meta disk commit. Depth-one pipelined — a new "
+             "save first waits out the previous write, and the elastic "
+             "trainer acks master tasks only past that durability "
+             "barrier. Per-call override: train(async_save=...).")
+FLAGS.define("train_ckpt_keep", 2,
+             "checkpoint prune budget for step-granular training saves: "
+             "keep this many newest VERIFIED checkpoints (corrupt dirs "
+             "never count toward the budget, so torn young saves cannot "
+             "reap the only good artifact). 0 disables pruning. "
+             "Per-call override: train(keep=...).", parser=int)
 FLAGS.define("save_dir", "./output", "default checkpoint output directory")
 FLAGS.define("log_level", "INFO", "logging level")
 FLAGS.define("prealloc_mem", False, "let XLA preallocate the whole HBM arena")

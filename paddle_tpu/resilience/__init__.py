@@ -1,0 +1,40 @@
+"""paddle_tpu.resilience — the fault-tolerant training runtime.
+
+Training's mirror of the serving stack's chaos machinery (PRs 3/6/7):
+failure is the steady state on preemptible TPU capacity, so every
+recovery path here is deterministic, seeded and CI-replayable.
+
+Four connected parts:
+
+- :mod:`~paddle_tpu.resilience.faults` — :class:`TrainFaultPlan`, the
+  seedable injected-failure schedule (deaths, NaN gradients, slow
+  steps, kill-during-save) threaded through ``trainer.SGD(faults=...)``
+  on an injected clock;
+- :mod:`~paddle_tpu.resilience.guard` — :class:`BadStepGuard`, the
+  in-step skip / hysteresis / rollback-to-last-good policy ladder over
+  one fused grad-norm+finiteness reduction;
+- :mod:`~paddle_tpu.resilience.checkpointer` —
+  :class:`AsyncCheckpointer`, step-granular background checkpoint
+  writes over the tmp+rename+md5 commit protocol (training stalls only
+  for the device->host snapshot);
+- :mod:`~paddle_tpu.resilience.supervisor` — :func:`run_supervised`,
+  restarting a training fn across deaths/rollbacks from the newest
+  verified checkpoint.
+
+``python -m paddle_tpu.resilience run`` replays the seeded chaos demo;
+``... check`` is the tier-1 gate (ladder exit 10 via tools_tier1.sh).
+"""
+
+from paddle_tpu.resilience.checkpointer import AsyncCheckpointer
+from paddle_tpu.resilience.faults import (BadStepRollback,
+                                          InjectedTrainerDeath,
+                                          ManualClock, TrainFaultPlan)
+from paddle_tpu.resilience.guard import BadStepGuard
+from paddle_tpu.resilience.supervisor import (RunReport, SupervisorGaveUp,
+                                              run_supervised)
+
+__all__ = [
+    "TrainFaultPlan", "InjectedTrainerDeath", "BadStepRollback",
+    "ManualClock", "BadStepGuard", "AsyncCheckpointer",
+    "run_supervised", "RunReport", "SupervisorGaveUp",
+]

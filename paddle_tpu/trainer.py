@@ -16,6 +16,7 @@ MultiGradientMachine ring / pserver addGradient analog).
 from __future__ import annotations
 
 import functools
+import itertools
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
@@ -69,7 +70,8 @@ class SGD:
                  is_local: bool = True, mesh=None,
                  metrics: Optional[Dict[str, LayerOutput]] = None,
                  zero_axis: Optional[str] = None,
-                 zero: Optional[int] = None):
+                 zero: Optional[int] = None,
+                 faults=None, guard=None, tracer=None):
         costs = [cost] if isinstance(cost, LayerOutput) else list(cost)
         self.metrics = dict(metrics or {})
         # auto-collect evaluator nodes passed via extra_layers
@@ -125,6 +127,41 @@ class SGD:
         self._rng = jax.random.PRNGKey(FLAGS.seed or 0)
         self._step_fn = None
         self._test_fn = None
+        # fault-tolerant runtime (paddle_tpu.resilience): a seedable
+        # TrainFaultPlan drives injected deaths/NaNs/slow steps, the
+        # BadStepGuard fuses the skip-or-rollback policy into the jitted
+        # step, and the tracer puts guard/checkpoint edges on the obs
+        # timeline.  guard=None falls back to FLAGS.train_bad_step_policy
+        # ("off" by default, so the unguarded step signature — and every
+        # existing compiled program — is unchanged).
+        self._faults = faults
+        if guard is None:
+            policy = str(FLAGS.train_bad_step_policy or "off")
+            if policy != "off":
+                from paddle_tpu.resilience.guard import BadStepGuard
+
+                guard = BadStepGuard(
+                    policy=policy,
+                    max_norm=float(FLAGS.train_bad_step_max_norm),
+                    rollback_after=int(FLAGS.train_bad_step_window))
+        if faults is not None and faults.injects_grads():
+            enforce_that(guard is not None,
+                         "TrainFaultPlan injects non-finite gradients "
+                         "but no bad-step guard is set — pass "
+                         "SGD(guard=BadStepGuard()) (or set "
+                         "FLAGS.train_bad_step_policy) so the poison "
+                         "is screened instead of corrupting optimizer "
+                         "slots", context="trainer")
+        self._guard = guard
+        if tracer is None:
+            from paddle_tpu.obs.trace import NULL_TRACER
+
+            tracer = NULL_TRACER
+        self._tracer = tracer
+        self._global_step = 0
+        self._bad_steps_seen = 0   # per-train()-call device-counter mark
+        self.bad_steps_total = 0   # lifetime skipped-step count
+        self._async_ckpt = None
 
     # ------------------------------------------------------------------
     # compiled steps
@@ -143,8 +180,9 @@ class SGD:
         # cadence must agree even if the flag changes later
         self._stats_period = int(FLAGS.show_parameter_stats_period or 0)
         stats_on = self._stats_period > 0
+        guard = self._guard
 
-        def step(params, opt_state, model_state, rng, feeds):
+        def forward_backward(params, model_state, rng, feeds):
             def loss_fn(p):
                 outs, new_state = topo.forward(p, model_state, feeds,
                                                train=True, rng=rng, mesh=mesh)
@@ -154,20 +192,56 @@ class SGD:
                                zip(metric_names, outs[n_costs:])}
                 return total, (new_state, metric_vals)
 
-            (loss, (new_mstate, metric_vals)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
+            return jax.value_and_grad(loss_fn, has_aux=True)(params)
+
+        def grad_stats(metric_vals, grads):
+            if not stats_on:
+                return metric_vals
+            metric_vals = dict(metric_vals)
+            metric_vals["__param_stats__"] = {
+                k: (jnp.mean(jnp.abs(g)), jnp.max(jnp.abs(g)))
+                for k, g in grads.items()}
+            return metric_vals
+
+        def step(params, opt_state, model_state, rng, feeds):
+            (loss, (new_mstate, metric_vals)), grads = forward_backward(
+                params, model_state, rng, feeds)
             new_params, new_opt = optimizer.apply(params, grads, opt_state)
-            if stats_on:
-                metric_vals = dict(metric_vals)
-                metric_vals["__param_stats__"] = {
-                    k: (jnp.mean(jnp.abs(g)), jnp.max(jnp.abs(g)))
-                    for k, g in grads.items()}
-            return loss, new_params, new_opt, new_mstate, metric_vals
+            return (loss, new_params, new_opt, new_mstate,
+                    grad_stats(metric_vals, grads))
+
+        def guarded_step(params, opt_state, model_state, rng, feeds,
+                         guard_state):
+            # bad-step guard (paddle_tpu.resilience.guard): screen the
+            # gradients with ONE fused f32 sq-norm reduction (also the
+            # fault plan's poison seam — `inject` is 0.0 outside
+            # injection windows), run the usual update, and select every
+            # params/slot/model-state leaf back to its old value when
+            # the step is bad.  The counters stay on device; the host
+            # reads them on the same lazy cadence as .cost — no new
+            # per-step sync, no extra compile (the inject scalar is a
+            # same-shape argument, not a trace constant).
+            from paddle_tpu.resilience.guard import (guard_outputs,
+                                                     screen_grads,
+                                                     select_good)
+
+            (loss, (new_mstate, metric_vals)), grads = forward_backward(
+                params, model_state, rng, feeds)
+            grads, good, _ = screen_grads(grads, guard_state["inject"],
+                                          guard.max_norm)
+            new_params, new_opt = optimizer.apply(params, grads, opt_state)
+            new_params = select_good(good, new_params, params)
+            new_opt = select_good(good, new_opt, opt_state)
+            new_mstate = select_good(good, new_mstate, model_state)
+            return (loss, new_params, new_opt, new_mstate,
+                    grad_stats(metric_vals, grads),
+                    guard_outputs(good, guard_state))
 
         # With mesh-sharded (NamedSharding) inputs, jit partitions the whole
         # step SPMD automatically — XLA inserts the grad psum (the
         # MultiGradientMachine ring / pserver addGradient analog).
-        return audit_jit(step, site="trainer.train_step",
+        return audit_jit(guarded_step if guard is not None else step,
+                         site="trainer.train_step",
                          donate_argnums=(0, 1, 2),
                          xla_contract=self._step_contract())
 
@@ -215,7 +289,10 @@ class SGD:
                 in_specs = ((), (), feed)        # params, mstate, feeds
             else:
                 # params, opt_state, model_state, rng, feeds
+                # (+ the replicated guard-state scalars when guarded)
                 in_specs = ((), opt, (), (), feed)
+                if self._guard is not None:
+                    in_specs = in_specs + ((),)
                 if plan is not None:
                     expect = (1,)
         return SiteContract(
@@ -327,12 +404,29 @@ class SGD:
               feeding=None, test_reader=None, save_dir: Optional[str] = None,
               start_pass: int = 0, saving_period: int = 1, master=None,
               record_parser=None, heartbeat_ttl_s: Optional[float] = None,
-              prefetch: int = 0) -> None:
+              prefetch: int = 0, save_period_steps: int = 0,
+              resume: bool = False, async_save: Optional[bool] = None,
+              keep: Optional[int] = None) -> None:
         """``save_dir``/``start_pass``/``saving_period`` are the
         --save_dir/--start_pass/--saving_period flags of the reference
         trainer (ParamUtil.h:77-111): checkpoints (params + optimizer
         state) land in save_dir/pass-%05d every ``saving_period`` passes,
         and ``start_pass`` resumes from an existing one if present.
+
+        Fault-tolerant mode (paddle_tpu.resilience): with
+        ``save_period_steps=N`` checkpoints are STEP-granular — every N
+        steps (and at each pass end) a checkpoint carrying a ``cursor``
+        (pass id, step-in-pass, global step, rng state) is written under
+        a monotonically increasing id; ``resume=True`` restores the
+        newest INTACT checkpoint (corrupt dirs are rejected with a
+        CKPT-CORRUPT line and the next-older one wins) and fast-forwards
+        the data cursor, so a killed run re-joins mid-pass with the same
+        rng stream — final params equal an uninterrupted run's.
+        ``async_save=True`` (default ``FLAGS.train_ckpt_async``) writes
+        blobs on a background thread (AsyncCheckpointer): training
+        stalls only for the device->host snapshot.  ``keep`` bounds the
+        checkpoint dir (verified-aware pruning; default
+        ``FLAGS.train_ckpt_keep``).
 
         With ``master=MasterClient(...)`` training is elastic/task-driven
         instead of reader-driven (reference: cloud_reader + etcd
@@ -341,6 +435,9 @@ class SGD:
         sample tuple), the lease is heartbeat per batch, and a lapsed
         lease triggers re-register + auto-resume from the latest
         checkpoint in ``save_dir``."""
+        use_async = bool(FLAGS.train_ckpt_async) if async_save is None \
+            else bool(async_save)
+        keep = int(FLAGS.train_ckpt_keep) if keep is None else int(keep)
         if master is not None:
             enforce_that(record_parser is not None,
                          "master= training needs record_parser=",
@@ -348,22 +445,41 @@ class SGD:
             enforce_that(start_pass == 0, "start_pass is reader-path only; "
                          "elastic training resumes from save_dir "
                          "automatically", context="trainer")
+            enforce_that(save_period_steps == 0,
+                         "save_period_steps is reader-path only; elastic "
+                         "training checkpoints per saving_period tasks",
+                         context="trainer")
             return self._train_elastic(master, record_parser, num_passes,
                                        event_handler, feeding, save_dir,
                                        heartbeat_ttl_s, saving_period,
-                                       test_reader)
+                                       test_reader, use_async, keep)
         enforce_that(reader is not None, "train() needs a reader "
                      "(or master=)", context="trainer")
+        enforce_that(not (resume and start_pass > 0),
+                     "resume= (step-granular, cursor-driven) and "
+                     "start_pass= (pass-granular) are exclusive",
+                     context="trainer")
+        # silently no-opping these would make a supervised run restart
+        # from scratch on every death — the elastic path already errors
+        # on the same misuse ("lease lost with no save_dir")
+        enforce_that(not (resume and save_dir is None),
+                     "resume=True needs save_dir= (nothing to resume "
+                     "from otherwise)", context="trainer")
+        enforce_that(not (save_period_steps > 0 and save_dir is None),
+                     "save_period_steps needs save_dir=",
+                     context="trainer")
         if event_handler is None:
             event_handler = _default_event_handler
         feeder = self._make_feeder(feeding)
         if self._step_fn is None:
             self._step_fn = self._build_step()
+        log = plog.logger()
+
+        from paddle_tpu import checkpoint as ckpt
 
         if save_dir is not None and start_pass > 0:
             import os
 
-            from paddle_tpu import checkpoint as ckpt
             # resume from exactly pass start_pass-1 (newer checkpoints may
             # exist when re-branching; silently training from fresh init
             # would overwrite them with garbage)
@@ -374,104 +490,341 @@ class SGD:
                          context="trainer")
             self.load_checkpoint(save_dir, want)
 
+        resume_pass, resume_step = start_pass, 0
+        if resume and save_dir is not None:
+            loaded = ckpt.load_latest(save_dir)
+            if loaded is not None:
+                self.apply_checkpoint(loaded)
+                meta = loaded[3]
+                cur = meta.get("cursor") or {}
+                # a cursor-less (legacy per-pass) artifact resumes at the
+                # pass AFTER the one it closed
+                resume_pass = int(cur.get("pass_id",
+                                          meta.get("pass_id", -1) + 1))
+                resume_step = int(cur.get("step_in_pass", 0))
+                self._global_step = int(cur.get("global_step", 0))
+                if cur.get("rng") is not None:
+                    self._rng = jnp.asarray(
+                        np.asarray(cur["rng"], dtype=np.uint32))
+                log.info("resumed from checkpoint (pass %d, step-in-pass "
+                         "%d, global step %d)", resume_pass, resume_step,
+                         self._global_step)
+                self._tracer.instant("train_resume", cat="train",
+                                     pass_id=resume_pass,
+                                     step=self._global_step)
+        step_saves = save_dir is not None and save_period_steps > 0
+        ck_next = 0
+        if step_saves:
+            # monotonic checkpoint counter above every existing dir
+            # (id 0 is a real id — `or -1` would shift the numbering)
+            lp = ckpt.latest_pass(save_dir)
+            ck_next = (lp + 1) if lp is not None else 0
+        # per-call checkpointer: a previous train() call's async writer
+        # must neither leak into this call (async_save=False here would
+        # silently stay async, with the OLD keep) nor race it — settle
+        # and rebuild from this call's arguments
+        if self._async_ckpt is not None:
+            self._drain_async_writer("superseded by a new train() call")
+            self._async_ckpt = None
+        if step_saves and use_async:
+            from paddle_tpu.resilience.checkpointer import AsyncCheckpointer
+
+            self._async_ckpt = AsyncCheckpointer(keep=keep)
+
         params = self.parameters.as_dict()
         opt_state = self.opt_state
         mstate = self.model_state
-        log = plog.logger()
+        gstate = self._guard_init() if self._guard is not None else None
+        self._bad_steps_seen = 0   # fresh device counter this train()
+        faults = self._faults
 
-        # reference flag semantics (ParamUtil.h): num_passes is the TOTAL
-        # pass count; resuming at start_pass runs passes [start_pass,
-        # num_passes), not num_passes additional ones
-        for pass_id in range(start_pass, num_passes):
-            event_handler(v2_event.BeginPass(pass_id))
-            # host-side floats; device scalars buffer in `pending` and flush
-            # with ONE stacked transfer per stream per log window
-            pass_costs: List[float] = []
-            pass_metrics: Dict[str, List[float]] = {n: [] for n in self.metrics}
-            pending: List = []
-            pending_metrics: Dict[str, List] = {n: [] for n in self.metrics}
-
-            def flush():
-                if pending:
-                    pass_costs.extend(np.asarray(jnp.stack(pending)).tolist())
-                    pending.clear()
-                for k, buf in pending_metrics.items():
-                    if buf:
-                        pass_metrics[k].extend(np.asarray(jnp.stack(buf)).tolist())
-                        buf.clear()
-
-            if prefetch > 0:
-                # device-resident double buffering: feed conversion + the
-                # host->device transfer of batch k+1 overlap batch k's
-                # compute (the async DataProvider pool analog)
-                from paddle_tpu.reader.prefetch import device_prefetch
-
-                feed_it = device_prefetch(
-                    reader(), size=prefetch, transform=feeder.feed,
-                    place=self._shard_feeds if self.mesh is not None
-                    else None)
-            else:
-                feed_it = (self._shard_feeds(feeder.feed(b))
-                           for b in reader())
-            for batch_id, feeds in enumerate(feed_it):
-                event_handler(v2_event.BeginIteration(pass_id, batch_id))
-                self._rng, key = jax.random.split(self._rng)
-                with stats.timer("trainOneBatch"):
-                    loss, params, opt_state, mstate, metric_vals = self._step_fn(
-                        params, opt_state, mstate, key, feeds)
-                pstats = metric_vals.pop("__param_stats__", None)
-                period = getattr(self, "_stats_period", 0)
-                if pstats is not None and period > 0 \
-                        and (batch_id + 1) % period == 0:
-                    for k in sorted(pstats):
-                        avg_abs, max_abs = pstats[k]
-                        log.info("Param %s avgAbsGrad=%.6g maxAbsGrad=%.6g",
-                                 k, float(avg_abs), float(max_abs))
-                # no host sync per batch (the device round-trip costs more
-                # than the step); events convert lazily via properties
-                pending.append(loss)
-                for k, v in metric_vals.items():
-                    pending_metrics[k].append(v)
-                event_handler(v2_event.EndIteration(pass_id, batch_id, loss,
-                                                    metric_vals))
-                if FLAGS.log_period and (batch_id + 1) % FLAGS.log_period == 0:
-                    flush()
-                    mtxt = " ".join(f"{k}={np.mean(v[-FLAGS.log_period:]):.5f}"
-                                    for k, v in pass_metrics.items())
-                    log.info("Pass %d, Batch %d, Cost %.5f %s", pass_id,
-                             batch_id, np.mean(pass_costs[-FLAGS.log_period:]), mtxt)
-            # pass end: sync back, fire event (with test if reader given)
-            flush()
+        def sync_back():
             self.parameters.update_from(params)
             self.opt_state = opt_state
             self.model_state = mstate
-            result_metrics = {k: float(np.mean(v)) if v else 0.0
-                              for k, v in pass_metrics.items()}
-            if test_reader is not None:
-                tr = self.test(test_reader, feeding)
-                event_handler(v2_event.EndPass(pass_id, tr.metrics, self.parameters))
-            else:
-                event_handler(v2_event.EndPass(pass_id, result_metrics, self.parameters))
-            if save_dir is not None and (pass_id + 1) % saving_period == 0:
-                self.save_checkpoint(save_dir, pass_id)
-            # scrape surface for the per-batch timers: publish the
-            # StatSet into the obs registry each pass instead of ad-hoc
-            # report() prints — training timings land next to serving
-            # metrics on ONE export (obs.default_registry().to_text()).
-            # Wrap event_handler with obs.trainer_event_bridge(tracer)
-            # to additionally put every pass/iteration on a trace
-            # timeline.
-            stats.timer_stats().publish(default_registry(),
-                                        prefix="trainer_")
 
-        self.parameters.update_from(params)
-        self.opt_state = opt_state
-        self.model_state = mstate
+        def save_cursor(pass_id: int, step_in_pass: int) -> None:
+            """One step-granular checkpoint (sync or async) carrying the
+            resume cursor; checkpoint ids are a monotonic counter, not
+            pass ids, so mid-pass saves never collide."""
+            nonlocal ck_next
+            sync_back()
+            self._save_with_cursor(save_dir, ck_next, pass_id,
+                                   step_in_pass, keep)
+            ck_next += 1
+
+        try:
+            # reference flag semantics (ParamUtil.h): num_passes is the
+            # TOTAL pass count; resuming runs passes [resume_pass,
+            # num_passes), not num_passes additional ones
+            for pass_id in range(resume_pass, num_passes):
+                skip = resume_step if pass_id == resume_pass else 0
+                raw_it = reader()
+                if skip:
+                    # fast-forward the data cursor: the resumed pass
+                    # consumed `skip` batches before the checkpoint, so
+                    # drop them unconverted (no feed/transfer cost)
+                    for _ in range(skip):
+                        if next(raw_it, None) is None:
+                            break
+                    peek = next(raw_it, None)
+                    if peek is None:
+                        # the cursor sits exactly at the pass boundary
+                        # (the pass-end save was torn): the pass already
+                        # completed AND fired its events before the
+                        # crash — repair the boundary cursor and move on
+                        # without re-firing BeginPass/EndPass over an
+                        # empty replay (a zero-metric duplicate EndPass
+                        # would feed garbage to early-stopping handlers)
+                        if step_saves:
+                            save_cursor(pass_id + 1, 0)
+                        continue
+                    raw_it = itertools.chain([peek], raw_it)
+                event_handler(v2_event.BeginPass(pass_id))
+                # host-side floats; device scalars buffer in `pending` and
+                # flush with ONE stacked transfer per stream per log window
+                pass_costs: List[float] = []
+                pass_metrics: Dict[str, List[float]] = {
+                    n: [] for n in self.metrics}
+                pending: List = []
+                pending_metrics: Dict[str, List] = {
+                    n: [] for n in self.metrics}
+
+                def flush():
+                    if pending:
+                        pass_costs.extend(
+                            np.asarray(jnp.stack(pending)).tolist())
+                        pending.clear()
+                    for k, buf in pending_metrics.items():
+                        if buf:
+                            pass_metrics[k].extend(
+                                np.asarray(jnp.stack(buf)).tolist())
+                            buf.clear()
+
+                if prefetch > 0:
+                    # device-resident double buffering: feed conversion +
+                    # the host->device transfer of batch k+1 overlap batch
+                    # k's compute (the async DataProvider pool analog)
+                    from paddle_tpu.reader.prefetch import device_prefetch
+
+                    feed_it = device_prefetch(
+                        raw_it, size=prefetch, transform=feeder.feed,
+                        place=self._shard_feeds if self.mesh is not None
+                        else None)
+                else:
+                    feed_it = (self._shard_feeds(feeder.feed(b))
+                               for b in raw_it)
+                for batch_id, feeds in enumerate(feed_it, start=skip):
+                    if faults is not None:
+                        # injected clock tick + scheduled death, BEFORE
+                        # the step runs (a killed step's work is lost and
+                        # must replay from the last checkpoint)
+                        faults.step_begin(self._global_step)
+                    event_handler(v2_event.BeginIteration(pass_id, batch_id))
+                    self._rng, key = jax.random.split(self._rng)
+                    with stats.timer("trainOneBatch"):
+                        if gstate is not None:
+                            gstate["inject"] = np.float32(
+                                faults.grad_inject(self._global_step)
+                                if faults is not None else 0.0)
+                            (loss, params, opt_state, mstate, metric_vals,
+                             gout) = self._step_fn(params, opt_state,
+                                                   mstate, key, feeds,
+                                                   gstate)
+                            gstate = {"inject": gstate["inject"], **gout}
+                        else:
+                            loss, params, opt_state, mstate, metric_vals = \
+                                self._step_fn(params, opt_state, mstate,
+                                              key, feeds)
+                    self._global_step += 1
+                    pstats = metric_vals.pop("__param_stats__", None)
+                    period = getattr(self, "_stats_period", 0)
+                    if pstats is not None and period > 0 \
+                            and (batch_id + 1) % period == 0:
+                        for k in sorted(pstats):
+                            avg_abs, max_abs = pstats[k]
+                            log.info("Param %s avgAbsGrad=%.6g "
+                                     "maxAbsGrad=%.6g",
+                                     k, float(avg_abs), float(max_abs))
+                    # no host sync per batch (the device round-trip costs
+                    # more than the step); events convert lazily
+                    pending.append(loss)
+                    for k, v in metric_vals.items():
+                        pending_metrics[k].append(v)
+                    event_handler(v2_event.EndIteration(pass_id, batch_id,
+                                                        loss, metric_vals))
+                    if step_saves and (batch_id + 1) % save_period_steps == 0:
+                        save_cursor(pass_id, batch_id + 1)
+                    if gstate is not None:
+                        self._guard_check(gstate)
+                    if FLAGS.log_period \
+                            and (batch_id + 1) % FLAGS.log_period == 0:
+                        flush()
+                        mtxt = " ".join(
+                            f"{k}={np.mean(v[-FLAGS.log_period:]):.5f}"
+                            for k, v in pass_metrics.items())
+                        log.info("Pass %d, Batch %d, Cost %.5f %s", pass_id,
+                                 batch_id,
+                                 np.mean(pass_costs[-FLAGS.log_period:]),
+                                 mtxt)
+                # pass end: sync back, fire event (+ test if reader given)
+                flush()
+                sync_back()
+                result_metrics = {k: float(np.mean(v)) if v else 0.0
+                                  for k, v in pass_metrics.items()}
+                if test_reader is not None:
+                    tr = self.test(test_reader, feeding)
+                    event_handler(v2_event.EndPass(pass_id, tr.metrics,
+                                                   self.parameters))
+                else:
+                    event_handler(v2_event.EndPass(pass_id, result_metrics,
+                                                   self.parameters))
+                if gstate is not None:
+                    # before the pass-end save: a save-kill must not
+                    # swallow this pass's bad-step accounting
+                    self._flush_guard_stats(gstate)
+                if step_saves:
+                    # pass boundary in cursor terms: next pass, step 0
+                    save_cursor(pass_id + 1, 0)
+                elif save_dir is not None \
+                        and (pass_id + 1) % saving_period == 0:
+                    self.save_checkpoint(save_dir, pass_id)
+                # scrape surface for the per-batch timers: publish the
+                # StatSet into the obs registry each pass instead of
+                # ad-hoc report() prints — training timings land next to
+                # serving metrics on ONE export.  Wrap event_handler with
+                # obs.trainer_event_bridge(tracer) to additionally put
+                # every pass/iteration on a trace timeline.
+                stats.timer_stats().publish(default_registry(),
+                                            prefix="trainer_")
+        except BaseException:
+            # unwind (injected death, rollback, real error): let the
+            # in-flight background write finish — deterministic, and a
+            # half-written artifact would otherwise race the resume —
+            # and loudly report (not raise) any recorded writer failure,
+            # since the restart path builds a fresh trainer and would
+            # otherwise drop it with this object
+            self._drain_async_writer("train loop unwinding")
+            raise
+
+        sync_back()
+        if self._async_ckpt is not None:
+            # durability barrier: train() returning means the newest
+            # checkpoint is committed (writer errors surface here)
+            self._async_ckpt.wait()
+
+    # ------------------------------------------------------------------
+    # bad-step guard + cursor-checkpoint plumbing (paddle_tpu.resilience)
+    # ------------------------------------------------------------------
+
+    def _guard_init(self):
+        from paddle_tpu.resilience.guard import guard_init
+
+        return guard_init()
+
+    def _guard_check(self, gstate) -> None:
+        """Rollback-policy hysteresis check, amortized: the consecutive
+        counter is a device scalar read back only every
+        ``guard.cadence`` steps (healthy steps stay on the lazy .cost
+        sync contract).  A streak of ``rollback_after`` bad steps dumps
+        the flight recorder and raises BadStepRollback — the supervisor
+        restarts from the newest verified checkpoint."""
+        g = self._guard
+        if g is None or g.policy != "rollback" \
+                or self._global_step % g.cadence:
+            return
+        consec = int(gstate["bad_consec"])
+        if consec < g.rollback_after:
+            return
+        from paddle_tpu.resilience.faults import BadStepRollback
+
+        self._tracer.instant("bad_step_rollback", cat="train",
+                             consec=consec, step=self._global_step)
+        if getattr(self._tracer, "enabled", False):
+            self._tracer.dump_postmortem("bad-step-rollback")
+        default_registry().counter(
+            "train_rollbacks_total",
+            "bad-step guard rollbacks to the last good checkpoint").inc()
+        raise BadStepRollback(
+            f"{consec} consecutive bad steps (>= {g.rollback_after}) at "
+            f"global step {self._global_step}: rolling back to the last "
+            "verified checkpoint")
+
+    def _flush_guard_stats(self, gstate) -> None:
+        """Lazy bad-step accounting (one host read per pass): newly
+        skipped steps land on the obs timeline and the unified registry,
+        and ``self.bad_steps_total`` accumulates the lifetime count
+        (the device counter restarts at 0 on every train() call; the
+        watermark ``_bad_steps_seen`` is reset with it)."""
+        total = int(gstate["bad_total"])
+        new = total - self._bad_steps_seen
+        if new > 0:
+            self.bad_steps_total += new
+            self._tracer.instant("bad_steps_skipped", cat="train",
+                                 count=new, total=self.bad_steps_total,
+                                 step=self._global_step)
+            default_registry().counter(
+                "train_bad_steps_total",
+                "train steps skipped by the bad-step guard "
+                "(non-finite or over-norm gradients)").inc(new)
+        self._bad_steps_seen = total
+
+    def _drain_async_writer(self, why: str) -> None:
+        """Join the in-flight async write and LOUDLY report — never
+        raise — a recorded writer failure.  Used wherever the
+        checkpointer is being discarded or the loop is already
+        unwinding: the failed artifact is uncommitted (resume falls
+        back to the previous checkpoint), but the failure must not die
+        silently with the object."""
+        ck = self._async_ckpt
+        if ck is None:
+            return
+        ck.drain()
+        err = ck.take_error()
+        if err is not None:
+            plog.logger().warning(
+                "async checkpoint writer failed (%s): %r — artifact "
+                "left uncommitted; resume falls back to the previous "
+                "checkpoint", why, err)
+            self._tracer.instant("ckpt_write_failed", cat="train",
+                                 why=why)
+
+    def _save_with_cursor(self, root: str, ck_id: int, pass_id: int,
+                          step_in_pass: int, keep: int) -> None:
+        """One step-granular checkpoint under the tmp+rename+md5 commit
+        protocol, sync or async (``self._async_ckpt``).  The cursor
+        records everything a replacement trainer needs to continue the
+        SAME run: pass id, step-in-pass (the data cursor), global step
+        (the fault/metric clock) and the rng key (the dropout/shuffle
+        stream)."""
+        from paddle_tpu import checkpoint as ckpt
+
+        extra = {"cursor": {"pass_id": int(pass_id),
+                            "step_in_pass": int(step_in_pass),
+                            "global_step": int(self._global_step),
+                            "rng": np.asarray(self._rng).tolist()}}
+        hook = self._faults.save_hook(ck_id) \
+            if self._faults is not None else None
+        with self._tracer.span("checkpoint_save", cat="train", ck=ck_id,
+                               step=self._global_step):
+            if self._async_ckpt is not None:
+                self._async_ckpt.save(
+                    root, ck_id, self.parameters, opt_state=self.opt_state,
+                    model_state=self.model_state, extra_meta=extra,
+                    shard_plan=self._zero_plan, commit_hook=hook)
+            else:
+                ckpt.save_checkpoint(
+                    root, ck_id, self.parameters, opt_state=self.opt_state,
+                    model_state=self.model_state, extra_meta=extra,
+                    shard_plan=self._zero_plan, commit_hook=hook)
+                if keep > 0:
+                    ckpt.prune_checkpoints(root, keep=keep)
 
     def _train_elastic(self, master, record_parser, num_passes: int,
                        event_handler, feeding, save_dir: Optional[str],
                        ttl_s: Optional[float], saving_period: int,
-                       test_reader) -> None:
+                       test_reader, use_async: bool = False,
+                       keep: int = 2) -> None:
         """Task-driven elastic training (the kill/resume e2e productized).
 
         One SGD step per master task; the step counter (== applied task
@@ -492,6 +845,15 @@ class SGD:
         resumes without losing or double-applying a task. Old
         checkpoints are pruned (crash-resume only needs the latest; the
         previous one is kept as insurance while the newest is young).
+
+        Async mode (``use_async``, an AsyncCheckpointer) PIPELINES the
+        durability: flush N waits out write N-1, acks the tasks write
+        N-1 covered, then submits write N and keeps training — the ack
+        invariant ("ack strictly after durable") holds with the disk
+        write off the step path.  A crash in any window still resumes
+        exactly: write N's covered tasks are unacked, so they requeue
+        and replay against checkpoint N-1 (or skip against N if its
+        meta committed first).
         """
         import time as _time
 
@@ -504,6 +866,21 @@ class SGD:
             self._step_fn = self._build_step()
         log = plog.logger()
         saving_period = max(1, int(saving_period))
+        faults = self._faults
+        # per-call checkpointer (same contract as the reader path); the
+        # async prune budget keeps the sync path's >= 2 insurance floor,
+        # or a keep=1 caller would lose the previous checkpoint the
+        # elastic rejoin story depends on while the newest is young
+        if self._async_ckpt is not None:
+            self._drain_async_writer("superseded by a new train() call")
+            self._async_ckpt = None
+        if save_dir is not None and use_async:
+            from paddle_tpu.resilience.checkpointer import AsyncCheckpointer
+
+            # keep=0 stays "pruning disabled" (the documented flag
+            # semantics); only a positive budget gets the >= 2 floor
+            self._async_ckpt = AsyncCheckpointer(
+                keep=keep if keep == 0 else max(2, keep))
 
         def resume_state():
             """-> (next_step, skip_set, pass_id, next_ckpt_id)."""
@@ -532,130 +909,240 @@ class SGD:
         params = self.parameters.as_dict()
         opt_state = self.opt_state
         mstate = self.model_state
+        gstate = self._guard_init() if self._guard is not None else None
+        self._bad_steps_seen = 0   # fresh device counter this train()
         unacked: List[int] = []
+        # async pipelining: tasks covered by the in-flight (submitted,
+        # not yet provably durable) checkpoint — acked at the NEXT flush
+        # once that write has committed
+        covered: List[int] = []
 
         def sync_back():
             self.parameters.update_from(params)
             self.opt_state = opt_state
             self.model_state = mstate
 
-        def flush(meta_pass: int, epoch: int) -> None:
-            """Checkpoint the current state, then ack everything the
-            checkpoint covers. Ack strictly AFTER the write: the reverse
-            order could lose acked-but-not-durable updates."""
+        def settle_covered() -> None:
+            """The durability-then-ack invariant, in ONE place: wait the
+            in-flight write durable (writer errors raise HERE, on the
+            training thread), then — and only then — ack the tasks that
+            write covered."""
+            self._async_ckpt.wait()
+            for tid in covered:
+                master.ack_task(tid)
+            covered.clear()
+
+        def flush(meta_pass: int, epoch: int, final: bool = False) -> None:
+            """Checkpoint the current state, then ack everything a
+            DURABLE checkpoint covers. Ack strictly AFTER the write: the
+            reverse order could lose acked-but-not-durable updates.  On
+            the async path the write of flush N commits in the
+            background while training continues; flush N+1 (or the
+            ``final`` drain) waits it out and acks its tasks."""
             nonlocal ck_id
-            if save_dir is not None:
+            if save_dir is None:
+                for tid in unacked:
+                    master.ack_task(tid)
+                unacked.clear()
+                return
+            hook = faults.save_hook(ck_id) if faults is not None else None
+            meta = {"next_step": step, "pass_id": meta_pass,
+                    "epoch": epoch}
+            if self._async_ckpt is not None:
+                # NOTE the lease math: a task acks at the latest one
+                # full flush window after its write submits, so the
+                # master's timeout_s must cover saving_period steps +
+                # one checkpoint write (the per-step idle() early-ack
+                # usually settles much sooner)
+                settle_covered()                 # previous write durable
+                covered[:] = list(unacked)
+                unacked.clear()
+                meta["task_ids"] = list(covered)
                 sync_back()
+                with self._tracer.span("checkpoint_save", cat="train",
+                                       ck=ck_id):
+                    self._async_ckpt.save(
+                        save_dir, ck_id, self.parameters,
+                        opt_state=self.opt_state,
+                        model_state=self.model_state, extra_meta=meta,
+                        shard_plan=self._zero_plan, commit_hook=hook)
+                ck_id += 1
+                if final:
+                    settle_covered()
+                return
+            meta["task_ids"] = list(unacked)
+            sync_back()
+            with self._tracer.span("checkpoint_save", cat="train",
+                                   ck=ck_id):
                 ckpt.save_checkpoint(
                     save_dir, ck_id, self.parameters,
                     opt_state=self.opt_state, model_state=self.model_state,
-                    extra_meta={"next_step": step, "pass_id": meta_pass,
-                                "epoch": epoch, "task_ids": list(unacked)},
-                    shard_plan=self._zero_plan)
-                ckpt.prune_checkpoints(save_dir, keep=2)
-                ck_id += 1
+                    extra_meta=meta, shard_plan=self._zero_plan,
+                    commit_hook=hook)
+                if keep > 0:
+                    ckpt.prune_checkpoints(save_dir, keep=max(2, keep))
+            ck_id += 1
             for tid in unacked:
                 master.ack_task(tid)
             unacked.clear()
 
-        while pass_id < num_passes:
-            master.begin_pass()
-            event_handler(v2_event.BeginPass(pass_id))
-            pending_costs: List = []
-            batch_id = 0
-            epoch = 0
-            rejoined = False
-            resumed_acks = False
-            while True:
-                if not master.heartbeat(ttl_s=ttl_s):
-                    # declared dead (long GC/preemption): durable state is
-                    # required to rejoin — silently restarting the rng
-                    # stream from scratch would corrupt training
-                    enforce_that(save_dir is not None,
-                                 "elastic lease lost with no save_dir: "
-                                 "cannot resume; pass save_dir= to "
-                                 "train(master=...)", context="trainer")
-                    log.info("elastic: lease lost, re-registering")
-                    master.register(ttl_s=ttl_s)
-                    unacked.clear()
-                    step, skip_set, pass_id, ck_id = resume_state()
-                    params = self.parameters.as_dict()
-                    opt_state = self.opt_state
-                    mstate = self.model_state
-                    rejoined = True
-                    break
-                status, got = master.try_next_task()
-                if status == "done":
-                    if resumed_acks and batch_id == 0:
-                        # the only thing this pass did was ack stale tasks
-                        # from the PREVIOUS pass (crash at a pass
-                        # boundary): the queue just drained, so recycle it
-                        # and actually train this pass
-                        master.begin_pass()
-                        resumed_acks = False
-                        continue
-                    break
-                if status == "empty":
-                    # possibly blocked on our own unacked tasks: flush
-                    if unacked:
+        try:
+            while pass_id < num_passes:
+                master.begin_pass()
+                event_handler(v2_event.BeginPass(pass_id))
+                pending_costs: List = []
+                batch_id = 0
+                epoch = 0
+                rejoined = False
+                resumed_acks = False
+                while True:
+                    if not master.heartbeat(ttl_s=ttl_s):
+                        # declared dead (long GC/preemption): durable state
+                        # is required to rejoin — silently restarting the
+                        # rng stream from scratch would corrupt training
+                        enforce_that(save_dir is not None,
+                                     "elastic lease lost with no save_dir: "
+                                     "cannot resume; pass save_dir= to "
+                                     "train(master=...)", context="trainer")
+                        log.info("elastic: lease lost, re-registering")
+                        # settle the in-flight write before reloading
+                        # (racing it would read a half-commit); its
+                        # outcome is superseded by the reload either
+                        # way, so a writer error is reported, not raised
+                        self._drain_async_writer("lease lost, rejoining")
+                        master.register(ttl_s=ttl_s)
+                        unacked.clear()
+                        covered.clear()
+                        step, skip_set, pass_id, ck_id = resume_state()
+                        params = self.parameters.as_dict()
+                        opt_state = self.opt_state
+                        mstate = self.model_state
+                        rejoined = True
+                        break
+                    status, got = master.try_next_task()
+                    if status == "done":
+                        if resumed_acks and batch_id == 0:
+                            # the only thing this pass did was ack stale
+                            # tasks from the PREVIOUS pass (crash at a
+                            # pass boundary): the queue just drained, so
+                            # recycle it and actually train this pass
+                            master.begin_pass()
+                            resumed_acks = False
+                            continue
+                        break
+                    if status == "empty":
+                        # possibly blocked on our own unacked tasks: flush
+                        if unacked:
+                            flush(pass_id, epoch)
+                        elif covered and self._async_ckpt is not None:
+                            # the queue tail: only the in-flight write's
+                            # tasks are outstanding — wait it durable and
+                            # ack them, or the poll would spin forever
+                            settle_covered()
+                        else:
+                            master.poll_wait()   # jittered backoff, not a
+                        continue                 # fixed-interval hammer
+                    task_id, epoch, records = got
+                    master.poll_reset()
+                    if skip_set:
+                        if (task_id, epoch) in skip_set:
+                            # already applied inside the restored
+                            # checkpoint (crash hit between write and
+                            # ack): ack, skip
+                            skip_set.discard((task_id, epoch))
+                            log.info("elastic: task %d already in "
+                                     "checkpoint, skipping", task_id)
+                            master.ack_task(task_id)
+                            resumed_acks = True
+                            continue
+                        # requeued tasks come back FIRST; a non-match means
+                        # the remaining skip entries are stale
+                        skip_set.clear()
+                    if faults is not None:
+                        # injected clock + scheduled death BEFORE the
+                        # batch is parsed or BeginIteration fires (the
+                        # reader path's ordering: a killed step leaves
+                        # no dangling iteration span on the obs
+                        # timeline); the task stays leased-but-unacked,
+                        # so it requeues when the lease lapses
+                        faults.step_begin(step)
+                    batch = [record_parser(r) for r in records]
+                    event_handler(v2_event.BeginIteration(pass_id, batch_id))
+                    feeds = self._shard_feeds(feeder.feed(batch))
+                    with stats.timer("trainOneBatch"):
+                        if gstate is not None:
+                            gstate["inject"] = np.float32(
+                                faults.grad_inject(step)
+                                if faults is not None else 0.0)
+                            (loss, params, opt_state, mstate, metric_vals,
+                             gout) = self._step_fn(
+                                params, opt_state, mstate,
+                                jax.random.PRNGKey(step), feeds, gstate)
+                            gstate = {"inject": gstate["inject"], **gout}
+                        else:
+                            loss, params, opt_state, mstate, metric_vals = \
+                                self._step_fn(params, opt_state, mstate,
+                                              jax.random.PRNGKey(step),
+                                              feeds)
+                    metric_vals.pop("__param_stats__", None)
+                    step += 1
+                    self._global_step = step
+                    unacked.append(task_id)
+                    if len(unacked) >= saving_period:
                         flush(pass_id, epoch)
-                    else:
-                        master.poll_wait()   # jittered backoff, not a
-                    continue                 # fixed-interval hammer
-                task_id, epoch, records = got
-                master.poll_reset()
-                if skip_set:
-                    if (task_id, epoch) in skip_set:
-                        # already applied inside the restored checkpoint
-                        # (crash hit between write and ack): ack, skip
-                        skip_set.discard((task_id, epoch))
-                        log.info("elastic: task %d already in checkpoint, "
-                                 "skipping", task_id)
-                        master.ack_task(task_id)
-                        resumed_acks = True
-                        continue
-                    # requeued tasks come back FIRST; a non-match means
-                    # the remaining skip entries are stale
-                    skip_set.clear()
-                batch = [record_parser(r) for r in records]
-                event_handler(v2_event.BeginIteration(pass_id, batch_id))
-                feeds = self._shard_feeds(feeder.feed(batch))
-                with stats.timer("trainOneBatch"):
-                    loss, params, opt_state, mstate, metric_vals = \
-                        self._step_fn(params, opt_state, mstate,
-                                      jax.random.PRNGKey(step), feeds)
-                metric_vals.pop("__param_stats__", None)
-                step += 1
-                unacked.append(task_id)
-                if len(unacked) >= saving_period:
-                    flush(pass_id, epoch)
-                batch_id += 1
-                pending_costs.append(loss)  # device scalar, no sync
-                event_handler(v2_event.EndIteration(pass_id, batch_id - 1,
-                                                    loss, metric_vals))
-                if FLAGS.log_period and batch_id % FLAGS.log_period == 0:
-                    window = pending_costs[-FLAGS.log_period:]
-                    log.info("Elastic pass %d, Batch %d, Cost %.5f", pass_id,
-                             batch_id - 1,
-                             float(np.mean(np.asarray(jnp.stack(window)))))
-            if rejoined:
-                continue  # restart the (possibly different) resumed pass
-            # pass complete: flush leftovers, mark the NEXT pass durable so
-            # a crash right here doesn't re-run this pass on resume
-            pass_id += 1
-            flush(pass_id, epoch)
-            sync_back()
-            # same registry publish as the reader path: elastic passes
-            # expose their trainOneBatch timings through obs too
-            stats.timer_stats().publish(default_registry(),
-                                        prefix="trainer_")
-            if test_reader is not None:
-                tr = self.test(test_reader, feeding)
-                event_handler(v2_event.EndPass(pass_id - 1, tr.metrics,
-                                               self.parameters))
-            else:
-                event_handler(v2_event.EndPass(pass_id - 1, {},
-                                               self.parameters))
+                    elif covered and self._async_ckpt is not None \
+                            and self._async_ckpt.idle():
+                        # opportunistic early ack: the background write
+                        # already committed, so its tasks need not stay
+                        # leased until the next flush — this keeps the
+                        # unacked window near ONE saving_period (plus
+                        # actual write time) instead of two, which is
+                        # what the master's per-task timeout_s must
+                        # cover to avoid requeuing work a live trainer
+                        # already applied
+                        settle_covered()
+                    if gstate is not None:
+                        self._guard_check(gstate)
+                    batch_id += 1
+                    pending_costs.append(loss)  # device scalar, no sync
+                    event_handler(v2_event.EndIteration(
+                        pass_id, batch_id - 1, loss, metric_vals))
+                    if FLAGS.log_period and batch_id % FLAGS.log_period == 0:
+                        window = pending_costs[-FLAGS.log_period:]
+                        log.info("Elastic pass %d, Batch %d, Cost %.5f",
+                                 pass_id, batch_id - 1,
+                                 float(np.mean(np.asarray(
+                                     jnp.stack(window)))))
+                if rejoined:
+                    continue  # restart the (possibly different) pass
+                # pass complete: flush leftovers, mark the NEXT pass
+                # durable so a crash right here doesn't re-run this pass
+                # on resume (final=True drains the async pipeline — the
+                # pass boundary is a full durability point)
+                pass_id += 1
+                flush(pass_id, epoch, final=True)
+                sync_back()
+                if gstate is not None:
+                    self._flush_guard_stats(gstate)
+                # same registry publish as the reader path: elastic passes
+                # expose their trainOneBatch timings through obs too
+                stats.timer_stats().publish(default_registry(),
+                                            prefix="trainer_")
+                if test_reader is not None:
+                    tr = self.test(test_reader, feeding)
+                    event_handler(v2_event.EndPass(pass_id - 1, tr.metrics,
+                                                   self.parameters))
+                else:
+                    event_handler(v2_event.EndPass(pass_id - 1, {},
+                                                   self.parameters))
+        except BaseException:
+            # unwind (injected death, rollback, real error): let the
+            # in-flight write finish — its meta either commits (resume
+            # skips its tasks) or not (they replay) — loudly reporting
+            # any recorded writer failure instead of dropping it with
+            # this trainer object
+            self._drain_async_writer("elastic loop unwinding")
+            raise
         sync_back()
 
     def test(self, reader, feeding=None) -> v2_event.TestResult:

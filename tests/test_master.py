@@ -119,6 +119,61 @@ def test_snapshot_recover(dataset, tmp_path):
     assert t.id in ids and len(ids) == 6
 
 
+def test_corrupt_snapshot_recovers_clean(dataset, tmp_path, capsys):
+    """A torn/corrupt snapshot (truncated mid-write by a pre-hardening
+    kill, or disk damage) must rebuild the queue from a clean state —
+    loudly — instead of crashing the master at boot; the next
+    set_dataset re-partitions like a first boot."""
+    snap = str(tmp_path / "state.json")
+    svc = Service(chunks_per_task=4, snapshot_path=snap)
+    svc.set_dataset(dataset)
+    svc.get_task()
+    body = open(snap).read()
+    for garbage in (body[:len(body) // 2],    # truncated mid-write
+                    '{"todo": [',             # syntactically torn
+                    '{"done": []}'):          # valid JSON, missing keys
+        with open(snap, "w") as f:
+            f.write(garbage)
+        svc2 = Service(chunks_per_task=4, snapshot_path=snap)
+        assert "MASTER-SNAPSHOT-CORRUPT" in capsys.readouterr().out
+        assert svc2.set_dataset(dataset) == 6, "clean re-partition"
+        t = svc2.get_task()
+        assert t is not None and svc2.task_finished(t.id)
+        # the recovered service keeps snapshotting atomically: its own
+        # writes produce a loadable file again (5 todo: one task done)
+        svc3 = Service(chunks_per_task=4, snapshot_path=snap)
+        assert svc3.set_dataset(dataset) == 5  # idempotent: state kept
+        assert capsys.readouterr().out == ""
+
+
+def test_snapshot_has_no_fixed_tmp_name(dataset, tmp_path):
+    """The snapshot tempfile is unique per write (mkstemp), so two
+    services pointed at one path — or a write racing a crash-restart —
+    can never clobber each other's half-written tmp; only complete
+    renames land."""
+    snap = str(tmp_path / "state.json")
+    svc = Service(chunks_per_task=4, snapshot_path=snap)
+    svc.set_dataset(dataset)
+    assert not os.path.exists(snap + ".tmp")
+    leftovers = [f for f in os.listdir(str(tmp_path))
+                 if f.endswith(".tmp")]
+    assert leftovers == []
+
+
+def test_progress_reports_queue_position(dataset):
+    svc = Service(chunks_per_task=4)
+    assert svc.progress() == {"pass_no": 0, "todo": 0, "pending": 0,
+                              "done": 0}
+    svc.set_dataset(dataset)
+    t = svc.get_task()
+    assert svc.progress() == {"pass_no": 0, "todo": 5, "pending": 1,
+                              "done": 0}
+    svc.task_finished(t.id)
+    assert svc.progress()["done"] == 1
+    c = MasterClient(service=svc)
+    assert c.progress()["todo"] == 5
+
+
 def test_save_model_dedup():
     clock = FakeClock()
     svc = Service(time_fn=clock)

@@ -1,0 +1,492 @@
+"""Fault-tolerant training runtime (paddle_tpu.resilience, ISSUE 14).
+
+Deterministic chaos for the TRAINING side, mirroring what
+test_serving_robustness.py does for serving: every recovery path runs
+on seeded injection — no sleeps, no real kills.
+
+- TrainFaultPlan: order-independent draws, fire-once kills, the control
+  twin contract;
+- bad-step guard: in-graph skip leaves params/slots/model-state
+  bit-untouched, counters ride the lazy sync contract, ONE compile with
+  the fused reduction (sealed retrace pin), rollback hysteresis +
+  postmortem + supervisor recovery;
+- checkpoint commit protocol: kill between blob write and meta commit
+  leaves the previous checkpoint as latest; CKPT-CORRUPT fallback on
+  meta-bearing-but-torn dirs; verified-aware pruning never reaps the
+  only good artifact;
+- AsyncCheckpointer: durable pipelined writes, writer errors surface at
+  the next wait;
+- step-granular resume: reader-path kill mid-pass resumes to a
+  bit-identical trajectory (sync and async saves), elastic path ditto
+  with pipelined acks.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import checkpoint as ckpt
+from paddle_tpu.platform.enforce import EnforceError
+from paddle_tpu.resilience import (AsyncCheckpointer, BadStepGuard,
+                                   BadStepRollback, InjectedTrainerDeath,
+                                   ManualClock, TrainFaultPlan,
+                                   run_supervised)
+
+pytestmark = pytest.mark.resilience
+
+
+# ---------------------------------------------------------------------------
+# helpers — the model/dataset/snapshotters are the chaos scenario's own
+# (ONE definition of the pinned model across gate, bench and tests)
+# ---------------------------------------------------------------------------
+
+from paddle_tpu.resilience.chaos import (_build_trainer as _build,  # noqa: E402
+                                         _dataset as _data,
+                                         _slots, _snap as _params)
+
+
+def _reader(data, batch=8):
+    return paddle.batch(lambda: iter(data), batch)
+
+
+def _assert_tree_equal(a, b, msg=""):
+    assert set(a) == set(b), msg
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=f"{msg} {k}")
+
+
+# ---------------------------------------------------------------------------
+# TrainFaultPlan
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_draws_are_order_independent():
+    """Injection decisions are pure in (seed, step): a resumed run
+    re-drawing steps in any order replays the same schedule, and the
+    control twin poisons exactly the same steps."""
+    a = TrainFaultPlan(seed=7, bad_rate=0.3)
+    b = a.control_twin()
+    fwd = [a.grad_inject(s) for s in range(40)]
+    rev = [a.grad_inject(s) for s in reversed(range(40))][::-1]
+    twin = [b.grad_inject(s) for s in range(40)]
+    assert fwd == rev == twin
+    assert any(v != 0.0 for v in fwd), "rate 0.3 over 40 steps must hit"
+    assert not b.kill_at and b.kill_rate == 0.0 and not b.kill_save_at
+
+
+def test_fault_plan_kills_fire_once():
+    plan = TrainFaultPlan(kill_at={3})
+    plan.step_begin(2)
+    with pytest.raises(InjectedTrainerDeath):
+        plan.step_begin(3)
+    plan.step_begin(3)   # the resumed re-run of step 3 survives
+    plan.step_begin(4)
+
+
+def test_fault_plan_clock_and_slow_steps():
+    clk = ManualClock(tick_s=0.5)
+    plan = TrainFaultPlan(clock=clk, slow_steps={1: 4.0})
+    plan.step_begin(0)
+    assert clk() == 0.5
+    plan.step_begin(1)
+    assert clk() == 5.0
+
+
+def test_fault_plan_requires_guard_for_poison():
+    with pytest.raises(EnforceError):
+        _build(guard=None, faults=TrainFaultPlan(bad_steps={1}))
+
+
+def test_guard_rejects_nonpositive_rollback_window():
+    with pytest.raises(ValueError):
+        BadStepGuard(policy="rollback", rollback_after=0)
+
+
+# ---------------------------------------------------------------------------
+# bad-step guard
+# ---------------------------------------------------------------------------
+
+
+def test_skip_leaves_params_slots_and_state_untouched():
+    """A poisoned step is a bit-exact no-op on params, optimizer slots
+    AND the step counter — the 'NaN never poisons slots' contract."""
+    data = _data(n=24)
+    plan = TrainFaultPlan(bad_steps={1})
+    sgd = _build(guard=BadStepGuard(), faults=plan)
+    sgd.train(_reader(data), num_passes=1)
+    assert sgd.bad_steps_total == 1
+
+    # twin: identical run whose reader simply omits batch 1 — if the
+    # skipped step were anything but a bit-exact no-op (params, slots,
+    # step counter), the two trajectories would diverge
+    twin = _build(guard=BadStepGuard())
+    twin.train(paddle.batch(lambda: iter(data[0:8] + data[16:24]), 8),
+               num_passes=1)
+    _assert_tree_equal(_params(sgd), _params(twin), "params")
+    _assert_tree_equal(_slots(sgd), _slots(twin), "slots")
+    assert int(sgd.opt_state["step"]) == int(twin.opt_state["step"]) == 2
+
+
+def test_guard_max_norm_skips_finite_spikes():
+    data = _data(n=16)
+    sgd = _build(guard=BadStepGuard(max_norm=1e-9))
+    before = _params(sgd)
+    sgd.train(_reader(data), num_passes=1)
+    assert sgd.bad_steps_total == 2, "every step exceeds a 1e-9 norm cap"
+    _assert_tree_equal(_params(sgd), before, "params moved past the cap")
+
+
+def test_guarded_step_is_one_compile_under_seal():
+    """The acceptance pin: the guarded train step — fused bad-step
+    reduction included — compiles ONCE; varying the inject scalar across
+    steps (0.0 vs NaN) is a value change, not a signature change, so the
+    sealed replay adds zero compiles and zero RETRACE diagnostics."""
+    from paddle_tpu.analysis.retrace import auditor
+    from paddle_tpu.platform.flags import FLAGS
+
+    old = FLAGS.jit_audit
+    FLAGS.jit_audit = True
+    aud = auditor()
+    aud.reset()
+    try:
+        data = _data(n=16)                          # 2 steps per pass
+        plan = TrainFaultPlan(bad_steps={1, 3})     # one poison per pass
+        sgd = _build(guard=BadStepGuard(), faults=plan)
+        sgd.train(_reader(data), num_passes=1)      # warmup: compiles once
+        aud.seal("trainer.train_step")
+        # steady-state replay, INCLUDING an injection (global step 3):
+        # flipping inject 0.0 <-> NaN is a value change, never a compile
+        sgd.train(_reader(data), num_passes=1)
+        assert aud.compile_count("trainer.train_step") == 1
+        aud.assert_no_retraces()
+        assert sgd.bad_steps_total == 2
+    finally:
+        FLAGS.jit_audit = old
+        aud.reset()
+
+
+def test_rollback_policy_raises_and_dumps_postmortem(tmp_path, capsys):
+    from paddle_tpu.obs.trace import Tracer
+
+    data = _data(n=40)
+    # a persistent bad window >= K
+    plan = TrainFaultPlan(bad_steps={1, 2, 3})
+    tracer = Tracer(time_fn=lambda: 0.0)
+    sgd = _build(guard=BadStepGuard(policy="rollback", rollback_after=3,
+                                    check_every=1),
+                 faults=plan, tracer=tracer)
+    with pytest.raises(BadStepRollback):
+        sgd.train(_reader(data), num_passes=1)
+    out = capsys.readouterr().out
+    assert "OBS-POSTMORTEM" in out
+    names = [e.name for e in tracer.events] + [e.name for e in tracer.ring]
+    assert "bad_step_rollback" in names
+
+
+def test_supervisor_recovers_from_rollback(tmp_path):
+    """Rollback-to-last-good end to end: the supervisor restarts from
+    the newest verified checkpoint; once the transient fault window is
+    cleared (on_restart), the run completes with finite params."""
+    data = _data(n=40)
+    plan = TrainFaultPlan(bad_steps={2, 3, 4})
+    save = str(tmp_path / "ck")
+
+    def attempt(i):
+        sgd = _build(guard=BadStepGuard(policy="rollback",
+                                        rollback_after=3, check_every=1),
+                     faults=plan)
+        sgd.train(_reader(data), num_passes=2, save_dir=save,
+                  save_period_steps=2, resume=True, async_save=False)
+        return sgd
+
+    def clear_fault(attempt_no, exc):
+        plan.bad_steps.clear()   # the glitch passed
+
+    report, sgd = run_supervised(attempt, max_restarts=3,
+                                 on_restart=clear_fault)
+    assert report.completed and report.rollbacks == 1
+    for k, v in _params(sgd).items():
+        assert np.isfinite(v).all(), k
+
+
+# ---------------------------------------------------------------------------
+# checkpoint commit protocol + graceful degradation
+# ---------------------------------------------------------------------------
+
+
+def _save_n(root, n, seed=5):
+    sgd = _build(seed=seed)
+    for i in range(n):
+        ckpt.save_checkpoint(str(root), i, sgd.parameters,
+                             opt_state=sgd.opt_state,
+                             model_state=sgd.model_state,
+                             extra_meta={"tag": i})
+    return sgd
+
+
+def test_kill_between_blob_and_meta_keeps_previous_latest(tmp_path):
+    sgd = _save_n(tmp_path, 1)
+
+    class Boom(RuntimeError):
+        pass
+
+    def hook(phase):
+        if phase == "meta":
+            raise Boom()
+
+    with pytest.raises(Boom):
+        ckpt.save_checkpoint(str(tmp_path), 1, sgd.parameters,
+                             opt_state=sgd.opt_state, commit_hook=hook)
+    # both blobs of pass-00001 are durable, meta is not: every reader
+    # must keep treating pass-00000 as latest, silently (no corruption)
+    assert os.path.exists(ckpt.pass_dir(str(tmp_path), 1) + "/state.pkl")
+    assert not os.path.exists(ckpt.pass_dir(str(tmp_path), 1)
+                              + "/meta.json")
+    assert ckpt.latest_pass(str(tmp_path)) == 0
+    _, _, _, meta = ckpt.load_checkpoint(str(tmp_path))
+    assert meta["tag"] == 0
+    assert ckpt.verify_pass_dir(str(tmp_path), 1) == "missing meta.json"
+
+
+def test_load_latest_falls_back_over_corrupt_dirs(tmp_path, capsys):
+    _save_n(tmp_path, 3)
+    # newest: torn blob (the kill-mid-prune / partial-copy case)
+    os.remove(ckpt.pass_dir(str(tmp_path), 2) + "/state.pkl")
+    # middle: flipped bytes (md5 mismatch)
+    with open(ckpt.pass_dir(str(tmp_path), 1) + "/params.tar", "r+b") as f:
+        f.seek(40)
+        f.write(b"XXXX")
+    _, _, _, meta = ckpt.load_checkpoint(str(tmp_path))   # pass_id=None
+    assert meta["tag"] == 0, "must fall back to the oldest intact dir"
+    out = capsys.readouterr().out
+    assert out.count("CKPT-CORRUPT") == 2
+    assert "missing state.pkl" in out and "md5 mismatch" in out
+
+
+def test_explicit_corrupt_load_raises_with_tag(tmp_path, capsys):
+    _save_n(tmp_path, 1)
+    with open(ckpt.pass_dir(str(tmp_path), 0) + "/state.pkl", "r+b") as f:
+        f.write(b"ZZ")
+    with pytest.raises(EnforceError, match="CKPT-CORRUPT"):
+        ckpt.load_checkpoint(str(tmp_path), 0)
+
+
+def test_prune_never_reaps_newest_verified(tmp_path):
+    """Two corrupt young dirs must not count toward keep: the only good
+    artifact survives pruning."""
+    _save_n(tmp_path, 3)
+    for pid in (1, 2):
+        with open(ckpt.pass_dir(str(tmp_path), pid) + "/params.tar",
+                  "r+b") as f:
+            f.seek(10)
+            f.write(b"CORRUPT!")
+    ckpt.prune_checkpoints(str(tmp_path), keep=2)
+    assert ckpt.verify_pass_dir(str(tmp_path), 0) is None, \
+        "the only verified checkpoint was reaped"
+    # and with enough verified dirs, old ones (corrupt or not) go
+    _save_n(tmp_path, 5)
+    ckpt.prune_checkpoints(str(tmp_path), keep=2)
+    left = sorted(os.listdir(str(tmp_path)))
+    assert left == ["pass-00003", "pass-00004"]
+
+
+def test_async_checkpointer_durability_and_error_surface(tmp_path):
+    sgd = _build()
+    ck = AsyncCheckpointer(keep=0)
+    ck.save(str(tmp_path), 0, sgd.parameters, opt_state=sgd.opt_state)
+    ck.wait()
+    assert ck.commits == 1
+    assert ckpt.verify_pass_dir(str(tmp_path), 0) is None
+
+    def hook(phase):
+        if phase == "state":
+            raise InjectedTrainerDeath("writer killed")
+
+    ck.save(str(tmp_path), 1, sgd.parameters, commit_hook=hook)
+    with pytest.raises(InjectedTrainerDeath):
+        ck.wait()
+    ck.wait()   # error is consumed, not sticky
+    assert ckpt.latest_pass(str(tmp_path)) == 0
+
+
+# ---------------------------------------------------------------------------
+# step-granular resume parity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("async_save", [False, True],
+                         ids=["sync", "async"])
+def test_midpass_kill_resume_bit_identical(tmp_path, async_save):
+    """Kill mid-pass between step checkpoints; resume re-runs the lost
+    window with the restored rng + data cursor: final params are
+    BIT-identical to an uninterrupted run (the elastic determinism bar
+    on the reader path)."""
+    data = _data(n=48)                       # 6 steps/pass
+    ref = _build()
+    ref.train(_reader(data), num_passes=2)
+
+    save = str(tmp_path / f"ck_{async_save}")
+    plan = TrainFaultPlan(kill_at={4, 9})
+
+    def attempt(i):
+        sgd = _build(faults=plan)
+        sgd.train(_reader(data), num_passes=2, save_dir=save,
+                  save_period_steps=2, resume=True, async_save=async_save)
+        return sgd
+
+    report, got = run_supervised(attempt, max_restarts=4)
+    assert report.deaths == 2
+    _assert_tree_equal(_params(got), _params(ref), "resume parity")
+    _assert_tree_equal(_slots(got), _slots(ref), "slot parity")
+
+
+def test_async_save_false_overrides_previous_async_train(tmp_path):
+    """A later train(async_save=False) on the SAME trainer must not
+    silently keep using the previous call's background writer (or its
+    old keep budget): the checkpointer is rebuilt per call."""
+    data = _data(n=16)
+    save = str(tmp_path / "ck")
+    sgd = _build()
+    sgd.train(_reader(data), num_passes=1, save_dir=save,
+              save_period_steps=1, resume=True, async_save=True)
+    assert sgd._async_ckpt is not None
+    sgd.train(_reader(data), num_passes=1, save_dir=save,
+              save_period_steps=1, resume=True, async_save=False)
+    assert sgd._async_ckpt is None, "stale async writer leaked"
+    assert ckpt.load_latest(save) is not None
+
+
+def test_exact_boundary_resume_does_not_refire_pass_events(tmp_path):
+    """A torn PASS-END save leaves the cursor at (p, steps_per_pass):
+    the resumed run must not replay an empty pass p — no duplicate
+    BeginPass/EndPass with zeroed metrics — it repairs the boundary
+    cursor and continues at pass p+1, bit-identical to a straight run."""
+    data = _data(n=48)                      # 6 steps/pass
+    save = str(tmp_path / "ck")
+    # saves: ck0 after b2, ck1 after b5 (cursor (0, 6) — the exact
+    # boundary), then the pass-end ck2 dies between state and meta
+    plan = TrainFaultPlan(kill_save_at={2: "meta"})
+    sgd_a = _build(faults=plan)
+    with pytest.raises(InjectedTrainerDeath):
+        sgd_a.train(_reader(data), num_passes=2, save_dir=save,
+                    save_period_steps=3, resume=True, async_save=False)
+
+    events = []
+
+    def rec(ev):
+        if isinstance(ev, (paddle.event.BeginPass, paddle.event.EndPass)):
+            events.append((type(ev).__name__, ev.pass_id))
+
+    sgd_b = _build()
+    sgd_b.train(_reader(data), num_passes=2, save_dir=save,
+                save_period_steps=3, resume=True, async_save=False,
+                event_handler=rec)
+    assert events == [("BeginPass", 1), ("EndPass", 1)], events
+    ref = _build()
+    ref.train(_reader(data), num_passes=2)
+    _assert_tree_equal(_params(sgd_b), _params(ref), "boundary resume")
+
+
+def test_resume_and_start_pass_are_exclusive(tmp_path):
+    sgd = _build()
+    with pytest.raises(EnforceError):
+        sgd.train(_reader(_data()), num_passes=2, resume=True,
+                  start_pass=1, save_dir=str(tmp_path))
+    # silently ignoring these would restart a supervised run from
+    # scratch on every death — they must error like the elastic path
+    with pytest.raises(EnforceError):
+        sgd.train(_reader(_data()), num_passes=1, resume=True)
+    with pytest.raises(EnforceError):
+        sgd.train(_reader(_data()), num_passes=1, save_period_steps=2)
+
+
+# ---------------------------------------------------------------------------
+# elastic path: injected deaths + pipelined async acks
+# ---------------------------------------------------------------------------
+
+
+def _write_recordio(tmp_path, data):
+    from paddle_tpu.master.recordio import recordio_write
+
+    p = str(tmp_path / "train.recordio")
+    recordio_write(p, [(",".join(f"{v:.6f}" for v in x) + f"|{y}").encode()
+                       for x, y in data])
+    return p
+
+
+def _parse(rec):
+    xs, label = rec.decode().split("|")
+    return (np.asarray([float(v) for v in xs.split(",")], np.float32),
+            int(label))
+
+
+def test_elastic_injected_death_resume_parity_async(tmp_path):
+    """The kill/resume e2e driven by a TrainFaultPlan instead of an
+    event-handler crash, with ASYNC pipelined checkpoints: acks only
+    ever cover durable writes, so the replacement trainer's final params
+    equal a straight run's."""
+    from paddle_tpu.master.client import MasterClient
+    from paddle_tpu.master.service import Service
+
+    class Clock:
+        t = 1000.0
+
+        def __call__(self):
+            return self.t
+
+    clk = Clock()
+    data = _data(n=64, seed=3)
+    path = _write_recordio(tmp_path, data)
+
+    def fresh():
+        svc = Service(chunks_per_task=8, timeout_s=1e6, time_fn=clk)
+        svc.set_dataset([path])              # 8 tasks
+        return svc
+
+    ref = _build(seed=9)
+    ref.train(master=MasterClient(service=fresh()), record_parser=_parse,
+              num_passes=1, heartbeat_ttl_s=1e9)
+
+    svc = fresh()
+    save = str(tmp_path / "ck")
+    plan = TrainFaultPlan(kill_at={5})
+    sgd_a = _build(seed=9, faults=plan)
+    with pytest.raises(InjectedTrainerDeath):
+        sgd_a.train(master=MasterClient(service=svc), record_parser=_parse,
+                    num_passes=1, save_dir=save, heartbeat_ttl_s=10.0,
+                    saving_period=2, async_save=True)
+    assert svc.progress()["pending"] > 0, "the dead trainer holds tasks"
+    clk.t += 11.0                            # lease lapses -> requeue
+
+    sgd_b = _build(seed=9)
+    sgd_b.train(master=MasterClient(service=svc), record_parser=_parse,
+                num_passes=1, save_dir=save, heartbeat_ttl_s=1e9,
+                saving_period=2, async_save=True)
+    _assert_tree_equal(_params(sgd_b), _params(ref), "elastic parity")
+    prog = svc.progress()
+    assert prog["pending"] == 0 and prog["todo"] == 0
+
+
+# ---------------------------------------------------------------------------
+# seeded chaos acceptance replay (the bench/gate scenario, pinned here)
+# ---------------------------------------------------------------------------
+
+
+def test_seeded_chaos_acceptance(tmp_path):
+    from paddle_tpu.resilience.chaos import seeded_chaos, torn_save_probe
+
+    out = seeded_chaos(str(tmp_path / "chaos"))
+    assert out["problems"] == []
+    assert out["train_chaos_parity_ok"] == 1
+    assert out["train_chaos_deaths"] == 4
+    assert out["train_chaos_ckpt_corrupt_surviving"] == 0
+    probe = torn_save_probe(str(tmp_path / "torn"))
+    assert probe["problems"] == [] and probe["torn_save_ok"] == 1
+    # the recovery history landed on the unified scrape surface
+    from paddle_tpu.obs import default_registry
+
+    snap = default_registry().snapshot()
+    assert snap.get("train_supervised_restarts{kind=death}") == 4.0
+    assert snap.get("train_supervised_completed") == 1.0

@@ -126,4 +126,26 @@ elif [ "$shard_rc" -ne 0 ]; then
     print_postmortems
     exit 9
 fi
+# checkpoint/resume chaos gate (paddle_tpu.resilience): replays the
+# seeded kill+NaN+slow+torn-save training chaos plan under the resume
+# supervisor and checks every invariant — final params bit-identical to
+# the uninterrupted control, every death resumed from a verified
+# checkpoint, injected non-finite steps skipped with optimizer slots
+# untouched, zero CKPT-CORRUPT on surviving artifacts, and a kill
+# between blob write and meta commit leaving the previous checkpoint
+# loadable.  Exit 10 extends the ladder (3/4/5/6/7/8/9); same contract
+# as the lint/fleet/xla/shard gates: branch on the checker's OWN exit
+# status (findings=1, crash=2), never on a grep of the shared log —
+# tests intentionally corrupt checkpoints and print CKPT-CORRUPT lines.
+env JAX_PLATFORMS=cpu python -m paddle_tpu.resilience check 2>&1 | tee -a /tmp/_t1.log
+resil_rc=${PIPESTATUS[0]}
+if [ "$resil_rc" -eq 1 ]; then
+    echo 'CKPT-CORRUPT: training checkpoint/resume chaos invariants violated (see log above)'
+    print_postmortems
+    exit 10
+elif [ "$resil_rc" -ne 0 ]; then
+    echo "CKPT-CORRUPT: resilience checker itself exited $resil_rc without running to completion"
+    print_postmortems
+    exit 10
+fi
 exit $rc
