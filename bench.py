@@ -1300,6 +1300,124 @@ def worker_serving_tp():
     print(json.dumps(out), flush=True)
 
 
+def worker_serving_spec():
+    """Speculative decoding A/B (round 18): a CHATTY Poisson trace —
+    short repetitive prompts (a shared greeting + a repeated phrase),
+    short replies — replayed THREE times on one injected clock:
+    spec-off (control), n-gram/prompt-lookup speculation, and
+    draft-model speculation (a 1-layer draft with its own paged pool).
+    All greedy, so the control IS the oracle trajectory.
+
+    Asserts, not just reports: the n-gram replay is token-identical to
+    the spec-off control; decode ticks per emitted token drop >= 1.5x
+    under n-gram speculation at the measured acceptance rate; and all
+    three replays drain with 0 page/ref leaks (draft pool included).
+    Wall-clock tokens/s is CPU PROXY ONLY; ticks-per-token, acceptance
+    rate and TTFT replay bit-identically on the injected clock."""
+    import numpy as np
+
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import (DecoderLM, FaultPlan, ManualClock,
+                                    RequestStatus, ServingEngine)
+
+    paddle.init()
+    rng = np.random.RandomState(0)
+    vocab, eos, gen = 512, 1, 24
+    model = DecoderLM(vocab_size=vocab, num_layers=2, num_heads=2,
+                      head_dim=16, max_positions=256)
+    params = model.init_params(jax.random.PRNGKey(0))
+    # the draft: a 1-layer model wearing the target's embeddings, first
+    # layer and head — the "distilled draft" stand-in (random draft
+    # weights would accept ~nothing and say nothing about the machinery)
+    draft = DecoderLM(vocab_size=vocab, num_layers=1, num_heads=2,
+                      head_dim=16, max_positions=256)
+    dparams = {k: params[k] for k in
+               ("emb", "pos", "out", "l0.wq", "l0.wk", "l0.wv",
+                "l0.wo", "l0.w1", "l0.w2")}
+    n_req, rate = 24, 50.0
+    greeting = rng.randint(2, vocab, size=6).tolist()
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, n_req))
+    prompts = []
+    for _ in range(n_req):
+        phrase = rng.randint(2, vocab, size=3).tolist()
+        prompts.append(greeting + phrase * 3 +
+                       rng.randint(2, vocab, size=2).tolist())
+
+    def replay(mode, **kw):
+        clock = ManualClock(tick_s=0.02)
+        eng = ServingEngine(model, params, eos_id=eos, page_size=16,
+                            num_pages=96, max_pages_per_seq=8,
+                            max_slots=8, buckets=(16, 32),
+                            spec_mode=mode, spec_k=4,
+                            faults=FaultPlan(clock=clock), **kw)
+        rids = [None] * n_req
+        i = 0
+        t0 = time.monotonic()
+        while i < n_req or eng.has_work:
+            while i < n_req and arrivals[i] <= clock():
+                rids[i] = eng.submit(prompts[i], max_tokens=gen)
+                i += 1
+            eng.step()
+            assert eng.metrics.ticks < 5000, "spec trace failed to drain"
+        wall = time.monotonic() - t0
+        eng.run(max_ticks=1)          # drained: conservation check
+        assert all(eng.status(r) is RequestStatus.COMPLETED for r in rids)
+        assert eng.pool.total_refs == 0, "page refs leaked"
+        snap = eng.metrics.snapshot()
+        results = [eng.result(r) for r in rids]
+        # decode ticks per emitted decode token: each request's verify-
+        # tick participations (decode_slots: one per running slot per
+        # step) over the tokens those ticks emitted (first tokens come
+        # from prefill, not a decode tick)
+        decode_tokens = snap["tokens_generated"] - len(rids)
+        tpt = snap["decode_slots"] / max(1, decode_tokens)
+        return results, snap, tpt, wall
+
+    outs_off, snap_off, tpt_off, wall_off = replay("off")
+    outs_ng, snap_ng, tpt_ng, wall_ng = replay("ngram")
+    outs_dr, snap_dr, tpt_dr, wall_dr = replay(
+        "draft", draft_model=draft, draft_params=dparams)
+
+    assert outs_ng == outs_off, "ngram speculation broke greedy parity"
+    assert outs_dr == outs_off, "draft speculation broke greedy parity"
+    assert snap_ng["spec_tokens_accepted"] > 0
+    reduction = tpt_off / max(tpt_ng, 1e-9)
+    assert reduction >= 1.5, (
+        f"decode ticks/token only improved {reduction:.2f}x "
+        f"(acceptance {snap_ng['spec_acceptance_rate']})")
+
+    out = {
+        "serving_spec_model": "decoderlm_L2_H2_D16_v512_page16_pool96"
+                              "_slots8_chatty24_k4",
+        "serving_spec_ticks_per_token_off": round(tpt_off, 4),
+        "serving_spec_ticks_per_token_ngram": round(tpt_ng, 4),
+        "serving_spec_ticks_per_token_draft": round(tpt_dr, 4),
+        "serving_spec_reduction_ngram": round(reduction, 4),
+        "serving_spec_acceptance_ngram": snap_ng["spec_acceptance_rate"],
+        "serving_spec_acceptance_draft": snap_dr["spec_acceptance_rate"],
+        "serving_spec_rollbacks_ngram": snap_ng["spec_rollbacks"],
+        "serving_spec_suspended_ngram": snap_ng["spec_suspended"],
+        "serving_spec_draft_steps": snap_dr["draft_steps"],
+        "serving_spec_draft_time_s": snap_dr["draft_time_s"],
+        "serving_spec_tokens_per_s_off": round(
+            snap_off["tokens_generated"] / max(wall_off, 1e-9), 2),
+        "serving_spec_tokens_per_s_ngram": round(
+            snap_ng["tokens_generated"] / max(wall_ng, 1e-9), 2),
+        "serving_spec_tokens_per_s_draft": round(
+            snap_dr["tokens_generated"] / max(wall_dr, 1e-9), 2),
+        "serving_spec_ttft_ms_p95_off": snap_off["ttft_ms_p95"],
+        "serving_spec_ttft_ms_p95_ngram": snap_ng["ttft_ms_p95"],
+        "serving_spec_ticks_off": snap_off["ticks"],
+        "serving_spec_ticks_ngram": snap_ng["ticks"],
+        "serving_spec_completed": snap_ng["requests_completed"],
+        "serving_spec_parity_ok": int(outs_ng == outs_off
+                                      and outs_dr == outs_off),
+    }
+    print(json.dumps(out), flush=True)
+
+
 def _tp_page_bytes(model):
     """f32 bytes one tp=1 page costs for ``model`` at page 16 — the
     per-chip pool budget unit worker_serving_tp sizes with."""
@@ -1709,6 +1827,7 @@ WORKERS = {
     "serving_chaos": worker_serving_chaos,
     "serving_prefix": worker_serving_prefix,
     "serving_mixed": worker_serving_mixed,
+    "serving_spec": worker_serving_spec,
     "serving_tp": worker_serving_tp,
     "serving_fleet": worker_serving_fleet,
     "train_chaos": worker_train_chaos,
@@ -1797,7 +1916,8 @@ def main():
 
     # cheap + hardware-independent first: never starved by a dead tunnel
     for cpu_worker in ("scaling", "zero1", "serving", "serving_chaos",
-                       "serving_prefix", "serving_mixed", "serving_tp",
+                       "serving_prefix", "serving_mixed", "serving_spec",
+                       "serving_tp",
                        "serving_fleet", "train_chaos"):
         out, err = _run_worker(cpu_worker, deadline, cpu=True,
                                attempt_timeout=380, max_attempts=1)

@@ -68,7 +68,8 @@ from paddle_tpu.analysis.retrace import (CapturedCall, SiteContract,
                                          SiteRecord, auditor)
 
 __all__ = ["audit_sites", "audit_record", "estimate_jaxpr", "SiteReport",
-           "RULES", "drive_serving_steady_state", "drive_trainer_step",
+           "RULES", "drive_serving_steady_state",
+           "drive_serving_spec_steady_state", "drive_trainer_step",
            "run_compiled_path_audit"]
 
 TAG = "XLA-AUDIT"
@@ -673,6 +674,45 @@ def drive_serving_steady_state(kv_dtype: str = "int8", seal: bool = True):
     return eng
 
 
+def drive_serving_spec_steady_state(seal: bool = True):
+    """The speculative-decoding steady state (round 18): an n-gram
+    speculating engine (``spec_mode='ngram'``) runs a repetitive trace
+    so the widened ``serving.step`` — each slot contributing ``k+1``
+    verify rows — compiles, accepts, rejects and rolls back for real,
+    then (sealed) replays the same shape: the audit proves speculation
+    adds the ``k`` dimension to the (bucket, k1) jit ladder and nothing
+    else, under the SAME step contract.  Requires ``FLAGS.jit_audit``
+    on before the call.  Returns the engine."""
+    import jax
+    import numpy as np
+
+    from paddle_tpu.serving import DecoderLM, ServingEngine
+
+    model = DecoderLM(vocab_size=50, num_layers=2, num_heads=2,
+                      head_dim=8, max_positions=128)
+    params = model.init_params(jax.random.PRNGKey(0))
+    eng = ServingEngine(model, params, eos_id=1, page_size=4,
+                        num_pages=64, max_pages_per_seq=12, max_slots=4,
+                        buckets=(4, 8, 16), prefill_chunk=8,
+                        spec_mode="ngram", spec_k=3)
+    rng = np.random.RandomState(0)
+    phrase = rng.randint(2, 50, size=4).tolist()
+
+    def burst():
+        # repetitive prompts: the n-gram proposer finds real matches,
+        # so accept AND reject/rollback paths both execute
+        eng.submit(phrase * 3, max_tokens=10)
+        eng.step()
+        eng.submit(rng.randint(2, 50, size=6).tolist(), max_tokens=8)
+        eng.run(max_ticks=300)
+
+    burst()
+    if seal:
+        auditor().seal()
+        burst()                       # steady state: no new compiles
+    return eng
+
+
 def drive_trainer_step(batches: int = 2, batch_size: int = 16):
     """One tiny fc-classifier training pass (the ``trainer.train_step``
     site, donation contract (0, 1, 2)) plus one test pass (the
@@ -725,6 +765,9 @@ def run_compiled_path_audit(printer: Callable[[str], None] = print,
     aud.reset()
     try:
         eng = drive_serving_steady_state(seal=False)
+        # the widened speculative step (k+1 verify rows per slot) rides
+        # the same serving.step contract — audit it in the gate too
+        drive_serving_spec_steady_state(seal=False)
         drive_trainer_step()
         aud.seal()
         # sealed steady-state replay (fresh traffic, same buckets)

@@ -173,6 +173,35 @@ FLAGS.define("serving_kv_dtype", "float32",
              "dtypes admit proportionally more pages, which multiplies "
              "prefix-cache capacity and admissible concurrency. "
              "Per-engine override: ServingEngine(kv_dtype=...).")
+FLAGS.define("serving_spec_mode", "off",
+             "speculative decoding: off | ngram | draft. 'ngram' drafts "
+             "by prompt-lookup (match the last serving_spec_ngram "
+             "tokens of a slot's own prompt+output history against "
+             "earlier occurrences and propose what followed — zero "
+             "extra model cost); 'draft' runs a small draft DecodeModel "
+             "(ServingEngine(draft_model=, draft_params=)) with its own "
+             "paged KV pool. Either way ONE fused target-model step "
+             "verifies all k+1 positions per slot per tick (speculative "
+             "slots contribute k+1 rows instead of 1), the longest "
+             "agreeing prefix is accepted (greedy: exact match; "
+             "sampled: rejection sampling against the target "
+             "distribution) and rejected tokens roll back via COW page "
+             "forks, so greedy output stays token-identical to "
+             "non-speculative decoding. Per-engine override: "
+             "ServingEngine(spec_mode=...).")
+FLAGS.define("serving_spec_k", 4,
+             "speculation depth: drafted tokens per slot per tick. The "
+             "verify step compiles once per (prefill_bucket, k+1) pair "
+             "— k is a jit dimension, so keep it fixed per engine. "
+             "Lookahead KV pages are charged opportunistically (never "
+             "by preemption) and speculation is suspended per-slot "
+             "under page pressure. Per-engine override: "
+             "ServingEngine(spec_k=...).", parser=int)
+FLAGS.define("serving_spec_ngram", 3,
+             "n-gram size of the prompt-lookup proposer: the longest "
+             "history suffix matched against earlier history (falls "
+             "back to shorter suffixes down to 1). Per-engine override: "
+             "ServingEngine(spec_ngram=...).", parser=int)
 FLAGS.define("serving_queue_deadline_s", 0.0,
              "default per-request admission deadline: a request still "
              "queued this many seconds after submit is shed as TIMED_OUT "

@@ -13,6 +13,9 @@ from paddle_tpu.serving.decode_attention import (
     ragged_paged_attention_reference, ragged_paged_attention_tp)
 from paddle_tpu.serving.engine import (DecodeModel, DecoderLM, ServingEngine,
                                        greedy_decode_reference, validate_tp)
+from paddle_tpu.serving.speculate import (DraftProposer, NGramProposer,
+                                          SamplingParams, accept_tokens,
+                                          next_token, warp_probs)
 from paddle_tpu.serving.faults import (FaultPlan, FleetFaultPlan,
                                        InjectedDeviceError, ManualClock,
                                        PageLeakError)
@@ -45,4 +48,6 @@ __all__ = [
     "FaultPlan", "FleetFaultPlan", "ManualClock", "InjectedDeviceError",
     "PageLeakError",
     "FleetRouter", "Replica", "ReplicaState",
+    "SamplingParams", "NGramProposer", "DraftProposer", "accept_tokens",
+    "next_token", "warp_probs",
 ]

@@ -526,21 +526,35 @@ def paged_decode_attention_reference(q, k_pages, v_pages, page_table,
         k_scale=k_scale, v_scale=v_scale, sm_scale=sm_scale)
 
 
-def expand_decode_rows(q, qpos):
-    """Pad one-row-per-sequence decode queries to one :data:`BLOCK_ROWS`
-    block each — THE one copy of the kernel's one-sequence-per-block
+def expand_decode_rows(q, qpos, rows_per_seq: int = 1):
+    """Pad per-sequence decode/verify rows to whole :data:`BLOCK_ROWS`
+    blocks — THE one copy of the kernel's one-sequence-per-block
     packing for decode rows (the decode wrapper and the engine's
     unified step both build on it, so the contract can't silently fork).
-    Rows 0 mod BLOCK_ROWS are real, the rest padding (qpos −1).
-    Returns ``(q_expanded, row_seq, qpos_expanded)``; sequence ``i`` is
-    row block ``i``, so callers slice results back with
-    ``[::BLOCK_ROWS]``."""
-    b, h, d = q.shape
-    t = b * BLOCK_ROWS
-    qe = jnp.zeros((t, h, d), q.dtype).at[::BLOCK_ROWS].set(q)
-    row_seq = jnp.repeat(jnp.arange(b, dtype=jnp.int32), BLOCK_ROWS)
-    qp = jnp.full((t,), -1, jnp.int32).at[::BLOCK_ROWS].set(
-        qpos.astype(jnp.int32))
+
+    ``q`` is ``[B * rows_per_seq, H, D]`` sequence-major: sequence
+    ``i`` owns rows ``i*rows_per_seq .. (i+1)*rows_per_seq - 1``
+    (plain decode passes 1 row per sequence; a speculative verify
+    passes ``k+1``).  Each sequence's rows pad up to
+    ``ceil(rows_per_seq / BLOCK_ROWS) * BLOCK_ROWS`` rows (padding
+    qpos −1), so every aligned block stays single-sequence no matter
+    the speculation depth.  Returns ``(q_expanded, row_seq,
+    qpos_expanded)``; callers slice results back by reshaping to
+    ``[B, padded_rows, ...]`` and taking ``[:, :rows_per_seq]`` (for
+    ``rows_per_seq == 1`` that is the historical ``[::BLOCK_ROWS]``)."""
+    rps = int(rows_per_seq)
+    bt, h, d = q.shape
+    b = bt // rps
+    rbk = -(-rps // BLOCK_ROWS) * BLOCK_ROWS
+    row_seq = jnp.repeat(jnp.arange(b, dtype=jnp.int32), rbk)
+    if rbk == rps:
+        return q, row_seq, qpos.astype(jnp.int32)
+    pad = rbk - rps
+    qe = jnp.pad(q.reshape(b, rps, h, d),
+                 ((0, 0), (0, pad), (0, 0), (0, 0))).reshape(b * rbk, h, d)
+    qp = jnp.pad(qpos.astype(jnp.int32).reshape(b, rps),
+                 ((0, 0), (0, pad)),
+                 constant_values=-1).reshape(b * rbk)
     return qe, row_seq, qp
 
 

@@ -28,8 +28,19 @@ v1 engine paid two dispatches and two softmax passes per tick with
 in-flight prefill.  ``fuse_tick=False`` keeps the v1 two-dispatch
 shape as a bench control (same math, token-identical).
 
-Decoding is greedy (argmax) — the deterministic contract the parity
-tests pin; sampling policies layer on top later.
+Decoding is greedy (argmax) by default — the deterministic contract
+the parity tests pin.  ``submit(..., sampling=SamplingParams(...))``
+turns on real sampling (temperature/top-k/top-p with seeded
+per-position RNG streams, bit-reproducible across replays), and
+``spec_mode="ngram"|"draft"`` (round 18) turns on speculative
+decoding: a proposer drafts up to ``spec_k`` tokens per slot per
+tick, the SAME unified step verifies all ``k+1`` positions per slot
+(the jit ladder gains the ``k`` dimension: one compile per
+``(prefill_bucket, k+1)`` pair), the longest agreeing prefix is
+accepted — greedy stays token-identical to the oracle — and rejected
+tokens roll back via COW-guarded page forks plus
+``scheduler.rollback_pages``, so speculation composes with prefix
+caching without ever dirtying a shared page.
 
 Robustness layer (round 8): every request moves through a real
 :class:`RequestStatus` lifecycle with optional queue/total deadlines and
@@ -104,15 +115,21 @@ from paddle_tpu.serving.kv_cache import (NULL_PAGE, KVPages, PagedKVConfig,
                                          PagePool, PrefixCache, append_token,
                                          fork_page, init_kv_pages,
                                          kv_pool_specs, pages_for_budget,
-                                         resolve_kv_dtype, zero_pages)
+                                         pages_spanned, resolve_kv_dtype,
+                                         zero_pages)
 from paddle_tpu.serving.metrics import ServingMetrics
+from paddle_tpu.serving.speculate import (DraftProposer, NGramProposer,
+                                          SamplingParams, accept_tokens,
+                                          next_token)
 from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
                                           Request, RequestStatus,
                                           SchedulerConfig, bucket_for,
                                           pack_prefill_chunks)
 
-__all__ = ["DecodeModel", "DecoderLM", "ServingEngine",
+__all__ = ["DecodeModel", "DecoderLM", "SamplingParams", "ServingEngine",
            "greedy_decode_reference", "validate_tp"]
+
+_SPEC_MODES = ("off", "ngram", "draft")
 
 
 class DecodeModel:
@@ -403,6 +420,11 @@ class ServingEngine:
                  time_fn: Optional[Callable[[], float]] = None,
                  tracer=None, registry: Optional[MetricsRegistry] = None,
                  mesh=None, tp_axis: str = "model",
+                 spec_mode: Optional[str] = None,
+                 spec_k: Optional[int] = None,
+                 spec_ngram: Optional[int] = None,
+                 draft_model=None, draft_params=None,
+                 draft_pool_pages: Optional[int] = None,
                  xla_peak_bytes: Optional[int] = None,
                  xla_flops: Optional[float] = None,
                  xla_comm_bytes: Optional[float] = None):
@@ -564,6 +586,44 @@ class ServingEngine:
             else self.kv_cfg.max_seq_len
         chunk_rows = -(-chunk_rows // self._row_align) * self._row_align
         self._prefill_budget = max(top, chunk_rows)
+        # speculative decoding (round 18): a proposer drafts up to
+        # spec_k tokens per running slot per tick and ONE widened step
+        # verifies all k+1 positions (each speculative slot contributes
+        # k+1 rows instead of 1), accepting the longest agreeing prefix
+        # and rolling rejected tokens back via COW-guarded page forks.
+        # k+1 is a jit dimension: the step ladder is keyed
+        # (prefill_bucket, k1), one compile per pair.
+        self.spec_mode = str(spec_mode if spec_mode is not None
+                             else FLAGS.serving_spec_mode)
+        enforce_that(self.spec_mode in _SPEC_MODES,
+                     f"spec_mode must be one of {_SPEC_MODES}, got "
+                     f"{self.spec_mode!r}", context="serving-spec")
+        self.spec_k = int(spec_k if spec_k is not None
+                          else FLAGS.serving_spec_k)
+        enforce_that(self.spec_mode == "off" or self.spec_k >= 1,
+                     "spec_k must be >= 1 when speculation is on",
+                     context="serving-spec")
+        self._proposer = None
+        if self.spec_mode == "ngram":
+            self._proposer = NGramProposer(n=spec_ngram)
+        elif self.spec_mode == "draft":
+            enforce_that(
+                draft_model is not None and draft_params is not None,
+                "spec_mode='draft' needs ServingEngine(draft_model=, "
+                "draft_params=) — a small DecodeModel sharing the "
+                "target's vocabulary", context="serving-spec")
+            enforce_that(
+                int(draft_model.vocab_size) == int(model.vocab_size),
+                f"draft vocab ({draft_model.vocab_size}) must equal the "
+                f"target vocab ({model.vocab_size})",
+                context="serving-spec")
+            self._proposer = DraftProposer(
+                draft_model, draft_params, page_size=page_size,
+                num_pages=int(draft_pool_pages or num_pages),
+                max_pages_per_seq=int(max_pages_per_seq),
+                max_slots=max_slots)
+        # verify rows per decode slot: 1 (plain decode) + spec_k drafts
+        self._k1 = 1 + (self.spec_k if self._proposer is not None else 0)
         # donate the incoming KV pool: every call overwrites self._kv
         # with the returned pool, so XLA may update pages in place —
         # without this the decode tick copies the whole pool and peak
@@ -590,7 +650,9 @@ class ServingEngine:
                 n = int(np.prod(leaf.shape)) if leaf.shape else 1
                 param_count += n
                 param_bytes += n * jnp.dtype(leaf.dtype).itemsize
-        rows = max_slots + self._prefill_budget
+        # the widened step's worst-case row stack: k1 verify rows per
+        # slot plus the packed prefill budget
+        rows = max_slots * self._k1 + self._prefill_budget
         e = model.num_heads * model.head_dim
         # peak budgets reason about LOGICAL (global) avals — the xla
         # auditor's live-set estimator sums full aval bytes and cannot
@@ -653,10 +715,12 @@ class ServingEngine:
         # audit_jit == jax.jit unless FLAGS.jit_audit is on, in which
         # case each named site's compiles are counted by the retrace
         # auditor (paddle_tpu.analysis.retrace): the unified step must
-        # compile exactly once per (decode_bucket, prefill_bucket) pair
-        # — decode_bucket is the fixed max_slots row count, so the pair
-        # ladder is one entry per prefill bucket plus the decode-only 0
-        self._step_fns: Dict[int, Callable] = {}
+        # compile exactly once per (prefill_bucket, k1) pair — the
+        # decode row count is the fixed max_slots * k1 (k1 = 1 +
+        # spec_k, 1 with speculation off), so the pair ladder is one
+        # entry per prefill bucket per speculation depth, and
+        # speculation adds the k dimension and nothing else
+        self._step_fns: Dict[Tuple[int, int], Callable] = {}
         # COW fork + failure scrub: kv is argument 0 in both (same
         # donation contract as above)
         self._fork_fn = audit_jit(
@@ -774,17 +838,18 @@ class ServingEngine:
             ctx, NamedSharding(self.mesh, P(None, self.tp_axis, None)))
 
     def _attend(self, kv: KVPages, layer: int, q, table, att_lens,
-                row_seq, qpos):
+                row_seq, qpos, k1: int = 1):
         """One ragged paged attention over the tick's mixed row stack.
-        The reference path consumes the compact ``[B + pb]`` rows as-is;
-        the kernel path expands each decode row to its own BLOCK_ROWS
-        block (the one-sequence-per-block packing contract) — prefill
-        rows are already block-aligned by the packer — and slices the
-        context back out.  The expansion touches [B, H, D]-sized data,
-        noise next to the attention itself.  Under TP the kernel rides
-        a ``shard_map`` over the model axis (heads are attention-local,
-        so each chip runs the unchanged kernel on its head shard) and
-        both paths re-assert the head sharding on the context."""
+        The reference path consumes the compact ``[B * k1 + pb]`` rows
+        as-is; the kernel path expands each slot's ``k1`` decode/verify
+        rows to whole BLOCK_ROWS blocks (the one-sequence-per-block
+        packing contract) — prefill rows are already block-aligned by
+        the packer — and slices the context back out.  The expansion
+        touches [B*k1, H, D]-sized data, noise next to the attention
+        itself.  Under TP the kernel rides a ``shard_map`` over the
+        model axis (heads are attention-local, so each chip runs the
+        unchanged kernel on its head shard) and both paths re-assert
+        the head sharding on the context."""
         ks = kv.k_scale[layer] if kv.k_scale is not None else None
         vs = kv.v_scale[layer] if kv.v_scale is not None else None
         if not self._ragged_kernel:
@@ -794,14 +859,18 @@ class ServingEngine:
                 q, kv.k[layer], kv.v[layer], table, att_lens, row_seq,
                 qpos, k_scale=ks, v_scale=vs))
         b, rb = self._max_slots, BLOCK_ROWS
-        td = b * rb
+        bd = b * k1                      # compact decode/verify rows
+        rbk = -(-k1 // rb) * rb          # padded rows per slot
+        td = b * rbk                     # expanded decode/verify rows
+        h, d = q.shape[1], q.shape[2]
         # decode rows expand through THE shared packing helper (one copy
         # of the one-sequence-per-block contract); prefill rows are
         # already block-aligned by the packer and concatenate behind
-        qd, rsd, qpd = expand_decode_rows(q[:b], qpos[:b])
-        qe = jnp.concatenate([qd, q[b:]])
-        rs = jnp.concatenate([rsd, row_seq[b:]])
-        qp = jnp.concatenate([qpd, qpos[b:]])
+        qd, rsd, qpd = expand_decode_rows(q[:bd], qpos[:bd],
+                                          rows_per_seq=k1)
+        qe = jnp.concatenate([qd, q[bd:]])
+        rs = jnp.concatenate([rsd, row_seq[bd:]])
+        qp = jnp.concatenate([qpd, qpos[bd:]])
         if self.mesh is not None and self.tp > 1:
             ctx = ragged_paged_attention_tp(
                 self.mesh, self.tp_axis, qe, kv.k[layer], kv.v[layer],
@@ -811,29 +880,36 @@ class ServingEngine:
             ctx = ragged_paged_attention(
                 qe, kv.k[layer], kv.v[layer], table, att_lens, rs, qp,
                 k_scale=ks, v_scale=vs, use_kernel=True)
-        return self._tp_ctx(jnp.concatenate([ctx[:td:rb], ctx[td:]]))
+        cd = ctx[:td].reshape(b, rbk, h, d)[:, :k1].reshape(bd, h, d)
+        return self._tp_ctx(jnp.concatenate([cd, ctx[td:]]))
 
-    def _step_fn(self, pb: int):
+    def _step_fn(self, pb: int, k1: int = 1):
         """The unified per-tick step for prefill bucket ``pb`` (0 =
-        decode-only): ONE dispatch embeds the decode rows and the packed
-        prefill-chunk rows, scatters every row's K/V into its page
-        (quantize-on-write on int8 pools; masked rows write ZEROS to the
-        shared null page so computed junk can never leak into gathered
-        fallback reads), runs one ragged paged attention over the whole
-        mixed batch per layer, and returns logits for the decode rows
-        plus each slot's chunk-final row — prior context and in-chunk
-        causality come from the ONE ``token <= position`` mask, with no
-        separate prefill/decode paths to keep in sync."""
-        fn = self._step_fns.get(pb)
+        decode-only) at ``k1`` decode/verify rows per slot (1 = plain
+        decode; ``1 + spec_k`` when speculating — the widened verify
+        step): ONE dispatch embeds every slot's verify rows and the
+        packed prefill-chunk rows, scatters every row's K/V into its
+        page (quantize-on-write on int8 pools; masked rows write ZEROS
+        to the shared null page so computed junk can never leak into
+        gathered fallback reads), runs one ragged paged attention over
+        the whole mixed batch per layer, and returns logits for ALL
+        ``B * k1`` decode/verify rows plus each slot's chunk-final row
+        — prior context, in-chunk causality AND in-verify causality
+        (draft ``i`` sees drafts ``< i``) all come from the ONE
+        ``token <= position`` mask, with no separate paths to keep in
+        sync."""
+        fn = self._step_fns.get((pb, k1))
         if fn is not None:
             return fn
         model, cfg = self.model, self.kv_cfg
         b, page = self._max_slots, cfg.page_size
+        bd = b * k1
 
-        def raw(params, kv: KVPages, d_tokens, d_pos, d_active, p_tokens,
+        def raw(params, kv: KVPages, d_tokens, d_pos, d_valid, p_tokens,
                 p_qpos, p_seq, p_last, table, att_lens):
-            # d_tokens/d_pos/d_active: [B] — one decode row per slot
-            # (inactive rows write the null page and produce garbage
+            # d_tokens/d_pos/d_valid: [B, k1] — row 0 of a slot is the
+            # plain decode token, rows 1..k its drafted lookahead
+            # (invalid rows write the null page and produce garbage
             # logits the host ignores).  p_tokens/p_qpos/p_seq: [pb] —
             # packed prefill rows, qpos -1 = padding (p_seq stays the
             # owning slot so kernel blocks remain sequence-uniform).
@@ -841,38 +917,40 @@ class ServingEngine:
             # the packed stack (0 for slots not prefilling).  table:
             # [B, Pm]; att_lens: [B] — valid KV per slot AFTER this
             # step's writes.
-            arange_b = jnp.arange(b)
+            d_seq = jnp.repeat(jnp.arange(b), k1)
+            dt = d_tokens.reshape(bd)
+            dp = d_pos.reshape(bd)
+            dv = d_valid.reshape(bd)
             p_act = p_qpos >= 0
             pq = jnp.maximum(p_qpos, 0)
-            tokens = jnp.concatenate([d_tokens, p_tokens])
-            pos = jnp.concatenate([d_pos, pq])
-            x = model.embed(params, tokens, pos)          # [B + pb, E]
-            d_pages = jnp.where(d_active, table[arange_b, d_pos // page],
-                                NULL_PAGE)
+            tokens = jnp.concatenate([dt, p_tokens])
+            pos = jnp.concatenate([dp, pq])
+            x = model.embed(params, tokens, pos)       # [B*k1 + pb, E]
+            d_pages = jnp.where(dv, table[d_seq, dp // page], NULL_PAGE)
             p_pages = jnp.where(p_act, table[p_seq, pq // page], NULL_PAGE)
             pages = jnp.concatenate([d_pages, p_pages])
-            offs = jnp.concatenate([d_pos % page, pq % page])
-            wmask = jnp.concatenate([d_active, p_act])[:, None, None]
-            row_seq = jnp.concatenate([arange_b, p_seq])
-            qpos = jnp.concatenate([jnp.where(d_active, d_pos, -1),
-                                    p_qpos])
+            offs = jnp.concatenate([dp % page, pq % page])
+            wmask = jnp.concatenate([dv, p_act])[:, None, None]
+            row_seq = jnp.concatenate([d_seq, p_seq])
+            qpos = jnp.concatenate([jnp.where(dv, dp, -1), p_qpos])
             for l in range(cfg.num_layers):
                 q, k, v = model.qkv(params, l, x)
                 kv = append_token(kv, l, jnp.where(wmask, k, 0.0),
                                   jnp.where(wmask, v, 0.0), pages, offs)
                 ctx = self._attend(kv, l, q, table, att_lens, row_seq,
-                                   qpos)
+                                   qpos, k1=k1)
                 x = model.attn_out(params, l, ctx, x)
-            # logits only where the host will read them: the B decode
-            # rows + each slot's chunk-final row (2B rows, not B + pb)
-            sel = jnp.concatenate([arange_b, p_last])
+            # logits only where the host will read them: the B*k1
+            # decode/verify rows + each slot's chunk-final row
+            sel = jnp.concatenate([jnp.arange(bd), p_last])
             logits = model.logits(params, x[sel])
-            return logits[:b], logits[b:], self._tp_kv(kv)
+            return (logits[:bd].reshape(b, k1, -1), logits[bd:],
+                    self._tp_kv(kv))
 
         fn = audit_jit(raw, site="serving.step",
                        donate_argnums=self._donate_kv,
                        xla_contract=self._step_contract)
-        self._step_fns[pb] = fn
+        self._step_fns[(pb, k1)] = fn
         return fn
 
     # ---- user surface ----------------------------------------------------
@@ -881,7 +959,8 @@ class ServingEngine:
                on_token: Optional[Callable[[int], None]] = None,
                now: Optional[float] = None,
                queue_deadline_s: Optional[float] = None,
-               deadline_s: Optional[float] = None) -> int:
+               deadline_s: Optional[float] = None,
+               sampling: Optional[SamplingParams] = None) -> int:
         """Queue a request and return its rid — ALWAYS, even when the
         request is refused (infeasible size or queue backpressure): a
         refused rid carries status ``REJECTED``, so callers distinguish
@@ -891,9 +970,16 @@ class ServingEngine:
         ``queue_deadline_s`` bounds time waiting for admission (engine
         default: ``FLAGS.serving_queue_deadline_s``); ``deadline_s``
         bounds submit-to-last-token.  Either lapsing marks the request
-        ``TIMED_OUT`` and frees everything it held."""
+        ``TIMED_OUT`` and frees everything it held.
+
+        ``sampling`` (a :class:`SamplingParams`) turns on real sampling
+        — temperature/top-k/top-p with seeded per-position RNG streams,
+        bit-reproducible across replays on the injected clock; None (or
+        temperature 0) keeps greedy argmax, token-identical to the
+        oracle."""
         req = Request(prompt=list(int(t) for t in prompt),
-                      max_tokens=int(max_tokens), on_token=on_token)
+                      max_tokens=int(max_tokens), on_token=on_token,
+                      sampling=sampling)
         t = self._time() if now is None else now
         if queue_deadline_s is None:
             # engine-wide default; self.queue_deadline_s is None when
@@ -942,6 +1028,10 @@ class ServingEngine:
             if suspect:
                 self._kv = self._zero_fn(self._kv,
                                          jnp.asarray(suspect, jnp.int32))
+        if self._proposer is not None:
+            # drop any draft-model cache state (its pages return to the
+            # draft pool); a no-op for the n-gram proposer
+            self._proposer.release(req.rid)
         if req.slot is not None:
             self.scheduler.release(req, status)
         else:
@@ -1034,7 +1124,16 @@ class ServingEngine:
         # youngest) to grow older sequences.  admit() reserves the first
         # decode append's page, so fresh admissions never need same-tick
         # growth either.
-        m.on_preempt(len(sched.ensure_decode_pages()))
+        preempted = sched.ensure_decode_pages()
+        npreempt = len(preempted)
+        m.on_preempt(npreempt)
+        if self._proposer is not None:
+            for req in preempted:
+                # a preempted request re-prefills from scratch later;
+                # keeping its draft-model cache pinned meanwhile would
+                # starve the draft pool (and the state is stale anyway
+                # — catch-up rebuilds it at the next propose)
+                self._proposer.release(req.rid)
         admitted = sched.admit()
         for req in admitted:
             if req.admitted_at is None:
@@ -1049,12 +1148,13 @@ class ServingEngine:
             self._tracer.instant("admit", rid=req.rid, slot=req.slot,
                                  cached=req.cached_len, tick=tick)
             self._begin_prefill(req)
-        # the unified step: this tick's decode rows AND every selected
-        # prefill chunk ride ONE dispatch (one ragged attention over
-        # shared pages), so a long prefill no longer stalls running
-        # slots' inter-token latency NOR costs a second dispatch.
-        # Chunk candidates go oldest-progress-first so a request
-        # crowded out by the row budget is first in line next tick.
+        # the unified step: this tick's decode/verify rows AND every
+        # selected prefill chunk ride ONE dispatch (one ragged
+        # attention over shared pages), so a long prefill no longer
+        # stalls running slots' inter-token latency NOR costs a second
+        # dispatch.  Chunk candidates go oldest-progress-first so a
+        # request crowded out by the row budget is first in line next
+        # tick.
         prefilling = sorted(
             (r for r in sched.running_requests()
              if r.status is RequestStatus.RUNNING and r.prefilling),
@@ -1065,6 +1165,10 @@ class ServingEngine:
         running = [r for r in sched.running_requests()
                    if r.status is RequestStatus.RUNNING
                    and not r.prefilling and r.generated]
+        # speculation: draft lookahead tokens per slot BEFORE the retry
+        # loop (drafting mutates proposer state — it must run once per
+        # tick, and the position-keyed RNG keeps it deterministic)
+        drafts = self._propose_drafts(running, under_pressure=npreempt > 0)
         if running or chunks:
             for req, start, n, _ in chunks:
                 self._tracer.instant("prefill_chunk", rid=req.rid,
@@ -1076,13 +1180,14 @@ class ServingEngine:
                                    prefill_rows=total_rows):
                 if self._fuse_tick or not (running and chunks):
                     self._step_with_retry(running, chunks, total_rows,
-                                          tick)
+                                          tick, drafts)
                 else:
                     # fuse_tick=False: the v1 tick-interleave shape —
                     # prefill and decode as separate dispatches (bench
                     # control; same math, token-identical)
-                    self._step_with_retry([], chunks, total_rows, tick)
-                    self._step_with_retry(running, [], 0, tick)
+                    self._step_with_retry([], chunks, total_rows, tick,
+                                          {})
+                    self._step_with_retry(running, [], 0, tick, drafts)
         self._prev_tick_busy = (bool(running) or bool(admitted) or
                                 bool(prefilling))
         self._watchdog_sweep(tick)
@@ -1153,6 +1258,10 @@ class ServingEngine:
                 f"REF-LEAK: held={held} refs={pool.total_refs} "
                 f"cached={pool.num_cached} free={pool.num_free} "
                 f"usable={pool.num_usable}")
+        if self._proposer is not None:
+            # the draft-model pool obeys the same conservation law:
+            # pages held by live draft states == draft-pool refcounts
+            self._proposer.check_conservation()
 
     def load(self) -> Dict[str, object]:
         """Cheap load probe: the same queue_depth / running /
@@ -1274,8 +1383,91 @@ class ServingEngine:
                     > req.deadline_at):
                 self._finish(req, RequestStatus.REJECTED, now, shed=True)
 
+    def _propose_drafts(self, running: List[Request],
+                        under_pressure: bool) -> Dict[int, Tuple]:
+        """Per-tick speculation: ask the proposer for up to ``spec_k``
+        drafts per running slot, charge lookahead pages (opportunistic
+        — never by preemption), and privatize any shared page the
+        verify would write (:meth:`_cow_guard`).  Under page pressure
+        (a preemption ran this tick, or the pool is dry) speculation is
+        suspended outright: the tick degrades to plain 1-row decode,
+        which the base page charge already guaranteed.  Returns
+        ``{rid: (draft tokens, warped proposal probs or None)}``."""
+        if self._proposer is None or not running:
+            return {}
+        m = self.metrics
+        if under_pressure or self.pool.num_free == 0:
+            m.on_spec_suspend(len(running))
+            return {}
+        caps = {req.rid: max(0, min(self.spec_k,
+                                    req.tokens_remaining - 1,
+                                    self.kv_cfg.max_seq_len
+                                    - req.cache_len - 1))
+                for req in running}
+        eligible = [r for r in running if caps[r.rid] > 0]
+        proposals = self._proposer.propose(eligible,
+                                           lambda r: caps[r.rid]) \
+            if eligible else {}
+        drafts: Dict[int, Tuple] = {}
+        for req in running:
+            got = proposals.get(req.rid, ((), None))
+            toks, probs = list(got[0])[:caps[req.rid]], got[1]
+            if toks:
+                granted = self.scheduler.grant_lookahead(req, len(toks))
+                if granted < len(toks):
+                    m.on_spec_suspend()       # page-pressure shrink
+                    toks = toks[:granted]
+            # the guard also covers the base decode row (toks may be
+            # empty): a speculating engine never writes ANY verify row
+            # into a cached or refcount-shared page un-forked
+            toks = self._cow_guard(req, toks)
+            if toks:
+                drafts[req.rid] = (
+                    toks, None if probs is None else probs[:len(toks)])
+        if isinstance(self._proposer, DraftProposer):
+            m.on_draft(self._proposer.steps, self._proposer.step_time_s)
+        return drafts
+
+    def _cow_guard(self, req: Request, toks: List[int]) -> List[int]:
+        """Copy-on-write guard for the verify's multi-token write: every
+        page the ``len(toks) + 1`` rows would touch that is cached or
+        refcount-shared is forked into a private replica first (table
+        entry swapped, our reference moved), so a rejected speculative
+        branch can never dirty K/V another holder — a prefix-cache
+        sharer, or the cache itself — reads.  If the fork cannot get a
+        page, the lookahead truncates to stop short of the shared page
+        instead."""
+        page = self.kv_cfg.page_size
+        for idx in pages_spanned(req.cache_len, len(toks) + 1, page):
+            src = req.pages[idx]
+            if not self.pool.is_cached(src) and \
+                    self.pool.refcount(src) <= 1:
+                continue
+            got = self.scheduler.alloc_pages(1)
+            if got is None:
+                # cannot privatize: write nothing into this page.  The
+                # base decode row (position cache_len) always ships —
+                # its page is never shared under the engine's own
+                # insert policy (only FULL prefix pages are ever
+                # cached/stitched), this guard exists for duck-typed
+                # callers that cache more aggressively.
+                self.metrics.on_spec_suspend()
+                return toks[:max(0, idx * page - 1 - req.cache_len)]
+            # scalar page-id UPLOADS for the rare fork dispatch, not
+            # readbacks — same shape _begin_prefill's COW fork uses
+            self._kv = self._fork_fn(
+                self._kv,
+                jnp.asarray(src, jnp.int32),       # lint: allow(host-sync)
+                jnp.asarray(got[0], jnp.int32))    # lint: allow(host-sync)
+            self.pool.free([src])     # drop OUR ref; sharers keep theirs
+            req.pages[idx] = got[0]
+            self.metrics.on_spec_cow()
+            self._tracer.instant("spec_cow", rid=req.rid, src=src,
+                                 dst=got[0])
+        return toks
+
     def _step_with_retry(self, running: List[Request], chunks, total_rows,
-                         tick: int) -> None:
+                         tick: int, drafts: Dict[int, Tuple]) -> None:
         attempt = 0
         while True:
             try:
@@ -1283,7 +1475,7 @@ class ServingEngine:
                         self.faults.decode_should_fail(tick, attempt):
                     raise InjectedDeviceError(f"injected @ tick {tick} "
                                               f"attempt {attempt}")
-                self._do_step(running, chunks, total_rows)
+                self._do_step(running, chunks, total_rows, drafts)
                 return
             except self.transient_errors:
                 attempt += 1
@@ -1322,11 +1514,11 @@ class ServingEngine:
             self.metrics.on_cow()
 
     def _do_step(self, running: List[Request], chunks,
-                 total_rows: int) -> None:
+                 total_rows: int, drafts: Dict[int, Tuple]) -> None:
         """Assemble and dispatch ONE unified step, then walk its
         results: chunk bookkeeping first (cache inserts, finite guard,
-        final-chunk first-token emission — the v1 tick order), decode
-        emissions second.
+        final-chunk first-token emission — the v1 tick order),
+        decode/verify emissions second.
 
         Every chunk's final-row logits go through the finite guard
         BEFORE its full pages are indexed (those logits attend over
@@ -1334,20 +1526,31 @@ class ServingEngine:
         for the whole chain): without the per-chunk check, suspect K/V
         from an overflowing prompt would be hittable for the whole
         multi-tick prefill window, and a sharer admitted in that window
-        would stitch it before the final-chunk rollback ran."""
-        b = self._max_slots
+        would stitch it before the final-chunk rollback ran.
+
+        With speculation, slot ``s`` ships ``1 + len(drafts[s])`` rows
+        (the plain decode token plus the lookahead); the accept walk
+        (``speculate.accept_tokens``) emits the longest agreeing prefix
+        plus one bonus/corrected token, and a partial acceptance rolls
+        the lookahead pages back (``scheduler.rollback_pages``) — the
+        rejected rows' K/V beyond the new length is masked junk the
+        next real tokens overwrite."""
+        b, k1 = self._max_slots, self._k1
         cfg = self.kv_cfg
-        d_tokens = np.zeros((b,), np.int32)
-        d_pos = np.zeros((b,), np.int32)
-        d_active = np.zeros((b,), bool)
+        d_tokens = np.zeros((b, k1), np.int32)
+        d_pos = np.zeros((b, k1), np.int32)
+        d_valid = np.zeros((b, k1), bool)
         att_lens = np.zeros((b,), np.int32)
         table = np.full((b, cfg.max_pages_per_seq), NULL_PAGE, np.int32)
         for req in running:
             s = req.slot
-            d_tokens[s] = req.generated[-1]
-            d_pos[s] = req.cache_len
-            d_active[s] = True
-            att_lens[s] = req.cache_len + 1
+            dr = drafts.get(req.rid, ((), None))[0]
+            n = 1 + len(dr)
+            d_tokens[s, 0] = req.generated[-1]
+            d_tokens[s, 1:n] = dr
+            d_pos[s, :n] = req.cache_len + np.arange(n)
+            d_valid[s, :n] = True
+            att_lens[s] = req.cache_len + n
             table[s, :len(req.pages)] = req.pages
         pb = 0
         if chunks:
@@ -1368,20 +1571,24 @@ class ServingEngine:
             # padding rows keep the owning slot so each kernel block
             # stays sequence-uniform (their qpos -1 masks them out)
             p_seq[off:off + rows] = s
-            p_last[s] = b + off + n - 1   # absolute row in the step's stack
+            # absolute row in the step's stack (behind the B*k1
+            # decode/verify rows)
+            p_last[s] = b * k1 + off + n - 1
             att_lens[s] = start + n
             table[s, :len(req.pages)] = req.pages
             off += rows
-        d_logits, p_logits, self._kv = self._step_fn(pb)(
+        d_logits, p_logits, self._kv = self._step_fn(pb, k1)(
             self.params, self._kv, jnp.asarray(d_tokens),
-            jnp.asarray(d_pos), jnp.asarray(d_active),
+            jnp.asarray(d_pos), jnp.asarray(d_valid),
             jnp.asarray(p_tokens), jnp.asarray(p_qpos),
             jnp.asarray(p_seq), jnp.asarray(p_last), jnp.asarray(table),
             jnp.asarray(att_lens))
-        d_logits = np.asarray(d_logits)   # forces device sync
+        d_logits = np.asarray(d_logits)   # forces device sync; [B,k1,V]
         p_logits = np.asarray(p_logits)
-        self.metrics.on_step(len(running), total_rows,
-                             pb - sum(c[2] for c in chunks))
+        self.metrics.on_step(
+            sum(1 + len(drafts.get(r.rid, ((),))[0]) for r in running),
+            total_rows, pb - sum(c[2] for c in chunks),
+            n_slots=len(running))
         # stamp AFTER the sync so TTFT includes the step compute
         now = self._time()
         for req, start, n, _rows in chunks:
@@ -1398,14 +1605,42 @@ class ServingEngine:
         for req in running:
             if req.status is not RequestStatus.RUNNING:
                 continue    # cancelled from another slot's on_token
-            row = d_logits[req.slot]
-            if not np.isfinite(row).all():
-                # poisoned slot: fail ONLY this request — its pages go
-                # back, the fused batchmates keep decoding untouched
+            dr, dprobs = drafts.get(req.rid, ((), None))
+            nrows = 1 + len(dr)
+            rows = d_logits[req.slot, :nrows]
+            if not np.isfinite(rows).all():
+                # poisoned slot (possibly mid-verify): fail ONLY this
+                # request — its pages go back (uncached ones scrubbed
+                # by _finish), the fused batchmates keep decoding
+                # untouched and the proposer state is released
                 self._finish(req, RequestStatus.FAILED, now)
                 continue
-            req.cache_len += 1
-            self._emit(req, int(np.argmax(row)), now)
+            emitted, accepted = accept_tokens(
+                rows, dr, dprobs, req.sampling, len(req.generated),
+                self.eos_id)
+            req.cache_len += accepted + 1
+            if dr:
+                req.spec_proposed += len(dr)
+                req.spec_accepted += accepted
+                self.metrics.on_spec(len(dr), accepted)
+                self._tracer.instant("spec_accept", rid=req.rid,
+                                     proposed=len(dr), accepted=accepted)
+                if accepted < len(dr):
+                    # rejected branch: return the lookahead pages past
+                    # the accepted length (the rolled-back rows' K/V is
+                    # masked junk; a shared page was already COW-forked
+                    # before the write)
+                    self.scheduler.rollback_pages(req)
+                    self._tracer.instant("spec_rollback", rid=req.rid,
+                                         rejected=len(dr) - accepted)
+            for tok in emitted:
+                self._emit(req, tok, now)
+                if req.finished:
+                    break
+            if not req.finished and self._proposer is not None:
+                # accepted history is now truth: the draft proposer
+                # rolls its own cache back to it (no-op for n-gram)
+                self._proposer.commit(req)
 
     def _finish_chunk(self, req: Request, start: int, n: int, logits,
                       now: float) -> None:
@@ -1441,7 +1676,10 @@ class ServingEngine:
         if req.cache_len < len(toks):
             return                            # more chunks, later ticks
         req.prefilling = False
-        self._emit(req, int(np.argmax(logits)), now)
+        # first token: greedy argmax unless the request samples (seeded
+        # per-position draw — position 0 of its generated stream)
+        self._emit(req, next_token(logits, req.sampling,
+                                   len(req.generated)), now)
 
     def _emit(self, req: Request, tok: int, now: float) -> None:
         req.generated.append(tok)

@@ -273,7 +273,18 @@ def append_token(kv: KVPages, layer: int, k_new: jax.Array, v_new: jax.Array,
     rows pass page_ids == NULL_PAGE — duplicates on the null page are
     fine, nothing reads it).  Quantized pools quantize on write (the
     scale lands at the same [layer, page, offset, head] address).
-    Pure; returns the updated pool."""
+    Pure; returns the updated pool.
+
+    This is also the MULTI-TOKEN scatter of the speculative verify
+    step: a slot speculating ``k`` tokens contributes ``k+1``
+    consecutive rows (positions ``cache_len .. cache_len+k``, possibly
+    spanning a page boundary — see :func:`pages_spanned`), all written
+    in the one dispatch.  Rollback after a partial acceptance is
+    host-side: the rejected positions' K/V stays as finite junk beyond
+    the new length, masked away by the ``token <= position`` attention
+    inequality until the real tokens overwrite it, while the lookahead
+    PAGES past the length return to the pool
+    (``scheduler.rollback_pages`` — rollback-to-length)."""
     if kv.quantized:
         kq, ks = quantize_kv(k_new)
         vq, vs = quantize_kv(v_new)
@@ -296,6 +307,18 @@ def write_prompt(kv: KVPages, layer: int, k_seq: jax.Array, v_seq: jax.Array,
     caller.  Same quantize-on-write rule as :func:`append_token` (the
     scatter shape is identical — one row per position)."""
     return append_token(kv, layer, k_seq, v_seq, dest_pages, offsets)
+
+
+def pages_spanned(start: int, count: int, page_size: int) -> range:
+    """Page-table INDICES a write of ``count`` consecutive token
+    positions starting at ``start`` touches (empty for ``count <= 0``).
+    The one arithmetic the engine's verify-time COW guard and its tests
+    share: every spanned page that is cached or refcount-shared must be
+    forked before a speculative branch may write into it, so a rejected
+    branch can never dirty pages another holder reads."""
+    if count <= 0:
+        return range(0)
+    return range(start // page_size, (start + count - 1) // page_size + 1)
 
 
 def zero_pages(kv: KVPages, page_ids: jax.Array) -> KVPages:

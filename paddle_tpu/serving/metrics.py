@@ -55,9 +55,22 @@ class ServingMetrics:
         # whole point of the ragged kernel is fewer dispatches per unit
         # of work, so the bench reads these directly
         self.step_dispatches = 0      # unified-step device dispatches
-        self.decode_rows = 0          # decode rows shipped across steps
+        self.decode_rows = 0          # decode/verify rows shipped across
+        #                               steps (k1 per speculating slot)
+        self.decode_slots = 0         # slot participations (one per
+        #                               running slot per step)
         self.prefill_rows = 0         # prefill-chunk rows shipped (padded)
         self.prefill_pad_rows = 0     # of the bucket, padding/alignment
+        # speculative decoding (round 18)
+        self.spec_ticks = 0           # verify ticks with >= 1 drafted token
+        self.spec_tokens_proposed = 0  # drafted tokens shipped to verify
+        self.spec_tokens_accepted = 0  # of those, accepted
+        self.spec_rollbacks = 0       # verify walks that rejected >= 1 draft
+        self.spec_suspended = 0       # slot-ticks speculation was suspended
+        #                               (page pressure / no lookahead room)
+        self.spec_cow_forks = 0       # verify-time COW forks (shared tail)
+        self.draft_steps = 0          # draft-model dispatches (gauge)
+        self.draft_time_s = 0.0       # wall time inside them (gauge)
         # prefix caching (round 9)
         self.prefix_requested_tokens = 0  # cache_tokens summed at admission
         self.prefill_tokens_saved = 0     # of those, served from the cache
@@ -84,15 +97,20 @@ class ServingMetrics:
     def on_prefill(self, n_tokens: int) -> None:
         self.prefill_tokens += n_tokens
 
-    def on_step(self, n_decode: int, n_prefill_rows: int,
-                n_pad_rows: int) -> None:
-        """One unified-step dispatch: how many decode rows and (padded)
-        prefill rows rode it, and how much of the prefill bucket was
-        padding.  ``fuse_tick=False`` (the v1 two-dispatch control)
-        calls this twice per busy tick — the dispatch-count delta IS
-        the A/B."""
+    def on_step(self, n_decode_rows: int, n_prefill_rows: int,
+                n_pad_rows: int, n_slots: Optional[int] = None) -> None:
+        """One unified-step dispatch: how many decode/verify rows and
+        (padded) prefill rows rode it, and how much of the prefill
+        bucket was padding.  ``n_slots`` is the running-slot
+        participation count — equal to the row count without
+        speculation, 1/k1 of it with (each speculating slot ships k1
+        verify rows).  ``fuse_tick=False`` (the v1 two-dispatch
+        control) calls this twice per busy tick — the dispatch-count
+        delta IS the A/B."""
         self.step_dispatches += 1
-        self.decode_rows += n_decode
+        self.decode_rows += n_decode_rows
+        self.decode_slots += n_slots if n_slots is not None \
+            else n_decode_rows
         self.prefill_rows += n_prefill_rows
         self.prefill_pad_rows += max(0, n_pad_rows)
 
@@ -106,6 +124,29 @@ class ServingMetrics:
 
     def on_cow(self) -> None:
         self.cow_forks += 1
+
+    def on_spec(self, proposed: int, accepted: int) -> None:
+        """One slot's verify outcome this tick: ``proposed`` drafts rode
+        the widened step, ``accepted`` of them survived the walk (a
+        shortfall is a rollback)."""
+        if proposed > 0:
+            self.spec_ticks += 1
+        self.spec_tokens_proposed += proposed
+        self.spec_tokens_accepted += accepted
+        if accepted < proposed:
+            self.spec_rollbacks += 1
+
+    def on_spec_suspend(self, n: int = 1) -> None:
+        self.spec_suspended += n
+
+    def on_spec_cow(self) -> None:
+        self.spec_cow_forks += 1
+        self.cow_forks += 1
+
+    def on_draft(self, steps: int, seconds: float) -> None:
+        """Absolute draft-proposer counters (gauges, stamped per tick)."""
+        self.draft_steps = steps
+        self.draft_time_s = seconds
 
     def on_admit(self, queue_wait_s: float) -> None:
         self.queue_wait_s.append(max(0.0, queue_wait_s))
@@ -172,6 +213,14 @@ class ServingMetrics:
             return 0.0
         return (self.timed_out + self.shed) / demand
 
+    def spec_acceptance_rate(self) -> float:
+        """Of all drafted tokens shipped to verify, the fraction
+        accepted — the number the 2-3x decode-multiplication claim
+        rides on (tokens per verify tick = 1 + rate * k)."""
+        if self.spec_tokens_proposed == 0:
+            return 0.0
+        return self.spec_tokens_accepted / self.spec_tokens_proposed
+
     def prefix_hit_rate(self) -> float:
         """Token-level hit rate: of all the prefill tokens admissions
         asked for, the fraction served from the prefix cache."""
@@ -198,9 +247,19 @@ class ServingMetrics:
             "prefill_tokens": self.prefill_tokens,
             "step_dispatches": self.step_dispatches,
             "decode_rows": self.decode_rows,
+            "decode_slots": self.decode_slots,
             "prefill_rows": self.prefill_rows,
             "prefill_pad_rows": self.prefill_pad_rows,
             "prefix_hit_rate": round(self.prefix_hit_rate(), 4),
+            "spec_ticks": self.spec_ticks,
+            "spec_tokens_proposed": self.spec_tokens_proposed,
+            "spec_tokens_accepted": self.spec_tokens_accepted,
+            "spec_acceptance_rate": round(self.spec_acceptance_rate(), 4),
+            "spec_rollbacks": self.spec_rollbacks,
+            "spec_suspended": self.spec_suspended,
+            "spec_cow_forks": self.spec_cow_forks,
+            "draft_steps": self.draft_steps,
+            "draft_time_s": round(self.draft_time_s, 6),
             "prefill_tokens_saved": self.prefill_tokens_saved,
             "cow_forks": self.cow_forks,
             "cache_evictions": self.cache_evictions,

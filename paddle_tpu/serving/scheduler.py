@@ -79,6 +79,10 @@ class Request:
     prompt: List[int]
     max_tokens: int
     on_token: Optional[Callable[[int], None]] = None
+    # sampling policy (None = greedy argmax, the parity-test contract);
+    # a SamplingParams from serving.speculate with seeded per-position
+    # RNG streams, so replays are bit-identical
+    sampling: Optional[object] = None
     rid: int = field(default_factory=lambda: next(_rid_counter))
     # SLOs (absolute times on the engine's clock; None = unbounded)
     queue_deadline_at: Optional[float] = None   # must be admitted by
@@ -106,6 +110,10 @@ class Request:
     # chunk j's insert resumes hashing where chunk j-1 stopped
     chain_hash: Optional[int] = None
     chain_blocks: int = 0
+    # speculative decoding (round 18): per-request acceptance counters
+    # (the per-slot acceptance-rate observable)
+    spec_proposed: int = 0          # drafted tokens shipped to verify
+    spec_accepted: int = 0          # of those, accepted
 
     @property
     def cache_tokens(self) -> List[int]:
@@ -307,6 +315,50 @@ class ContinuousBatchingScheduler:
                 if victim is req:
                     break
         return preempted
+
+    def alloc_pages(self, n: int) -> Optional[List[int]]:
+        """Public allocation seam for engine-side page needs outside
+        admission/growth (the verify-time COW fork): same cache-evict
+        relief as every other allocation, never preemption.  Returns
+        the pages at refcount 1, or None."""
+        return self._alloc(n)
+
+    def grant_lookahead(self, req: Request, k: int) -> int:
+        """Charge pages for ``k`` speculative lookahead tokens beyond
+        the base decode append — OPPORTUNISTICALLY: cached pages may be
+        LRU-evicted to cover it (via ``_alloc``) but nothing is ever
+        preempted for speculation, so under page pressure the grant
+        shrinks and the engine speculates less (down to the plain
+        1-token decode, which ``ensure_decode_pages`` already
+        guaranteed).  Returns the lookahead that actually fits —
+        ``min(k, owned page room - 1)``, also bounded by the page-table
+        width."""
+        page = self.cfg.page_size
+        want = req.cache_len + int(k) + 1
+        while len(req.pages) * page < want:
+            if len(req.pages) >= self.cfg.max_pages_per_seq:
+                break
+            got = self._alloc(1)
+            if got is None:
+                break
+            req.pages.extend(got)
+        return max(0, min(int(k),
+                          len(req.pages) * page - req.cache_len - 1))
+
+    def rollback_pages(self, req: Request) -> int:
+        """Roll a speculating request's page table back to its length:
+        free lookahead pages past what ``cache_len + 1`` (the next
+        decode append — the same charge admission makes) needs.  Only
+        ever frees pages past the materialized length, so stitched
+        prefix pages (always a prefix of the table, below ``cache_len``)
+        can never be touched.  Returns how many pages went back."""
+        needed = max(1, self._pages_for(req.cache_len + 1))
+        if len(req.pages) <= needed:
+            return 0
+        extra = req.pages[needed:]
+        del req.pages[needed:]
+        self.pool.free(extra)
+        return len(extra)
 
     def _youngest_victim(self, exclude: Request) -> Optional[Request]:
         budget = self.cfg.preempt_budget

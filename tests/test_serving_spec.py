@@ -1,0 +1,613 @@
+"""Speculative decoding + real sampling (round 18).
+
+Covers: SamplingParams warping/seeded draws, the n-gram and draft-model
+proposers, the accept/rollback walk units, engine-level greedy parity
+(spec on == spec off == oracle) with real acceptance, bit-reproducible
+sampled replays, page-pressure suspension, verify-time COW forks on
+shared tail pages, NaN-mid-verify isolation, injected-error retry,
+lookahead page grant/rollback conservation, the sealed retrace pin
+(one compile per (prefill_bucket, k+1) pair — speculation adds the k
+dimension and nothing else), and fleet kill/resubmit exactly-once
+streams when a tick emits multiple accepted tokens.
+"""
+
+import numpy as np
+import jax
+import pytest
+
+from paddle_tpu.analysis.retrace import auditor
+from paddle_tpu.platform.flags import FLAGS
+from paddle_tpu.serving import (DecoderLM, DraftProposer, FaultPlan,
+                                FleetFaultPlan, FleetRouter, ManualClock,
+                                NGramProposer, RequestStatus,
+                                SamplingParams, ServingEngine,
+                                accept_tokens, greedy_decode_reference,
+                                next_token, warp_probs)
+from paddle_tpu.serving.kv_cache import pages_spanned
+from paddle_tpu.serving.speculate import position_rng
+
+from conftest import assert_serving_drained as assert_drained  # noqa: E402
+
+pytestmark = [pytest.mark.spec, pytest.mark.serving]
+
+EOS = 1
+
+
+@pytest.fixture(autouse=True)
+def f32():
+    old = FLAGS.use_bf16
+    FLAGS.use_bf16 = False
+    yield
+    FLAGS.use_bf16 = old
+
+
+@pytest.fixture(scope="module")
+def model_params():
+    model = DecoderLM(vocab_size=64, num_layers=2, num_heads=2,
+                      head_dim=8, max_positions=256)
+    return model, model.init_params(jax.random.PRNGKey(0))
+
+
+def _engine(model, params, **kw):
+    kw.setdefault("eos_id", EOS)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("num_pages", 96)
+    kw.setdefault("max_pages_per_seq", 16)
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("buckets", (8, 16, 32))
+    return ServingEngine(model, params, **kw)
+
+
+def _run_all(eng, prompts, max_tokens=20, sampling=None):
+    rids = [eng.submit(p, max_tokens=max_tokens, sampling=sampling)
+            for p in prompts]
+    res = eng.run()
+    return rids, res
+
+
+# ---------------------------------------------------------------------------
+# sampling units
+# ---------------------------------------------------------------------------
+
+
+def test_sampling_params_validation():
+    with pytest.raises(Exception):
+        SamplingParams(temperature=-1.0)
+    with pytest.raises(Exception):
+        SamplingParams(top_p=0.0)
+    with pytest.raises(Exception):
+        SamplingParams(top_k=-1)
+    assert SamplingParams().greedy
+    assert not SamplingParams(temperature=0.7).greedy
+
+
+def test_warp_probs_restricts_support():
+    logits = np.array([4.0, 3.0, 2.0, 1.0, 0.0])
+    s = SamplingParams(temperature=1.0, top_k=2)
+    p = warp_probs(logits, s)
+    assert p[2:].sum() == 0.0 and abs(p.sum() - 1.0) < 1e-12
+    s = SamplingParams(temperature=1.0, top_p=0.5)
+    p = warp_probs(logits, s)
+    assert p[0] > 0.0 and p[2:].sum() == 0.0   # top token alone covers 0.5
+    # top_p always keeps at least the argmax
+    p = warp_probs(logits, SamplingParams(temperature=1.0, top_p=1e-9))
+    assert p[0] == 1.0
+
+
+def test_next_token_greedy_and_seeded():
+    logits = np.array([0.1, 2.0, 0.3, 0.4])
+    assert next_token(logits, None, 0) == 1
+    assert next_token(logits, SamplingParams(), 5) == 1
+    s = SamplingParams(temperature=1.0, seed=9)
+    draws = {next_token(logits, s, pos) for pos in range(50)}
+    assert len(draws) > 1                      # actually random over pos
+    for pos in range(10):                      # but pure in (seed, pos)
+        assert next_token(logits, s, pos) == next_token(logits, s, pos)
+    # different seeds decorrelate
+    s2 = SamplingParams(temperature=1.0, seed=10)
+    assert any(next_token(logits, s, p) != next_token(logits, s2, p)
+               for p in range(20))
+
+
+def test_position_rng_is_counter_based():
+    a = position_rng(3, 7).random_sample()
+    b = position_rng(3, 7).random_sample()
+    c = position_rng(3, 8).random_sample()
+    assert a == b and a != c
+
+
+# ---------------------------------------------------------------------------
+# proposer units
+# ---------------------------------------------------------------------------
+
+
+def test_ngram_proposer_matches_most_recent():
+    p = NGramProposer(n=2)
+    #       0  1  2  3  4  5  6  7  8
+    hist = [5, 6, 9, 9, 5, 6, 7, 5, 6]
+    # suffix [5, 6] matched at its most recent earlier occurrence with
+    # a full-k continuation (ending index 6) -> what followed: [7, 5]
+    assert p.propose_one(hist, 2) == [7, 5]
+    # k=4: the recent match only continues 3 tokens; the earlier full
+    # match (ending index 2) wins with all 4
+    assert p.propose_one(hist, 4) == [9, 9, 5, 6]
+    # inside a constant run the nearest match is truncated — a full-k
+    # proposal still comes from one period earlier
+    assert p.propose_one([7, 3, 3, 3, 3, 3], 3) == [3, 3, 3]
+
+
+def test_ngram_proposer_suffix_fallback_and_miss():
+    p = NGramProposer(n=3)
+    assert p.propose_one([1, 2, 3, 4], 2) == []          # nothing repeats
+    # 3-gram misses, 1-gram [4] hits at index 1 -> proposes [9]
+    assert p.propose_one([4, 9, 7, 4], 2) == [9, 7]
+    assert p.propose_one([4], 2) == []                   # too short
+    assert p.propose_one([4, 4], 0) == []                # k = 0
+
+
+def test_pages_spanned():
+    assert list(pages_spanned(0, 1, 8)) == [0]
+    assert list(pages_spanned(7, 1, 8)) == [0]
+    assert list(pages_spanned(7, 2, 8)) == [0, 1]
+    assert list(pages_spanned(8, 5, 8)) == [1]
+    assert list(pages_spanned(6, 12, 8)) == [0, 1, 2]
+    assert list(pages_spanned(4, 0, 8)) == []
+
+
+# ---------------------------------------------------------------------------
+# accept walk units
+# ---------------------------------------------------------------------------
+
+
+def _rows(*argmaxes, v=16):
+    out = np.full((len(argmaxes), v), -5.0)
+    for i, a in enumerate(argmaxes):
+        out[i, a] = 5.0
+    return out
+
+
+def test_accept_greedy_full_acceptance_emits_bonus():
+    rows = _rows(3, 4, 5)
+    emitted, acc = accept_tokens(rows, [3, 4], None, None, 0, EOS)
+    assert emitted == [3, 4, 5] and acc == 2
+
+
+def test_accept_greedy_rejection_emits_target_token():
+    rows = _rows(3, 7, 5)
+    emitted, acc = accept_tokens(rows, [3, 4], None, None, 0, EOS)
+    assert emitted == [3, 7] and acc == 1      # draft 4 != target 7
+
+
+def test_accept_greedy_immediate_reject_is_plain_decode():
+    rows = _rows(9)
+    emitted, acc = accept_tokens(rows, [], None, None, 0, EOS)
+    assert emitted == [9] and acc == 0
+    emitted, acc = accept_tokens(_rows(9, 2), [3], None, None, 0, EOS)
+    assert emitted == [9] and acc == 0
+
+
+def test_accept_greedy_eos_stops_walk():
+    rows = _rows(EOS, 4, 5)
+    emitted, acc = accept_tokens(rows, [EOS, 4], None, None, 0, EOS)
+    assert emitted == [EOS] and acc == 1       # accepted EOS: no bonus
+
+
+def test_accept_rejection_sampling_point_mass():
+    s = SamplingParams(temperature=1.0, seed=0)
+    # target puts ~all mass on 3; draft proposes 3 -> accept w.p. ~1
+    rows = _rows(3, 6)
+    emitted, acc = accept_tokens(rows, [3], None, s, 0, EOS)
+    assert emitted[0] == 3 and acc == 1
+    # target mass on 2, draft proposes 3 (point mass): p(3)/q(3) ~ 0 ->
+    # reject; the residual zeroes the draft token, so the sample != 3
+    rows = _rows(2, 6)
+    emitted, acc = accept_tokens(rows, [3], None, s, 0, EOS)
+    assert acc == 0 and emitted[0] != 3
+    # deterministic across calls (counter-based RNG)
+    again, acc2 = accept_tokens(rows, [3], None, s, 0, EOS)
+    assert again == emitted and acc2 == acc
+
+
+# ---------------------------------------------------------------------------
+# engine: parity, acceptance, tick reduction
+# ---------------------------------------------------------------------------
+
+
+def _prompts(rng, n=6, lo=4, hi=20, vocab=64):
+    return [rng.randint(2, vocab, size=rng.randint(lo, hi)).tolist()
+            for _ in range(n)]
+
+
+def test_ngram_greedy_token_identical_and_fewer_ticks(model_params):
+    model, params = model_params
+    prompts = _prompts(np.random.RandomState(0))
+
+    def replay(mode):
+        eng = _engine(model, params, spec_mode=mode, spec_k=4)
+        rids, res = _run_all(eng, prompts, max_tokens=24)
+        assert all(eng.status(r) is RequestStatus.COMPLETED for r in rids)
+        assert_drained(eng)
+        return [res[r] for r in rids], eng.metrics.snapshot()
+
+    off, snap_off = replay("off")
+    on, snap_on = replay("ngram")
+    assert on == off                           # token-identical
+    assert snap_on["spec_tokens_accepted"] > 0  # speculation really ran
+    assert snap_on["ticks"] < snap_off["ticks"]
+    assert snap_on["spec_rollbacks"] > 0       # rejects exercised too
+    want = greedy_decode_reference(model, params, prompts[0], 24, EOS)
+    assert on[0] == want
+
+
+def test_spec_off_signature_unchanged(model_params):
+    """A spec-off engine builds k1=1 steps — one verify row per slot,
+    the exact pre-speculation shape."""
+    model, params = model_params
+    eng = _engine(model, params)
+    assert eng._k1 == 1 and eng._proposer is None
+    eng.submit([3, 4, 5], max_tokens=3)
+    eng.run()
+    assert all(k1 == 1 for (_pb, k1) in eng._step_fns)
+    assert_drained(eng)
+
+
+def test_draft_proposer_greedy_parity(model_params):
+    """Draft model == target model: near-total acceptance, and the
+    emitted stream stays token-identical (greedy acceptance is exact
+    match, so ANY draft model preserves parity — a perfect one just
+    accepts more)."""
+    model, params = model_params
+    prompts = _prompts(np.random.RandomState(1), n=4)
+    off_eng = _engine(model, params)
+    _, off = _run_all(off_eng, prompts, max_tokens=16)
+    eng = _engine(model, params, spec_mode="draft", spec_k=3,
+                  draft_model=model, draft_params=params)
+    rids, res = _run_all(eng, prompts, max_tokens=16)
+    assert all(eng.status(r) is RequestStatus.COMPLETED for r in rids)
+    snap = eng.metrics.snapshot()
+    assert [res[r] for r in rids] == list(off.values())
+    # a perfect draft accepts (nearly) everything it proposes
+    assert snap["spec_acceptance_rate"] > 0.9
+    assert snap["draft_steps"] > 0
+    assert_drained(eng)                        # draft pool checked too
+    assert eng._proposer.pool.total_refs == 0  # draft states released
+
+
+def test_draft_model_vocab_mismatch_rejected(model_params):
+    model, params = model_params
+    bad = DecoderLM(vocab_size=32, num_layers=1, num_heads=2, head_dim=8)
+    with pytest.raises(Exception, match="vocab"):
+        _engine(model, params, spec_mode="draft", draft_model=bad,
+                draft_params=bad.init_params(jax.random.PRNGKey(0)))
+    with pytest.raises(Exception, match="draft_model"):
+        _engine(model, params, spec_mode="draft")
+
+
+def test_sampled_replays_bit_identical(model_params):
+    model, params = model_params
+    prompts = _prompts(np.random.RandomState(2), n=4)
+    sp = SamplingParams(temperature=0.8, top_k=20, top_p=0.95, seed=42)
+
+    def replay(mode):
+        eng = _engine(model, params, spec_mode=mode, spec_k=3)
+        rids, res = _run_all(eng, prompts, max_tokens=16, sampling=sp)
+        assert all(eng.status(r) is RequestStatus.COMPLETED for r in rids)
+        assert_drained(eng)
+        return [res[r] for r in rids], eng.metrics.snapshot()
+
+    a1, _ = replay("ngram")
+    a2, _ = replay("ngram")
+    assert a1 == a2                            # bit-reproducible
+    g_eng = _engine(model, params)
+    _, g = _run_all(g_eng, prompts, max_tokens=16)
+    assert a1 != list(g.values())              # actually sampled
+    b1, _ = replay("off")
+    b2, _ = replay("off")
+    assert b1 == b2
+
+
+def test_per_request_seeds_decorrelate(model_params):
+    model, params = model_params
+    eng = _engine(model, params)
+    prompt = [7, 9, 11, 13]
+    r1 = eng.submit(prompt, max_tokens=12,
+                    sampling=SamplingParams(temperature=1.0, seed=1))
+    r2 = eng.submit(prompt, max_tokens=12,
+                    sampling=SamplingParams(temperature=1.0, seed=2))
+    res = eng.run()
+    assert res[r1] != res[r2]
+    assert_drained(eng)
+
+
+# ---------------------------------------------------------------------------
+# page pressure, lookahead charging, rollback, COW
+# ---------------------------------------------------------------------------
+
+
+def test_lookahead_grant_and_rollback_pages(model_params):
+    model, params = model_params
+    eng = _engine(model, params, spec_mode="ngram", spec_k=4)
+    rid = eng.submit([3, 4] * 4, max_tokens=2)
+    eng.step()
+    req = eng._requests[rid]
+    assert req.slot is not None
+    base = len(req.pages)
+    live0 = eng.pool.num_live
+    granted = eng.scheduler.grant_lookahead(req, 16)
+    assert granted >= 1
+    assert len(req.pages) > base               # lookahead pages charged
+    assert eng.pool.num_live == live0 + (len(req.pages) - base)
+    freed = eng.scheduler.rollback_pages(req)
+    # rolled back to exactly the next-append charge admission makes
+    assert freed > 0
+    assert len(req.pages) == max(
+        1, -(-(req.cache_len + 1) // eng.kv_cfg.page_size))
+    assert eng.pool.num_live == live0
+    eng.run()
+    assert_drained(eng)
+
+
+def test_speculation_suspended_under_page_pressure(model_params):
+    """A pool with zero slack: growth preemption and/or a dry free list
+    suspends speculation (spec_suspended counts), everything still
+    completes with parity and no leaks."""
+    model, params = model_params
+    rng = np.random.RandomState(3)
+    # repetitive 12-token prompts: the n-gram proposer WANTS to draft,
+    # but once both running slots grow to 3 pages they hold all 6
+    # usable pages, and the dry free list suspends speculation
+    prompts = [rng.randint(2, 64, size=3).tolist() * 4 for _ in range(4)]
+    ctrl = _engine(model, params)
+    _, off = _run_all(ctrl, prompts, max_tokens=12)
+    eng = _engine(model, params, num_pages=7, max_pages_per_seq=6,
+                  max_slots=2, spec_mode="ngram", spec_k=4,
+                  prefix_cache=False)
+    rids, res = _run_all(eng, prompts, max_tokens=12)
+    assert all(eng.status(r) is RequestStatus.COMPLETED for r in rids)
+    assert [res[r] for r in rids] == list(off.values())
+    assert eng.metrics.spec_suspended > 0
+    assert_drained(eng)
+
+
+def test_cow_guard_forks_shared_verify_page(model_params):
+    """A shared tail page (simulated second holder) is COW-forked
+    before the verify writes into it: the sharer's K/V bytes stay
+    bit-identical, the fork is counted, and refcounts conserve."""
+    model, params = model_params
+    eng = _engine(model, params, spec_mode="ngram", spec_k=3)
+    rid = eng.submit([5, 6] * 3, max_tokens=16)
+    for _ in range(4):
+        eng.step()
+    req = eng._requests[rid]
+    assert req.status is RequestStatus.RUNNING and not req.prefilling
+    tail_idx = req.cache_len // eng.kv_cfg.page_size
+    shared = req.pages[tail_idx]
+    eng.pool.ref([shared])                     # simulate a sharer
+    before = np.asarray(eng._kv.k[:, shared]).copy()
+    snap0 = eng.metrics.spec_cow_forks
+    for _ in range(6):
+        eng.step()
+    assert eng.metrics.spec_cow_forks > snap0
+    assert req.pages[tail_idx] != shared       # table entry swapped
+    after = np.asarray(eng._kv.k[:, shared])
+    np.testing.assert_array_equal(before, after)
+    assert eng.pool.refcount(shared) == 1      # only the sharer's ref
+    eng.pool.free([shared])                    # release the fake sharer
+    eng.run()
+    assert_drained(eng)
+
+
+# ---------------------------------------------------------------------------
+# chaos: NaN mid-verify, injected errors, preemption
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.faults
+def test_nan_mid_verify_fails_only_poisoned(model_params):
+    model, params = model_params
+    prompts = _prompts(np.random.RandomState(4), n=4)
+    ctrl = _engine(model, params)
+    _, off = _run_all(ctrl, prompts, max_tokens=14)
+    plan = FaultPlan(clock=ManualClock(tick_s=0.01))
+    eng = _engine(model, params, spec_mode="ngram", spec_k=3,
+                  faults=plan)
+    rids = [eng.submit(p, max_tokens=14) for p in prompts]
+    eng.step()
+    eng.step()
+    plan.poison_nan(rids[1])                   # NaN lands mid-verify
+    res = eng.run()
+    assert eng.status(rids[1]) is RequestStatus.FAILED
+    for j, rid in enumerate(rids):
+        if j == 1:
+            continue
+        assert eng.status(rid) is RequestStatus.COMPLETED
+        assert res[rid] == list(off.values())[j]   # batchmates keep parity
+    assert_drained(eng)
+
+
+@pytest.mark.faults
+def test_transient_decode_errors_retried_with_spec(model_params):
+    model, params = model_params
+    prompts = _prompts(np.random.RandomState(5), n=3)
+    ctrl = _engine(model, params)
+    _, off = _run_all(ctrl, prompts, max_tokens=12)
+    plan = FaultPlan(clock=ManualClock(tick_s=0.01),
+                     decode_errors={2: 1, 5: 2})
+    eng = _engine(model, params, spec_mode="ngram", spec_k=3,
+                  faults=plan)
+    rids, res = _run_all(eng, prompts, max_tokens=12)
+    assert all(eng.status(r) is RequestStatus.COMPLETED for r in rids)
+    assert [res[r] for r in rids] == list(off.values())
+    assert eng.metrics.retries >= 2
+    assert_drained(eng)
+
+
+@pytest.mark.faults
+def test_preemption_with_spec_keeps_parity(model_params):
+    """Fault-plan page pressure forces preemption + re-prefill while
+    speculating: the replayed stream is still token-identical."""
+    model, params = model_params
+    prompts = _prompts(np.random.RandomState(6), n=4, lo=6, hi=16)
+    ctrl = _engine(model, params)
+    _, off = _run_all(ctrl, prompts, max_tokens=12)
+    plan = FaultPlan(clock=ManualClock(tick_s=0.01),
+                     page_pressure=(3, 12, 30))
+    eng = _engine(model, params, num_pages=48, max_pages_per_seq=8,
+                  max_slots=2, spec_mode="ngram", spec_k=3, faults=plan)
+    rids, res = _run_all(eng, prompts, max_tokens=12)
+    assert all(eng.status(r) is RequestStatus.COMPLETED for r in rids)
+    assert [res[r] for r in rids] == list(off.values())
+    assert_drained(eng)
+
+
+@pytest.mark.faults
+def test_preemption_releases_draft_state(model_params):
+    """A preempted request's draft-model cache is released immediately
+    (not at terminal), so preemption churn cannot pin draft-pool pages
+    and starve the slots that are still running."""
+    model, params = model_params
+    # the pressure window drains the free list, which first SUSPENDS
+    # speculation (opportunistic lookahead never preempts) and then
+    # forces the plain growth path to preempt the youngest slot
+    plan = FaultPlan(clock=ManualClock(tick_s=0.01),
+                     page_pressure=(2, 30, 40))
+    eng = _engine(model, params, num_pages=10, max_pages_per_seq=8,
+                  max_slots=2, prefix_cache=False, spec_mode="draft",
+                  spec_k=2, draft_model=model, draft_params=params,
+                  draft_pool_pages=64, faults=plan)
+    rids = [eng.submit([6, 7] * 4, max_tokens=12) for _ in range(4)]
+    saw_preempt = False
+    for _ in range(60):
+        eng.step()
+        for rid in rids:
+            req = eng._requests[rid]
+            if req.status is RequestStatus.PREEMPTED:
+                saw_preempt = True
+                assert rid not in eng._proposer._state
+        if not eng.has_work:
+            break
+    assert saw_preempt, "pressure window produced no preemption"
+    eng.run()
+    assert all(eng.status(r) is RequestStatus.COMPLETED for r in rids)
+    assert eng._proposer.pool.total_refs == 0
+    assert_drained(eng)
+
+
+# ---------------------------------------------------------------------------
+# retrace: one compile per (bucket, k+1)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def audit():
+    old = FLAGS.jit_audit
+    FLAGS.jit_audit = True
+    auditor().reset()
+    yield auditor()
+    FLAGS.jit_audit = old
+    auditor().reset()
+
+
+def test_sealed_spec_step_one_compile_per_bucket_k(audit, model_params):
+    """The acceptance pin: a sealed speculative steady state compiles
+    serving.step exactly once per (prefill_bucket, k1) pair — the k
+    dimension is the ONLY thing speculation adds — and a fresh replay
+    over the same shapes compiles nothing new."""
+    model, params = model_params
+    rng = np.random.RandomState(7)
+    eng = _engine(model, params, spec_mode="ngram", spec_k=3,
+                  prefill_chunk=8)
+
+    def burst():
+        eng.submit((rng.randint(2, 64, size=3).tolist()) * 3,
+                   max_tokens=10)
+        eng.step()
+        eng.submit(rng.randint(2, 64, size=6).tolist(), max_tokens=8)
+        eng.run(max_ticks=300)
+
+    burst()
+    pairs = audit.compile_count("serving.step")
+    assert pairs == len(eng._step_fns)         # one compile per pair
+    assert all(k1 == eng._k1 == 4 for (_pb, k1) in eng._step_fns)
+    audit.seal()
+    burst()                                    # steady state: no compiles
+    audit.assert_budget("serving.step", pairs)
+    assert audit.diagnostics == []
+    assert_drained(eng)
+
+
+def test_draft_site_audited(audit, model_params):
+    model, params = model_params
+    eng = _engine(model, params, spec_mode="draft", spec_k=2,
+                  draft_model=model, draft_params=params)
+    eng.submit([9, 8] * 3, max_tokens=8)
+    eng.run(max_ticks=200)
+    assert audit.compile_count("serving.draft") >= 1
+    rec = audit.sites["serving.draft"]
+    assert rec.contract is not None
+    assert 1 in rec.jit_kwargs["donate_argnums"]
+    assert_drained(eng)
+
+
+def test_spec_metrics_published(model_params):
+    model, params = model_params
+    eng = _engine(model, params, spec_mode="ngram", spec_k=3)
+    rid = eng.submit([4, 5] * 4, max_tokens=12)
+    eng.run()
+    hz = eng.healthz()
+    snap = hz["metrics"]
+    assert "serving_spec_tokens_proposed" in snap
+    assert "serving_spec_acceptance_rate" in snap
+    assert "serving_spec_rollbacks" in snap
+    req = eng._requests[rid]
+    assert req.spec_proposed >= req.spec_accepted >= 0
+    assert hz["ok"]
+
+
+# ---------------------------------------------------------------------------
+# fleet: exactly-once with multi-token ticks
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fleet
+@pytest.mark.faults
+def test_fleet_kill_resubmit_exactly_once_with_spec(model_params):
+    """A replica dies mid-decode while its slots speculate (multiple
+    accepted tokens per tick): the resubmitted replay's on_token stream
+    stays exactly-once (high-water mark — no token re-emitted, none
+    skipped) and matches the final results token-for-token."""
+    model, params = model_params
+    plan = FleetFaultPlan(clock=ManualClock(tick_s=0.01), kill_at={6: 0})
+
+    def mk(i, time_fn):
+        return ServingEngine(model, params, eos_id=EOS, page_size=8,
+                             num_pages=64, max_pages_per_seq=12,
+                             max_slots=2, buckets=(8, 16),
+                             spec_mode="ngram", spec_k=3,
+                             time_fn=time_fn)
+
+    fl = FleetRouter(mk, 2, faults=plan, heartbeat_s=0.05,
+                     resubmit_budget=2)
+    rng = np.random.RandomState(8)
+    streams = {}
+    frids = []
+    for j in range(6):
+        prompt = (rng.randint(2, 64, size=3).tolist()) * 3
+        stream = []
+        frid = fl.submit(prompt, max_tokens=14,
+                         on_token=stream.append)
+        streams[frid] = (prompt, stream)
+        frids.append(frid)
+    res = fl.run(max_ticks=500)
+    assert fl.metrics.duplicate_completions == 0
+    assert fl.metrics.resubmits >= 1           # the kill displaced work
+    spec_accepted = sum(
+        rep.engine.metrics.spec_tokens_accepted for rep in fl.replicas)
+    assert spec_accepted > 0                   # multi-token ticks happened
+    for frid in frids:
+        assert fl.status(frid) is RequestStatus.COMPLETED
+        prompt, stream = streams[frid]
+        assert res[frid] == stream             # exactly-once, in order
+        want = greedy_decode_reference(model, params, prompt, 14, EOS)
+        assert res[frid] == want
+    fl.check_fleet_conservation()
