@@ -244,6 +244,25 @@ FLAGS.define("serving_fleet_resubmit_budget", 2,
              "FAILED — bounded recovery, never an infinite "
              "kill->resubmit loop. 0 = fail on the first death.",
              parser=int)
+FLAGS.define("serving_fleet_roles", "",
+             "comma-separated replica role list for a disaggregated "
+             "fleet ('prefill,prefill,decode,decode'); shorter lists "
+             "pad with 'unified', empty = every replica unified (the "
+             "classic fleet). Prompts route to prefill/unified "
+             "replicas; a prefill-class replica hands each request off "
+             "to the least-loaded decode-class replica after its first "
+             "token via the page-migration plane (export_chain/"
+             "import_chain), so long prefills never steal verify-row "
+             "budget from chatty decoders.")
+FLAGS.define("serving_migrate_budget", 16,
+             "page-migration admission budget: KV pages a DESTINATION "
+             "replica accepts per fleet tick across in-flight "
+             "migrations (chain handoffs and cross-replica prefix "
+             "seeds). Charged like chunked prefill — a blob of n pages "
+             "waits ceil(n/budget) ticks in the destination's transfer "
+             "queue and never blocks its decode tick. 0 disables "
+             "migration (prefill-class replicas then decode their own "
+             "requests to completion).", parser=int)
 FLAGS.define("obs_trace", False,
              "request-scoped span tracing (paddle_tpu.obs): when on, "
              "ServingEngine/FleetRouter construct a real Tracer on "

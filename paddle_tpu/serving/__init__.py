@@ -27,6 +27,10 @@ from paddle_tpu.serving.kv_cache import (NULL_PAGE, KVPages, PagedKVConfig,
                                          prefix_chain_hashes, quantize_kv,
                                          resolve_kv_dtype, write_prompt)
 from paddle_tpu.serving.metrics import FleetMetrics, ServingMetrics
+from paddle_tpu.serving.migrate import (MigrationBlob,
+                                        check_migration_conservation,
+                                        export_chain, export_prefix,
+                                        import_chain, import_prefix)
 from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
                                           Request, RequestStatus,
                                           SchedulerConfig, bucket_for,
@@ -48,6 +52,8 @@ __all__ = [
     "FaultPlan", "FleetFaultPlan", "ManualClock", "InjectedDeviceError",
     "PageLeakError",
     "FleetRouter", "Replica", "ReplicaState",
+    "MigrationBlob", "export_chain", "import_chain", "export_prefix",
+    "import_prefix", "check_migration_conservation",
     "SamplingParams", "NGramProposer", "DraftProposer", "accept_tokens",
     "next_token", "warp_probs",
 ]

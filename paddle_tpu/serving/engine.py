@@ -116,7 +116,7 @@ from paddle_tpu.serving.kv_cache import (NULL_PAGE, KVPages, PagedKVConfig,
                                          fork_page, init_kv_pages,
                                          kv_pool_specs, pages_for_budget,
                                          pages_spanned, resolve_kv_dtype,
-                                         zero_pages)
+                                         write_pages, zero_pages)
 from paddle_tpu.serving.metrics import ServingMetrics
 from paddle_tpu.serving.speculate import (DraftProposer, NGramProposer,
                                           SamplingParams, accept_tokens,
@@ -427,10 +427,20 @@ class ServingEngine:
                  draft_pool_pages: Optional[int] = None,
                  xla_peak_bytes: Optional[int] = None,
                  xla_flops: Optional[float] = None,
-                 xla_comm_bytes: Optional[float] = None):
+                 xla_comm_bytes: Optional[float] = None,
+                 role: str = "unified"):
         from paddle_tpu.platform.enforce import enforce_that
 
         self.eos_id = int(eos_id)
+        # fleet class (round 16): "prefill" replicas hand requests off to
+        # "decode" replicas after the first token via the page-migration
+        # plane (serving/migrate.py); "unified" runs both phases.  The
+        # engine itself treats every role identically — the role is an
+        # advertised routing attribute the FleetRouter reads.
+        self.role = str(role)
+        enforce_that(self.role in ("prefill", "decode", "unified"),
+                     f"role must be prefill/decode/unified, got {role!r}",
+                     context="serving")
         page_size = int(page_size or FLAGS.serving_page_size)
         max_slots = int(max_slots or FLAGS.serving_max_slots)
         # KV storage dtype: explicit kv_dtype > legacy dtype param >
@@ -729,6 +739,32 @@ class ServingEngine:
         self._zero_fn = audit_jit(
             zero_pages, site="serving.zero_pages", donate_argnums=(0,),
             xla_contract=kv_contract)
+        # page-migration splice (round 16): whole imported pages land in
+        # the pool via one donated scatter.  The page-count dimension is
+        # padded to a pow2 ladder by _apply_import so migrations of any
+        # size share O(log pages) compiles; padding rows target
+        # NULL_PAGE with a zero payload (page 0 is reserved scratch).
+        n_payload = 4 if self.kv_cfg.quantized else 2
+        if self.mesh is None:
+            imp_in: Tuple = ((),)
+            imp_out: Tuple = ((),)
+        else:
+            imp_in = (kvspec,) + ((),) * (1 + n_payload)
+            imp_out = (kvspec,) * 4
+        import_contract = SiteContract(
+            per_tick=True, donate=(0,),
+            peak_bytes=3 * kv_bytes + (1 << 24),
+            in_specs=imp_in, out_specs=imp_out, mesh_axes=mesh_axes,
+            comm_bytes=kv_comm)
+        if self.kv_cfg.quantized:
+            def _import_pages(kv, ids, k, v, ks, vs):
+                return write_pages(kv, ids, k, v, ks, vs)
+        else:
+            def _import_pages(kv, ids, k, v):
+                return write_pages(kv, ids, k, v)
+        self._import_fn = audit_jit(
+            _import_pages, site="serving.import_pages", donate_argnums=(0,),
+            xla_contract=import_contract)
         self._results: Dict[int, List[int]] = {}
         self._requests: Dict[int, Request] = {}
         # terminal rids in retirement order; oldest evicted past
@@ -1263,6 +1299,46 @@ class ServingEngine:
             # pages held by live draft states == draft-pool refcounts
             self._proposer.check_conservation()
 
+    # ---- page-migration plane (round 16) --------------------------------
+
+    def migratable_rids(self) -> List[int]:
+        """Requests eligible for a chain handoff to a decode-class
+        replica: still RUNNING, prefill fully materialized, and at
+        least the first token emitted (so the destination starts with a
+        decodable state — ``generated[-1]`` is the next step's input)."""
+        return [r.rid for r in self.scheduler.running_requests()
+                if r.status is RequestStatus.RUNNING and not r.prefilling
+                and r.generated]
+
+    def apply_imported_pages(self, page_ids: Sequence[int], k, v,
+                             k_scale=None, v_scale=None) -> None:
+        """Splice host page payloads (STORED values from
+        ``kv_cache.read_pages`` on the source engine) into this
+        engine's device pool at ``page_ids``.  The page-count dimension
+        is padded up to the next power of two so migrations of any size
+        share O(log pages) compiles of the donated
+        ``serving.import_pages`` scatter; padding rows write a zero
+        payload into NULL_PAGE (reserved scratch, never read)."""
+        n = len(page_ids)
+        if n == 0:
+            return
+        padded = 1 << max(0, (n - 1).bit_length())
+        pad = padded - n
+        ids = list(page_ids) + [NULL_PAGE] * pad
+
+        def _pad(a):
+            if a is None or pad == 0:
+                return a
+            z = np.zeros((a.shape[0], pad) + a.shape[2:], a.dtype)
+            return np.concatenate([a, z], axis=1)
+
+        ids_dev = jnp.asarray(ids, jnp.int32)
+        if self.kv_cfg.quantized:
+            self._kv = self._import_fn(self._kv, ids_dev, _pad(k), _pad(v),
+                                       _pad(k_scale), _pad(v_scale))
+        else:
+            self._kv = self._import_fn(self._kv, ids_dev, _pad(k), _pad(v))
+
     def load(self) -> Dict[str, object]:
         """Cheap load probe: the same queue_depth / running /
         free_pages numbers ``healthz`` reports, WITHOUT the
@@ -1273,6 +1349,13 @@ class ServingEngine:
         return {"queue_depth": self.scheduler.queue_depth,
                 "running": len(self.scheduler.running),
                 "free_pages": self.pool.num_free,
+                # class-aware routing probe (round 16): prompt tokens
+                # still owed a prefill, and this engine's fleet class —
+                # both O(1) (the scheduler maintains the backlog
+                # incrementally on every cache_len edge)
+                "prefill_backlog_tokens":
+                    self.scheduler.prefill_backlog_tokens,
+                "role": self.role,
                 "draining": self._draining}
 
     def healthz(self) -> Dict[str, object]:
@@ -1349,6 +1432,11 @@ class ServingEngine:
             "status_counts": counts,
             "deadline_miss_rate": round(self.metrics.deadline_miss_rate(),
                                         4),
+            # disaggregated-fleet probe (round 16): same pair load()
+            # exposes, on the full diagnostic surface
+            "prefill_backlog_tokens":
+                self.scheduler.prefill_backlog_tokens,
+            "role": self.role,
         }
 
     # ---- internals -------------------------------------------------------
@@ -1650,6 +1738,7 @@ class ServingEngine:
         first token from the chunk-final row's logits."""
         toks = req.cache_tokens
         req.cache_len = start + n
+        self.scheduler.note_prefill_progress(req, start)
         self.metrics.on_prefill(n)
         req.last_progress_tick = self._tick   # chunks are progress too
         if not np.isfinite(logits).all():

@@ -198,6 +198,13 @@ class FleetFaultPlan:
       for the window.  Longer than the lease TTL, the fleet declares it
       DEAD; when the partition heals, its stale lease token can no
       longer ack (the zombie-fencing contract from master/service.py).
+    - **migration drop** — ``drop_migration_at`` (migration sequence
+      numbers) and/or a seeded ``migration_drop_rate``: the page blob is
+      lost in flight between export and import, and the router must fall
+      back to re-prefilling on the destination (counted as
+      ``migration_fallbacks``) with the exactly-once token stream
+      preserved.  Draws come from a SEPARATE ``RandomState(seed + 1)``
+      so adding migration faults never perturbs the kill schedule.
     """
 
     seed: int = 0
@@ -206,9 +213,13 @@ class FleetFaultPlan:
     kill_rate: float = 0.0
     slow_replicas: Dict[int, int] = field(default_factory=dict)
     partitions: Dict[int, Tuple[int, int]] = field(default_factory=dict)
+    # page-migration faults (round 16)
+    migration_drop_rate: float = 0.0
+    drop_migration_at: Set[int] = field(default_factory=set)
 
     def __post_init__(self):
         self._rng = np.random.RandomState(self.seed)
+        self._mig_rng = np.random.RandomState(self.seed + 1)
 
     def tick_begin(self, tick: int) -> None:
         """Advance the injected clock for this fleet tick (all replicas
@@ -239,3 +250,12 @@ class FleetFaultPlan:
     def heartbeat_blocked(self, idx: int, tick: int) -> bool:
         win = self.partitions.get(idx)
         return win is not None and win[0] <= tick < win[1]
+
+    def drop_migration(self, seq: int) -> bool:
+        """True when migration number ``seq`` (the router's monotonically
+        increasing per-fleet counter) loses its blob in flight.  One
+        draw per call from the dedicated migration RNG, whether or not
+        ``migration_drop_rate`` is set, so scheduled and randomized
+        flavors replay identically when combined."""
+        hit = bool(self._mig_rng.random_sample() < self.migration_drop_rate)
+        return seq in self.drop_migration_at or hit

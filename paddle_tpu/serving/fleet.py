@@ -77,6 +77,8 @@ from paddle_tpu.serving.engine import ServingEngine
 from paddle_tpu.serving.faults import FleetFaultPlan, PageLeakError
 from paddle_tpu.serving.kv_cache import prefix_chain_hashes
 from paddle_tpu.serving.metrics import FleetMetrics
+from paddle_tpu.serving.migrate import (export_chain, export_prefix,
+                                        import_chain, import_prefix)
 from paddle_tpu.serving.scheduler import RequestStatus
 
 __all__ = ["FleetRouter", "Replica", "ReplicaState"]
@@ -127,9 +129,11 @@ class _FleetRequest:
 class Replica:
     """One engine plus its fleet-side bookkeeping."""
 
-    def __init__(self, idx: int, engine: ServingEngine):
+    def __init__(self, idx: int, engine: ServingEngine,
+                 role: str = "unified"):
         self.idx = idx
         self.engine = engine
+        self.role = role                      # prefill | decode | unified
         self.state = ReplicaState.JOINING
         self.slot: Optional[int] = None       # LeaseTable slot
         self.token: Optional[str] = None      # lease token (zombie fence)
@@ -146,6 +150,35 @@ class Replica:
         ld = self.engine.load()
         return (ld["queue_depth"] + ld["running"], -ld["free_pages"],
                 self.idx)
+
+    def prefill_key(self) -> Tuple[int, int, int, int]:
+        """Balancing key for PROMPT dispatch in a disaggregated fleet:
+        lead with the O(1) ``prefill_backlog_tokens`` probe (the tokens
+        actually ahead of a new prompt), then the classic load key —
+        queue depth alone undercounts a replica chewing a 2k-token
+        prefill."""
+        ld = self.engine.load()
+        return (ld["prefill_backlog_tokens"],
+                ld["queue_depth"] + ld["running"], -ld["free_pages"],
+                self.idx)
+
+
+@dataclass
+class _Transfer:
+    """One pending page transfer, queued per DESTINATION and admitted
+    against its per-tick page credit (``serving_migrate_budget``) —
+    charged to the destination like chunked prefill, never blocking its
+    decode tick.  ``kind="chain"`` hands a live request off;
+    ``kind="seed"`` warms a peer's PrefixCache."""
+
+    kind: str                          # "chain" | "seed"
+    src: int                           # source replica index
+    dest: int                          # destination replica index
+    seq: int                           # fleet-wide migration sequence no.
+    frid: Optional[int] = None         # chain: the fleet rid moving
+    erid: Optional[int] = None         # chain: source engine rid at enqueue
+    tokens: Optional[List[int]] = None  # seed: the prompt to warm
+    pages: int = 0                     # admission estimate (re-read at apply)
 
 
 class FleetRouter:
@@ -170,7 +203,9 @@ class FleetRouter:
                  faults: Optional[FleetFaultPlan] = None,
                  time_fn: Optional[Callable[[], float]] = None,
                  tracer=None,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 roles: Optional[Sequence[str]] = None,
+                 migrate_budget: Optional[int] = None):
         enforce_that(routing in ("affinity", "round_robin"),
                      f"unknown routing policy {routing!r}",
                      context="serving")
@@ -180,6 +215,21 @@ class FleetRouter:
             heartbeat_s = float(FLAGS.serving_fleet_heartbeat_s)
         if resubmit_budget is None:
             resubmit_budget = int(FLAGS.serving_fleet_resubmit_budget)
+        # disaggregation (round 16): per-replica roles; a shorter list
+        # pads with "unified", empty = the classic every-replica-unified
+        # fleet with every migration path dormant
+        if roles is None:
+            raw = str(FLAGS.serving_fleet_roles).strip()
+            roles = [s.strip() for s in raw.split(",")
+                     if s.strip()] if raw else []
+        self._roles: List[str] = [str(r) for r in roles]
+        for r in self._roles:
+            enforce_that(r in ("prefill", "decode", "unified"),
+                         f"unknown replica role {r!r}", context="serving")
+        if migrate_budget is None:
+            migrate_budget = int(FLAGS.serving_migrate_budget)
+        self.migrate_budget = max(0, int(migrate_budget))
+        self._disagg = any(r != "unified" for r in self._roles)
         enforce_that(num_replicas >= 1, "fleet needs >= 1 replica",
                      context="serving")
         self._make_engine = make_engine
@@ -224,6 +274,16 @@ class FleetRouter:
         self._prefix_owner: "OrderedDict[int, int]" = OrderedDict()
         self._rr_next = 0
         self._tick = 0
+        # page-migration plane (round 16): pending transfers FIFO per
+        # destination, admitted against a per-destination page credit of
+        # ``migrate_budget`` pages per fleet tick; chain transfers are
+        # also indexed by fleet rid so a terminal transition anywhere
+        # (completion, death resubmit) aborts the in-flight handoff
+        # instead of leaving it pending forever
+        self._mig_queues: Dict[int, Deque[_Transfer]] = {}
+        self._mig_pending: Dict[int, _Transfer] = {}   # frid -> transfer
+        self._mig_credit: Dict[int, int] = {}
+        self._mig_seq = 0
         for _ in range(num_replicas):
             self.add_replica()
         # initial replicas come up READY before the first submit (their
@@ -270,7 +330,14 @@ class FleetRouter:
         lease, enter JOINING.  Promoted to READY by the next tick's
         sweep once the lease is live and healthz reports ok."""
         idx = len(self.replicas)
-        rep = Replica(idx, self._make_engine(idx, self._time))
+        engine = self._make_engine(idx, self._time)
+        # role: the fleet's roles list wins (padding with "unified");
+        # an engine built with its own role keeps it when the list is
+        # silent about this index
+        role = self._roles[idx] if idx < len(self._roles) \
+            else getattr(engine, "role", "unified")
+        engine.role = role
+        rep = Replica(idx, engine, role=role)
         # one fleet-wide tracer/registry: the engine's instrumentation
         # points report under this replica's identity
         rep.engine.set_tracer(self.tracer.scoped(replica=idx))
@@ -440,20 +507,40 @@ class FleetRouter:
         return self.replicas[0].engine.kv_cfg.page_size
 
     def _route(self, prompt: Sequence[int],
-               exclude: Set[int]) -> Tuple[Optional[int], List[int], bool]:
+               exclude: Set[int]) -> Tuple[Optional[int], List[int], bool,
+                                           Optional[int]]:
         """Pick a READY replica for ``prompt``.  Returns (replica index
         or None, the prompt's chain hashes — empty under round_robin,
-        which never reads them, routed-by-affinity?)."""
+        which never reads them, routed-by-affinity?, seed-from replica
+        or None).
+
+        Disaggregated fleets restrict PROMPT dispatch to prefill-class
+        replicas (prefill/unified), balanced by their
+        ``prefill_backlog_tokens`` probe.  The affinity owner map is
+        keyed by the union of classes — a chain migrated to a decode
+        replica records it as owner — so when the deepest owner cannot
+        (or should not) take the prompt itself, the pick falls to the
+        least-backlogged prefill replica and the owner comes back as
+        ``seed_from``: the dispatcher warms the target's cache from the
+        owner via the page-migration plane instead of re-prefilling."""
         ready = self._ready(exclude)
         if not ready:
-            return None, [], False
+            return None, [], False, None
         if self.routing == "round_robin":
             while True:   # `ready` is non-empty, so the cycle terminates
                 idx = self._rr_next % len(self.replicas)
                 self._rr_next += 1
                 rep = self.replicas[idx]
                 if rep.state is ReplicaState.READY and idx not in exclude:
-                    return idx, [], False
+                    return idx, [], False, None
+        if self._disagg:
+            eligible = [r for r in ready
+                        if r.role in ("prefill", "unified")] or ready
+            balance_key = Replica.prefill_key
+        else:
+            eligible = ready
+            balance_key = Replica.load_key
+        eligible_idx = {r.idx for r in eligible}
         hashes = prefix_chain_hashes(prompt, self._page_size())
         # affinity: the DEEPEST chain link with a known live owner wins
         # (deeper link = longer shared prefix already materialized there)
@@ -463,17 +550,25 @@ class FleetRouter:
             if owner is not None and owner not in exclude and \
                     self.replicas[owner].state is ReplicaState.READY:
                 affinity = owner
+        seed_from = None
         if affinity is not None:
             rep = self.replicas[affinity]
-            limit = self.overflow_queue_depth
-            if limit is None:
-                # default: tolerate a queue as deep as two full decode
-                # batches before overflowing to the least-loaded replica
-                limit = 2 * rep.engine._max_slots
-            if rep.engine.load()["queue_depth"] < limit:
-                return affinity, hashes, True
-        best = min(ready, key=Replica.load_key)
-        return best.idx, hashes, False
+            if affinity in eligible_idx:
+                limit = self.overflow_queue_depth
+                if limit is None:
+                    # default: tolerate a queue as deep as two full decode
+                    # batches before overflowing to the least-loaded
+                    # replica
+                    limit = 2 * rep.engine._max_slots
+                if rep.engine.load()["queue_depth"] < limit:
+                    return affinity, hashes, True, None
+            # the owner holds the prefix but is not taking the prompt
+            # (wrong class, or saturated): seed the eventual target
+            seed_from = affinity
+        best = min(eligible, key=balance_key)
+        if seed_from == best.idx:
+            seed_from = None
+        return best.idx, hashes, False, seed_from
 
     # ---- user surface ------------------------------------------------------
 
@@ -558,6 +653,10 @@ class FleetRouter:
             for rep in doomed:
                 self._reap(rep, now)
         self._lease_sweep(tick, now)
+        # apply pending page transfers BEFORE the engines step: a chain
+        # (or seed) that clears its destination's per-tick credit lands
+        # ahead of that destination's admission/decode this tick
+        self._pump_migrations(now)
         for rep in self.replicas:
             if rep.state is ReplicaState.DEAD:
                 continue
@@ -570,6 +669,10 @@ class FleetRouter:
             if rep.state is ReplicaState.DRAINING and \
                     not rep.engine.has_work:
                 self._retire_replica(rep, now)
+        # AFTER the engines step: prefill-class replicas whose requests
+        # just finished prefilling (first token this tick) enqueue their
+        # chain handoffs; the transfers clear next tick's pump
+        self._scan_migratable()
         self._tick = tick + 1
         return self.has_work
 
@@ -609,7 +712,8 @@ class FleetRouter:
         only when every READY replica refuses is the fleet rid REJECTED."""
         tried: Set[int] = set()
         while True:
-            idx, hashes, affinity = self._route(freq.prompt, tried)
+            idx, hashes, affinity, seed_from = self._route(freq.prompt,
+                                                           tried)
             if idx is None:
                 self._finish(freq, RequestStatus.REJECTED, now)
                 return False
@@ -635,6 +739,15 @@ class FleetRouter:
                                 frid=freq.frid, erid=erid,
                                 affinity=affinity,
                                 attempt=freq.resubmits)
+            if seed_from is not None and self._disagg and \
+                    self.migrate_budget > 0:
+                # the prefix owner warms the chosen target through the
+                # page plane — paced by the destination's migrate
+                # budget, racing the request's own admission (a seed
+                # that lands first saves the whole prefix re-prefill;
+                # one that loses still warms the cache for the NEXT
+                # prompt sharing the prefix)
+                self._enqueue_seed(seed_from, idx, freq.prompt)
             return True
 
     def _resubmit(self, freq: _FleetRequest, now: float) -> None:
@@ -651,7 +764,16 @@ class FleetRouter:
                               "death-driven re-dispatches").inc()
         self.tracer.instant("resubmit", cat="fleet", frid=freq.frid,
                             attempt=freq.resubmits)
-        self._dispatch(freq, now)
+        if self._dispatch(freq, now) and freq.replica is not None:
+            # re-adopt surviving pages (round 16): before the target
+            # engine's next tick can admit (and re-prefill) the replayed
+            # request, seed its cache from whichever surviving replica
+            # still holds the deepest cached prefix — typically the
+            # prefill replica whose parked pages outlived the dead
+            # decoder.  Synchronous on purpose: this races admission
+            # within the same fleet tick, and it is already budgeted by
+            # the resubmit budget that gated this very call.
+            self._seed_for_resubmit(freq)
 
     def _harvest(self, rep: Replica, now: float) -> None:
         """Pull terminal engine statuses up into fleet statuses; mirror
@@ -691,6 +813,13 @@ class FleetRouter:
         if freq.finished:
             self.metrics.duplicate_completions += 1
             return
+        # a terminal transition aborts any in-flight chain handoff for
+        # this rid — the pump would only discover a dangling transfer
+        # later, and the migration ledger must balance at ANY drain
+        if self._mig_pending.pop(freq.frid, None) is not None:
+            self.metrics.on_migration_aborted()
+            self.tracer.instant("migrate_abort", cat="fleet",
+                                frid=freq.frid, reason="terminal")
         freq.status = status
         freq.terminal_transitions += 1
         freq.finished_at = now
@@ -705,6 +834,260 @@ class FleetRouter:
         self._retired.append(freq.frid)
         while len(self._retired) > self.max_retained:
             self._requests.pop(self._retired.popleft(), None)
+
+    # ---- page migration (round 16) ----------------------------------------
+
+    def _enqueue_seed(self, src_idx: int, dest_idx: int,
+                      prompt: Sequence[int]) -> None:
+        """Queue a cross-replica prefix warm: ``src`` (the affinity
+        owner) will push its cached prefix of ``prompt`` into ``dest``'s
+        PrefixCache through the page plane.  Seeds ride the same
+        per-destination credit as chain handoffs but are opportunistic —
+        they drop silently when stale and never enter the migration
+        ledger."""
+        t = _Transfer(kind="seed", src=src_idx, dest=dest_idx, seq=-1,
+                      tokens=[int(x) for x in prompt],
+                      pages=max(1, len(prompt) // self._page_size()))
+        self._mig_queues.setdefault(dest_idx, deque()).append(t)
+        self.tracer.instant("seed_enqueue", cat="fleet", src=src_idx,
+                            dest=dest_idx, tokens=len(t.tokens))
+
+    def _scan_migratable(self) -> None:
+        """Enqueue chain handoffs: every request on a prefill-class
+        replica that has finished its prefill (first token emitted)
+        moves to the least-loaded decode replica.  Runs after the
+        engines step so a prefill completed THIS tick is picked up
+        immediately; the transfer itself clears at the next tick's pump,
+        charged against the destination's page credit."""
+        if not (self._disagg and self.migrate_budget > 0):
+            return
+        decode_ready = [r for r in self.replicas
+                        if r.state is ReplicaState.READY and
+                        r.role == "decode"]
+        if not decode_ready:
+            return                 # no decode class left: prefill
+            #                        replicas finish their own requests
+        page = self._page_size()
+        for rep in self.replicas:
+            if rep.role != "prefill" or rep.state is ReplicaState.DEAD:
+                continue
+            for erid in rep.engine.migratable_rids():
+                frid = rep.rid_map.get(erid)
+                if frid is None:
+                    continue
+                freq = self._requests.get(frid)
+                if freq is None or freq.finished or \
+                        frid in self._mig_pending:
+                    continue
+                # least-loaded decode target, pending transfers included
+                # (else every handoff this tick piles on one replica)
+                dest = min(decode_ready, key=lambda r:
+                           (len(self._mig_queues.get(r.idx, ())),) +
+                           r.load_key())
+                ereq = rep.engine._requests[erid]
+                pages = -(-(ereq.cache_len + 1) // page)
+                seq = self._mig_seq      # chain-only numbering: the
+                self._mig_seq += 1       # fault plan's drop schedule
+                #                          addresses the Nth HANDOFF
+                t = _Transfer(kind="chain", src=rep.idx, dest=dest.idx,
+                              seq=seq, frid=frid, erid=erid, pages=pages)
+                self._mig_pending[frid] = t
+                self._mig_queues.setdefault(dest.idx, deque()).append(t)
+                self.metrics.on_migration_start()
+                self.tracer.instant("migrate_start", cat="fleet",
+                                    frid=frid, src=rep.idx, dest=dest.idx,
+                                    seq=seq, pages=pages)
+
+    def _pump_migrations(self, now: float) -> None:
+        """Apply pending transfers, bounded per destination per tick by
+        ``migrate_budget`` pages — the transfer plane's admission
+        control, charged to the DESTINATION exactly like chunked
+        prefill.  Unspent credit accrues while a transfer waits (a blob
+        bigger than the budget lands after ceil(pages/budget) ticks) and
+        resets when the queue drains, so an idle destination never banks
+        a burst."""
+        for dest_idx in list(self._mig_queues):
+            q = self._mig_queues[dest_idx]
+            credit = self._mig_credit.get(dest_idx, 0) + \
+                self.migrate_budget
+            while q:
+                t = q[0]
+                if t.kind == "chain" and \
+                        self._mig_pending.get(t.frid) is not t:
+                    q.popleft()       # aborted elsewhere (terminal rid)
+                    continue
+                viable, pages = self._transfer_viable(t)
+                if not viable:
+                    q.popleft()
+                    self._abort_transfer(t, reason="stale")
+                    continue
+                if pages > credit:
+                    break             # out of credit: resume next tick
+                q.popleft()
+                credit -= pages
+                if t.kind == "seed":
+                    self._apply_seed(t)
+                elif self._apply_chain(t, now) == "retry":
+                    # destination full right now (no slot / pages):
+                    # refund and retry next tick — the source keeps
+                    # decoding meanwhile, nothing is lost
+                    q.appendleft(t)
+                    credit += pages
+                    break
+            if q:
+                self._mig_credit[dest_idx] = credit
+            else:
+                del self._mig_queues[dest_idx]
+                self._mig_credit.pop(dest_idx, None)
+
+    def _transfer_viable(self, t: _Transfer) -> Tuple[bool, int]:
+        """(still worth applying?, pages to charge).  Chain transfers
+        re-read the source request's CURRENT page count — it grew by its
+        ongoing decode since enqueue."""
+        dest = self.replicas[t.dest]
+        if dest.state is not ReplicaState.READY:
+            return False, 0
+        src = self.replicas[t.src]
+        if t.kind == "seed":
+            if src.state is ReplicaState.DEAD or src.engine.cache is None:
+                return False, 0
+            return True, max(1, t.pages)
+        freq = self._requests.get(t.frid)
+        if freq is None or freq.finished or freq.replica != t.src or \
+                freq.erid != t.erid or src.state is ReplicaState.DEAD:
+            return False, 0           # rebound (death resubmit) or gone
+        ereq = src.engine._requests.get(t.erid)
+        if ereq is None or ereq.status is not RequestStatus.RUNNING or \
+                ereq.prefilling or not ereq.generated:
+            return False, 0
+        return True, -(-(ereq.cache_len + 1) // self._page_size())
+
+    def _abort_transfer(self, t: _Transfer, reason: str) -> None:
+        if t.kind != "chain":
+            return                    # seeds drop silently
+        if self._mig_pending.pop(t.frid, None) is not None:
+            self.metrics.on_migration_aborted()
+            self.tracer.instant("migrate_abort", cat="fleet",
+                                frid=t.frid, reason=reason)
+
+    def _apply_chain(self, t: _Transfer, now: float) -> str:
+        """Execute one chain handoff.  Returns "retry" when the
+        destination cannot host it right now; "done" otherwise (applied,
+        or dropped-in-flight -> re-prefill fallback)."""
+        src = self.replicas[t.src]
+        dest = self.replicas[t.dest]
+        freq = self._requests[t.frid]
+        with self.tracer.span("migrate", cat="fleet", frid=t.frid,
+                              src=t.src, dest=t.dest, seq=t.seq):
+            blob = export_chain(src.engine, t.erid)
+            if self.faults is not None and \
+                    self.faults.drop_migration(t.seq):
+                # blob lost in flight: the source copy is already
+                # committed to cancellation (the handoff was its exit),
+                # so fall back to a plain re-prefill on the destination.
+                # The exactly-once fence replays the already-emitted
+                # tokens silently; greedy determinism makes the stream
+                # identical.
+                self._mig_pending.pop(t.frid, None)
+                src.rid_map.pop(t.erid, None)
+                if not src.engine.status(t.erid).terminal:
+                    src.engine.cancel(t.erid, now=now)
+                freq.replica = None
+                freq.erid = None
+                freq.attempt_tokens = 0
+                remaining = None
+                if freq.deadline_at is not None:
+                    remaining = freq.deadline_at - now
+                erid2 = dest.engine.submit(
+                    freq.prompt, freq.max_tokens,
+                    on_token=self._wrap_on_token(freq),
+                    deadline_s=remaining, now=now)
+                if dest.engine.status(erid2) is RequestStatus.REJECTED:
+                    self._dispatch(freq, now)     # full re-route
+                else:
+                    freq.replica, freq.erid = t.dest, erid2
+                    freq.status = RequestStatus.QUEUED
+                    dest.rid_map[erid2] = t.frid
+                self.metrics.on_migration_fallback()
+                self.tracer.instant("migrate_fallback", cat="fleet",
+                                    frid=t.frid, seq=t.seq)
+                return "done"
+            # the CURRENT attempt has materialized len(generated) tokens
+            # — NOT freq.emitted: a handoff of a mid-replay resubmit
+            # (emitted > generated) would otherwise mis-index the
+            # destination's next token and forward the wrong one
+            freq.attempt_tokens = len(blob.generated)
+            rid2 = import_chain(dest.engine, blob,
+                                on_token=self._wrap_on_token(freq),
+                                now=now)
+            if rid2 is None:
+                return "retry"
+            self._mig_pending.pop(t.frid, None)
+            # unbind BEFORE cancelling so _harvest never reads the
+            # source's CANCELLED as this fleet rid's terminal status
+            src.rid_map.pop(t.erid, None)
+            if not src.engine.status(t.erid).terminal:
+                # the source's full prefix pages stay parked in its
+                # PrefixCache (RECLAIMABLE) — still exportable as seeds
+                src.engine.cancel(t.erid, now=now)
+            freq.replica, freq.erid = t.dest, rid2
+            freq.status = RequestStatus.RUNNING
+            dest.rid_map[rid2] = t.frid
+            if self.routing == "affinity":
+                # the chain's pages now live on the decode replica: it
+                # is the deepest owner for this prompt's prefix
+                self._record_owner(
+                    prefix_chain_hashes(freq.prompt, self._page_size()),
+                    t.dest)
+            self.metrics.on_migration_applied(blob.num_pages, blob.nbytes)
+            self.tracer.instant("migrate_apply", cat="fleet", frid=t.frid,
+                                src=t.src, dest=t.dest,
+                                pages=blob.num_pages, bytes=blob.nbytes)
+        return "done"
+
+    def _apply_seed(self, t: _Transfer) -> None:
+        src = self.replicas[t.src]
+        dest = self.replicas[t.dest]
+        blob = export_prefix(src.engine, t.tokens)
+        if blob is None:
+            return                    # owner evicted it meanwhile
+        blocks, nbytes = import_prefix(dest.engine, blob)
+        if blocks:
+            self.metrics.on_seed(blocks, nbytes)
+            self.tracer.instant("seed_apply", cat="fleet", src=t.src,
+                                dest=t.dest, blocks=blocks, bytes=nbytes)
+
+    def _seed_for_resubmit(self, freq: _FleetRequest) -> None:
+        """Re-adopt surviving pages after a death resubmit: seed the
+        resubmit target's cache from whichever live replica holds the
+        DEEPEST cached prefix of the prompt, so the replay stitches onto
+        imported pages instead of re-prefilling from token 0."""
+        if not (self._disagg and self.migrate_budget > 0):
+            return
+        dest = self.replicas[freq.replica]
+        if dest.engine.cache is None:
+            return
+        page = self._page_size()
+        best, best_len = None, dest.engine.cache.lookup(freq.prompt)[1]
+        for r in self.replicas:
+            if r.idx == dest.idx or r.state is ReplicaState.DEAD or \
+                    r.engine.cache is None:
+                continue
+            hit_len = r.engine.cache.lookup(freq.prompt)[1]
+            if hit_len > best_len:
+                best, best_len = r, hit_len
+        if best is None or best_len < page:
+            return                    # nobody holds more than the target
+        blob = export_prefix(best.engine, freq.prompt)
+        if blob is None:
+            return
+        blocks, nbytes = import_prefix(dest.engine, blob)
+        if blocks:
+            self.metrics.on_seed(blocks, nbytes)
+            self.metrics.on_migration_resubmit()
+            self.tracer.instant("readopt", cat="fleet", frid=freq.frid,
+                                src=best.idx, dest=dest.idx,
+                                blocks=blocks, bytes=nbytes)
 
     # ---- invariants / health ----------------------------------------------
 
@@ -756,10 +1139,12 @@ class FleetRouter:
                 ok = False
             reps[rep.idx] = {
                 "state": rep.state.value,
+                "role": rep.role,
                 "ok": hz["ok"],
                 "queue_depth": hz["queue_depth"],
                 "running": hz["running"],
                 "free_pages": hz["free_pages"],
+                "prefill_backlog_tokens": hz["prefill_backlog_tokens"],
                 "prefix_hit_rate": round(
                     rep.engine.metrics.prefix_hit_rate(), 4),
                 "dead_reason": rep.dead_reason,

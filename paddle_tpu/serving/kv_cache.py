@@ -356,6 +356,49 @@ def fork_page(kv: KVPages, src: jax.Array, dst: jax.Array) -> KVPages:
     return KVPages(k, v)
 
 
+def read_pages(kv: KVPages, page_ids: Sequence[int]):
+    """Pull whole pages to the host as STORED values — the export half
+    of the page-migration plane (``serving/migrate.py``).
+
+    page_ids: n page ids.  Returns ``(k, v, k_scale, v_scale)`` numpy
+    arrays, k/v shaped [L, n, page, H_kv, D] in the pool dtype and the
+    scales [L, n, page, H_kv] f32 (None for float pools).  int8 pages
+    are NOT dequantized: migration moves the quantized bytes plus their
+    scales verbatim, so the destination reads bit-identical K/V and the
+    transfer costs ~1/4 the f32 bytes."""
+    import numpy as np
+
+    ids = jnp.asarray(list(page_ids), jnp.int32)
+    k = np.asarray(kv.k[:, ids])
+    v = np.asarray(kv.v[:, ids])
+    if kv.quantized:
+        return (k, v, np.asarray(kv.k_scale[:, ids]),
+                np.asarray(kv.v_scale[:, ids]))
+    return k, v, None, None
+
+
+def write_pages(kv: KVPages, page_ids: jax.Array, k: jax.Array,
+                v: jax.Array, k_scale: Optional[jax.Array] = None,
+                v_scale: Optional[jax.Array] = None) -> KVPages:
+    """Splice whole pages into the pool — the import half of the
+    migration plane, shape-compatible with :func:`read_pages` output.
+
+    page_ids: [n] int32 destination ids (pad rows with NULL_PAGE and
+    zero payload: nothing reads the null page, so padded writes keep
+    the jitted import ladder shape-stable).  Stored values go in
+    verbatim — no re-quantization — so an exported int8 page arrives
+    bit-identical, scales included.  Pure; returns the updated pool."""
+    kk = kv.k.at[:, page_ids].set(k.astype(kv.k.dtype))
+    vv = kv.v.at[:, page_ids].set(v.astype(kv.v.dtype))
+    if kv.quantized:
+        return KVPages(kk, vv,
+                       kv.k_scale.at[:, page_ids].set(
+                           k_scale.astype(jnp.float32)),
+                       kv.v_scale.at[:, page_ids].set(
+                           v_scale.astype(jnp.float32)))
+    return KVPages(kk, vv)
+
+
 def gather_kv(kv: KVPages, layer: int, page_table: jax.Array):
     """Linearize page tables into contiguous K/V.
 

@@ -315,6 +315,23 @@ class FleetMetrics:
         self.replicas_joined = 0
         self.replicas_dead = 0       # killed / lease-expired
         self.replicas_drained = 0    # clean DRAINING -> DEAD retirements
+        # page-migration plane (round 16).  The conservation invariant:
+        # every started migration ends exactly one way —
+        #   migrations_started == applied + fallbacks + aborted
+        # (applied = chain spliced into the destination; fallback = blob
+        # dropped in flight, destination re-prefills; aborted = the
+        # source request reached a terminal status before the transfer
+        # cleared admission).
+        self.migrations_started = 0
+        self.migrations_applied = 0
+        self.migration_fallbacks = 0
+        self.migrations_aborted = 0
+        self.pages_migrated = 0      # pages spliced by applied handoffs
+        self.migration_bytes = 0     # host-blob payload bytes, applied only
+        self.cross_replica_seeds = 0  # prefix exports that warmed a peer
+        self.seed_pages = 0
+        self.seed_bytes = 0
+        self.migration_resubmits = 0  # death resubmits that re-adopted pages
         self._first_event_at: Optional[float] = None
         self._last_token_at: Optional[float] = None
 
@@ -332,6 +349,28 @@ class FleetMetrics:
 
     def on_resubmit(self) -> None:
         self.resubmits += 1
+
+    def on_migration_start(self) -> None:
+        self.migrations_started += 1
+
+    def on_migration_applied(self, pages: int, nbytes: int) -> None:
+        self.migrations_applied += 1
+        self.pages_migrated += int(pages)
+        self.migration_bytes += int(nbytes)
+
+    def on_migration_fallback(self) -> None:
+        self.migration_fallbacks += 1
+
+    def on_migration_aborted(self) -> None:
+        self.migrations_aborted += 1
+
+    def on_seed(self, pages: int, nbytes: int) -> None:
+        self.cross_replica_seeds += 1
+        self.seed_pages += int(pages)
+        self.seed_bytes += int(nbytes)
+
+    def on_migration_resubmit(self) -> None:
+        self.migration_resubmits += 1
 
     def on_token(self, now: float) -> None:
         self.tokens_emitted += 1
@@ -393,4 +432,14 @@ class FleetMetrics:
             "fleet_replicas_joined": self.replicas_joined,
             "fleet_replicas_dead": self.replicas_dead,
             "fleet_replicas_drained": self.replicas_drained,
+            "fleet_migrations_started": self.migrations_started,
+            "fleet_migrations_applied": self.migrations_applied,
+            "fleet_migration_fallbacks": self.migration_fallbacks,
+            "fleet_migrations_aborted": self.migrations_aborted,
+            "fleet_pages_migrated": self.pages_migrated,
+            "fleet_migration_bytes": self.migration_bytes,
+            "fleet_cross_replica_seeds": self.cross_replica_seeds,
+            "fleet_seed_pages": self.seed_pages,
+            "fleet_seed_bytes": self.seed_bytes,
+            "fleet_migration_resubmits": self.migration_resubmits,
         }

@@ -165,6 +165,12 @@ class ContinuousBatchingScheduler:
         self.running: Dict[int, Request] = {}       # slot -> request
         self._free_slots: List[int] = list(range(cfg.max_slots - 1, -1, -1))
         self.preemption_count = 0
+        # O(1) load probe for class-aware fleet routing (round 16):
+        # prompt tokens still to prefill across queued + running
+        # requests, maintained incrementally on every cache_len edge
+        # (submit/admit/chunk/preempt/release).  ``recompute_backlog``
+        # is the audit-time ground truth.
+        self.prefill_backlog_tokens = 0
 
     # ---- admission -------------------------------------------------------
 
@@ -188,7 +194,40 @@ class ContinuousBatchingScheduler:
             return False
         req.status = RequestStatus.QUEUED
         self.queue.append(req)
+        self._backlog_enter(req)
         return True
+
+    # ---- prefill-backlog accounting (round 16) ----------------------------
+    #
+    # Invariant: ``prefill_backlog_tokens`` equals the sum over every
+    # queued-or-running request of ``max(0, len(prompt) - cache_len)`` —
+    # the prompt tokens the engine still owes a prefill.  Decoding
+    # requests (cache_len >= prompt) contribute 0, so the number is the
+    # pure prefill debt the fleet router reads before dispatching a
+    # prompt to a prefill-class replica.
+
+    def _backlog_enter(self, req: Request) -> None:
+        self.prefill_backlog_tokens += max(0,
+                                           len(req.prompt) - req.cache_len)
+
+    def _backlog_leave(self, req: Request) -> None:
+        self.prefill_backlog_tokens -= max(0,
+                                           len(req.prompt) - req.cache_len)
+
+    def note_prefill_progress(self, req: Request, old_cache_len: int) -> None:
+        """Re-account a tracked request after its ``cache_len`` moved
+        (admission stitch, a finished prefill chunk, a preemption reset).
+        The engine calls this from ``_finish_chunk``; the scheduler's
+        own edges call it internally."""
+        plen = len(req.prompt)
+        self.prefill_backlog_tokens += (max(0, plen - req.cache_len)
+                                        - max(0, plen - old_cache_len))
+
+    def recompute_backlog(self) -> int:
+        """Ground-truth backlog (O(requests)); the migrate conservation
+        checker compares this against the incremental counter."""
+        live = list(self.queue) + list(self.running.values())
+        return sum(max(0, len(r.prompt) - r.cache_len) for r in live)
 
     def _pages_for(self, n_tokens: int) -> int:
         return -(-n_tokens // self.cfg.page_size)  # ceil
@@ -263,8 +302,10 @@ class ContinuousBatchingScheduler:
                 # re-walk sees the same entries)
                 self.cache.lookup(toks, touch=True)
             req.pages = shared + new     # page j holds tokens [jP, jP+P)
+            old_len = req.cache_len      # 0 (fresh or preempt-reset)
             req.cached_len = stitched
             req.cache_len = stitched     # engine prefills from here on
+            self.note_prefill_progress(req, old_len)
             req.cow_src = cow_src        # fork target is new[0] (engine)
             req.slot = self._free_slots.pop()
             req.status = RequestStatus.RUNNING
@@ -279,6 +320,7 @@ class ContinuousBatchingScheduler:
                      "status", context="serving")
         try:
             self.queue.remove(req)
+            self._backlog_leave(req)
         except ValueError:
             pass
         req.status = status
@@ -374,7 +416,9 @@ class ContinuousBatchingScheduler:
             self.tracer.instant("preempt", rid=req.rid, slot=req.slot,
                                 preemptions=req.preemptions + 1)
         self._release_slot_and_pages(req)
+        old_len = req.cache_len
         req.cache_len = 0
+        self.note_prefill_progress(req, old_len)  # re-owes its prefill
         req.cached_len = 0
         req.cow_src = None
         req.prefilling = False       # re-stitched at re-admission
@@ -410,6 +454,7 @@ class ContinuousBatchingScheduler:
         all exit through here so none of them can leak."""
         enforce_that(status in _TERMINAL, "release needs a terminal status",
                      context="serving")
+        self._backlog_leave(req)
         self._release_slot_and_pages(req)
         req.status = status
 

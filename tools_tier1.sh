@@ -148,4 +148,28 @@ elif [ "$resil_rc" -ne 0 ]; then
     print_postmortems
     exit 10
 fi
+# page-migration conservation gate (paddle_tpu.serving.migrate): replays
+# a seeded disaggregated 2-prefill/2-decode fleet with live chain
+# handoffs, an injected blob drop (fallback re-prefill), a decode-replica
+# kill (prefix re-adoption) and cross-replica prefix seeds, then checks
+# the migration ledger balances (started == applied + fallbacks +
+# aborted), no transfer is left pending after drain, every replica's O(1)
+# prefill-backlog probe matches a from-scratch recompute, and both pools
+# conserve pages/refs.  Exit 11 extends the ladder (3/4/5/6/7/8/9/10);
+# same contract as the lint/fleet/xla/shard/resilience gates: branch on
+# the checker's OWN exit status (findings=1, crash=2), never on a grep of
+# the shared log — migration tests intentionally print MIGRATE-LEAK
+# lines.  Run via -c, not -m: runpy would execute a second copy of
+# migrate.py next to the one the serving package already imported.
+env JAX_PLATFORMS=cpu python -c 'import sys; from paddle_tpu.serving.migrate import main; sys.exit(main(["check"]))' 2>&1 | tee -a /tmp/_t1.log
+mig_rc=${PIPESTATUS[0]}
+if [ "$mig_rc" -eq 1 ]; then
+    echo 'MIGRATE-LEAK: page-migration conservation violated (see log above)'
+    print_postmortems
+    exit 11
+elif [ "$mig_rc" -ne 0 ]; then
+    echo "MIGRATE-LEAK: migration checker itself exited $mig_rc without running to completion"
+    print_postmortems
+    exit 11
+fi
 exit $rc
