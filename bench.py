@@ -1761,6 +1761,187 @@ def worker_serving_disagg():
     print(json.dumps(out), flush=True)
 
 
+def worker_serving_control():
+    """Multi-tenant control-plane A/B (round 17): the six-tenant
+    shared-prefix trace of worker_serving_fleet, sharpened into an
+    adversarial 10x swing — one batch-class tenant storms at ten times
+    the polite tenants' rate (FleetFaultPlan.tenant_storm, its own
+    seeded RNG stream) while two interactive and three standard tenants
+    submit steadily under their SLO-class deadlines.  The SAME arrivals
+    replay twice through two replicas: weighted-fair queuing ON vs OFF
+    (FIFO dispatch, the control).  The claim is isolation, asserted
+    per tenant and not on averages: with WFQ on, EVERY non-storming
+    tenant finishes with zero deadline misses — the storm's backlog is
+    charged to the storming tenant's own virtual-time queue — while the
+    FIFO control makes polite interactive tenants miss behind the
+    storm's head-of-line burst.  The storm tenant is also token-bucket
+    metered, so the admission ledger shows real quota_deferred work
+    (identical across replays: the bucket sees the same costs at the
+    same injected times).  A third replay turns the autoscaler on and
+    KILLS a replica mid-storm: the fleet grows under the kill (join
+    races death), shrinks back once drained, and the exactly-once +
+    CONTROL-LEAK contracts hold through every scaling event — ledger
+    partitions per tenant, no duplicate completions, zero page/ref
+    leaks on every replica including the killed and drained ones.  A
+    static fleet pinned at the autoscaler's max handles the same trace
+    for the efficiency claim: the elastic fleet spends fewer
+    replica-ticks at token-identical outputs (greedy parity — scaling
+    changes WHERE, never WHAT)."""
+    import numpy as np
+
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import (AutoscalePolicy, DecoderLM,
+                                    FleetFaultPlan, FleetRouter,
+                                    ManualClock, RequestStatus,
+                                    ServingEngine, TenantRegistry,
+                                    check_control_conservation)
+
+    paddle.init()
+    vocab, eos = 256, 1
+    model = DecoderLM(vocab_size=vocab, num_layers=1, num_heads=2,
+                      head_dim=16, max_positions=256)
+    params = model.init_params(jax.random.PRNGKey(0))
+
+    tenants = ["web", "chat", "app", "api", "etl", "storm"]
+    classes = {"web": "interactive", "chat": "interactive",
+               "app": "standard", "api": "standard", "etl": "standard",
+               "storm": "batch"}
+    rng0 = np.random.RandomState(0)
+    systems = {t: rng0.randint(2, vocab, size=32).tolist()
+               for t in tenants}                    # 2 full pages each
+    storm_mult, window_end = 10, 10
+
+    def mk_registry():
+        reg = TenantRegistry()
+        for t in tenants:
+            if t == "storm":
+                # metered: the storm pays for its own burst at the
+                # bucket, before it can even reach the WFQ
+                reg.register(t, classes[t], quota_tokens_per_s=3000.0,
+                             burst_tokens=800.0)
+            else:
+                reg.register(t, classes[t])
+        return reg
+
+    def replay(wfq, autoscale=None, n=2, kill=None, idle_tail=0):
+        clock = ManualClock(tick_s=0.02)
+        plan = FleetFaultPlan(seed=0, clock=clock, kill_at=(kill or {}),
+                              tenant_storm=("storm", 0, window_end,
+                                            storm_mult))
+
+        def mk(i, time_fn):
+            return ServingEngine(model, params, eos_id=eos, page_size=16,
+                                 num_pages=48, max_pages_per_seq=6,
+                                 max_slots=4, buckets=(16, 64),
+                                 prefill_chunk=32, time_fn=time_fn)
+
+        fleet = FleetRouter(mk, n, heartbeat_s=0.1, resubmit_budget=2,
+                            faults=plan, tenants=mk_registry(), wfq=wfq,
+                            autoscale=autoscale)
+        rng = np.random.RandomState(1)
+        rids = []
+        tick = 0
+        while tick < window_end or fleet.has_work:
+            if tick < window_end and tick % 2 == 0:
+                for t in tenants:
+                    for _ in range(plan.storm_factor(tick, t)):
+                        prompt = systems[t] + rng.randint(
+                            2, vocab, size=int(rng.randint(4, 10))).tolist()
+                        rids.append((t, fleet.submit(prompt, max_tokens=6,
+                                                     tenant=t)))
+            fleet.step()
+            tick += 1
+            assert tick < 5000, "control trace failed to drain"
+        snap_at_drain = fleet.snapshot()
+        for _ in range(idle_tail):      # cold ticks: let scale-downs land
+            fleet.step()
+        check_control_conservation(fleet)
+        assert all(fleet.status(r).terminal for _, r in rids)
+        snap = fleet.snapshot()
+        assert snap["fleet_duplicate_completions"] == 0
+        # keyed by submission index, NOT frid: the frid counter is
+        # process-global, so only the arrival order lines replays up
+        outs = {j: fleet.result(frid) for j, (_, frid) in enumerate(rids)
+                if fleet.status(frid) is RequestStatus.COMPLETED}
+        hz = fleet.healthz()
+        led = fleet.ledger.snapshot()
+        # a polite tenant's misses live in two places: engine-side
+        # timeouts (healthz aggregation) and router-side WFQ sheds
+        # (ledger) — isolation must hold across BOTH
+        misses = {t: hz["tenants"].get(t, {}).get("deadline_misses", 0) +
+                  led.get(t, {}).get("shed", 0) for t in tenants}
+        return {"outs": outs, "snap": snap, "snap_at_drain": snap_at_drain,
+                "misses": misses, "ledger": led, "ticks": tick,
+                "fleet": fleet}
+
+    on = replay(wfq=True)
+    off = replay(wfq=False)
+
+    polite = [t for t in tenants if t != "storm"]
+    # THE isolation claim, per tenant: WFQ keeps every polite tenant at
+    # zero misses under the 10x storm; FIFO lets the storm starve them
+    assert all(on["misses"][t] == 0 for t in polite), on["misses"]
+    assert sum(off["misses"][t] for t in polite) > 0, off["misses"]
+    # the bucket metered the storm identically in both replays — same
+    # costs at the same injected times, WFQ on or off
+    assert on["ledger"]["storm"]["quota_deferred"] > 0
+    assert (on["ledger"]["storm"]["quota_deferred"] ==
+            off["ledger"]["storm"]["quota_deferred"])
+    # greedy parity on common completions: queuing policy changes WHEN
+    # a request runs, never WHAT it decodes
+    common = sorted(set(on["outs"]) & set(off["outs"]))
+    assert common and all(on["outs"][f] == off["outs"][f] for f in common)
+
+    # elastic replay: kill replica 0 mid-storm with the autoscaler live
+    policy = AutoscalePolicy(min_replicas=2, max_replicas=4,
+                             buffered_hi=4, cooldown_ticks=3)
+    auto = replay(wfq=True, autoscale=policy, kill={4: 0}, idle_tail=20)
+    scaler = auto["fleet"].autoscaler
+    assert auto["snap"]["fleet_replicas_dead"] >= 1
+    assert scaler.scale_ups >= 1, "fleet never grew under the kill"
+    assert scaler.scale_downs >= 1, "fleet never shrank after the storm"
+    # static control pinned at the autoscaler's ceiling, same arrivals
+    static = replay(wfq=True, n=policy.max_replicas)
+    elastic_common = sorted(set(auto["outs"]) & set(static["outs"]))
+    assert elastic_common and all(
+        auto["outs"][j] == static["outs"][j] for j in elastic_common), \
+        "autoscaling broke greedy parity"
+    auto_rt = auto["snap_at_drain"]["control_replica_ticks"]
+    static_rt = policy.max_replicas * static["ticks"]
+    assert auto_rt < static_rt, (auto_rt, static_rt)
+
+    out = {
+        "serving_control_model": "decoderlm_L1_H2_D16_v256_page16_pool48"
+                                 "_slots4_6tenants_storm10x_sys32",
+        "serving_control_requests": (len(on["outs"]) +
+                                     sum(v["quota_deferred"]
+                                         for v in on["ledger"].values())),
+        "serving_control_polite_misses_wfq":
+            sum(on["misses"][t] for t in polite),
+        "serving_control_polite_misses_fifo":
+            sum(off["misses"][t] for t in polite),
+        "serving_control_storm_quota_deferred":
+            on["ledger"]["storm"]["quota_deferred"],
+        "serving_control_storm_submitted":
+            on["ledger"]["storm"]["submitted"],
+        "serving_control_parity_ok": int(all(on["outs"][f] == off["outs"][f]
+                                             for f in common)),
+        "serving_control_parity_checked": len(common),
+        "serving_control_scale_ups": scaler.scale_ups,
+        "serving_control_scale_downs": scaler.scale_downs,
+        "serving_control_replica_ticks_auto": auto_rt,
+        "serving_control_replica_ticks_static": static_rt,
+        "serving_control_replica_ticks_saved":
+            round(1.0 - auto_rt / max(1, static_rt), 4),
+        "serving_control_chaos_resubmits":
+            auto["snap"]["fleet_resubmits"],
+        "serving_control_duplicate_completions": 0,
+    }
+    print(json.dumps(out), flush=True)
+
+
 def worker_moe():
     """MoE transformer LM vs its dense twin on one chip: single-chip
     Switch-style MoE (top-1 routing, dense dispatch formulation) at the
@@ -1998,6 +2179,7 @@ WORKERS = {
     "serving_tp": worker_serving_tp,
     "serving_fleet": worker_serving_fleet,
     "serving_disagg": worker_serving_disagg,
+    "serving_control": worker_serving_control,
     "train_chaos": worker_train_chaos,
     "moe": worker_moe,
 }
@@ -2086,7 +2268,8 @@ def main():
     for cpu_worker in ("scaling", "zero1", "serving", "serving_chaos",
                        "serving_prefix", "serving_mixed", "serving_spec",
                        "serving_tp",
-                       "serving_fleet", "serving_disagg", "train_chaos"):
+                       "serving_fleet", "serving_disagg", "serving_control",
+                       "train_chaos"):
         out, err = _run_worker(cpu_worker, deadline, cpu=True,
                                attempt_timeout=380, max_attempts=1)
         if out:

@@ -263,6 +263,39 @@ FLAGS.define("serving_migrate_budget", 16,
              "queue and never blocks its decode tick. 0 disables "
              "migration (prefill-class replicas then decode their own "
              "requests to completion).", parser=int)
+FLAGS.define("serving_tenant_classes", "",
+             "multi-tenant SLO registry for the fleet control plane "
+             "(serving/control.py): a comma list of 'name:class' pairs "
+             "('alice:interactive,bulk:batch'; a bare name means "
+             "standard). Classes bind latency-tier deadlines "
+             "(interactive 0.5s / standard 2s / batch none), WFQ "
+             "weights (4/2/1) and preemption precedence (batch slots "
+             "are victimized first). Empty = no registry: submits keep "
+             "their explicit deadlines, quotas and precedence are off. "
+             "Unknown tenants auto-register as standard on first "
+             "touch.")
+FLAGS.define("serving_wfq", False,
+             "weighted fair queuing at the FleetRouter: submits buffer "
+             "in per-tenant virtual-time queues (prompt-token-weighted "
+             "service, weights from the tenant registry) and release "
+             "to dispatch each tick bounded by the READY replicas' "
+             "admission slack — one tenant's 10x prompt storm backlogs "
+             "only its own queue while other tenants keep their "
+             "deadline SLO. Off = the classic submit->dispatch FIFO.")
+FLAGS.define("serving_autoscale", False,
+             "fleet autoscaler policy loop (serving/control.py "
+             "Autoscaler) on the fleet's injected clock: joins a "
+             "replica when any pressure signal breaches its hi "
+             "threshold (queue_wait_ms_p95, live-page fraction, "
+             "prefill backlog, WFQ backlog, fresh deadline misses) and "
+             "drains the newest idle replica when the fleet is "
+             "provably idle — never the last prefill-capable replica "
+             "of a disaggregated fleet. Hysteresis via "
+             "serving_autoscale_cooldown.")
+FLAGS.define("serving_autoscale_cooldown", 10,
+             "autoscaler hysteresis: fleet ticks with NO scaling "
+             "action after any join/drain, so one pressure spike "
+             "cannot flap the fleet size tick-over-tick.", parser=int)
 FLAGS.define("obs_trace", False,
              "request-scoped span tracing (paddle_tpu.obs): when on, "
              "ServingEngine/FleetRouter construct a real Tracer on "

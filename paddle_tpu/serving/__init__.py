@@ -11,6 +11,11 @@ from paddle_tpu.serving.decode_attention import (
     BLOCK_ROWS, attention_path, paged_decode_attention,
     paged_decode_attention_reference, ragged_paged_attention,
     ragged_paged_attention_reference, ragged_paged_attention_tp)
+from paddle_tpu.serving.control import (DEFAULT_CLASSES, AdmissionLedger,
+                                        Autoscaler, AutoscalePolicy,
+                                        TenantClass, TenantRegistry,
+                                        TenantSpec, WeightedFairQueue,
+                                        check_control_conservation)
 from paddle_tpu.serving.engine import (DecodeModel, DecoderLM, ServingEngine,
                                        greedy_decode_reference, validate_tp)
 from paddle_tpu.serving.speculate import (DraftProposer, NGramProposer,
@@ -54,6 +59,9 @@ __all__ = [
     "FleetRouter", "Replica", "ReplicaState",
     "MigrationBlob", "export_chain", "import_chain", "export_prefix",
     "import_prefix", "check_migration_conservation",
+    "TenantClass", "TenantSpec", "TenantRegistry", "DEFAULT_CLASSES",
+    "AdmissionLedger", "WeightedFairQueue", "AutoscalePolicy", "Autoscaler",
+    "check_control_conservation",
     "SamplingParams", "NGramProposer", "DraftProposer", "accept_tokens",
     "next_token", "warp_probs",
 ]

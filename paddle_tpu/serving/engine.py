@@ -996,7 +996,8 @@ class ServingEngine:
                now: Optional[float] = None,
                queue_deadline_s: Optional[float] = None,
                deadline_s: Optional[float] = None,
-               sampling: Optional[SamplingParams] = None) -> int:
+               sampling: Optional[SamplingParams] = None,
+               tenant: str = "default") -> int:
         """Queue a request and return its rid — ALWAYS, even when the
         request is refused (infeasible size or queue backpressure): a
         refused rid carries status ``REJECTED``, so callers distinguish
@@ -1015,7 +1016,7 @@ class ServingEngine:
         oracle."""
         req = Request(prompt=list(int(t) for t in prompt),
                       max_tokens=int(max_tokens), on_token=on_token,
-                      sampling=sampling)
+                      sampling=sampling, tenant=str(tenant))
         t = self._time() if now is None else now
         if queue_deadline_s is None:
             # engine-wide default; self.queue_deadline_s is None when
@@ -1080,6 +1081,11 @@ class ServingEngine:
             RequestStatus.FAILED: self.metrics.on_fail,
         }[status]
         hook()
+        if shed or status is RequestStatus.TIMED_OUT:
+            # deadline miss billed to the tenant (round 17): both the
+            # hard expiry and the unmeetable-estimate shed count — same
+            # numerator as deadline_miss_rate, split per tenant
+            self.metrics.on_tenant_miss(req.tenant)
         if req.first_token_at is not None:
             self._observe_stage("decode", now - req.first_token_at)
         self._tracer.instant("terminal", rid=req.rid, status=str(status),
@@ -1178,6 +1184,7 @@ class ServingEngine:
                 wait = now - (req.submitted_at
                               if req.submitted_at is not None else now)
                 m.on_admit(wait)
+                m.on_tenant_admit(req.tenant, wait)
                 self._observe_stage("queue", wait)
                 req.admitted_at = now
             req.last_progress_tick = tick
@@ -1356,7 +1363,38 @@ class ServingEngine:
                 "prefill_backlog_tokens":
                     self.scheduler.prefill_backlog_tokens,
                 "role": self.role,
-                "draining": self._draining}
+                "draining": self._draining,
+                # per-tenant split (round 17): the control plane's WFQ /
+                # autoscaler read this; O(live requests), still cheap at
+                # the bounded slot/queue sizes this probe already scans
+                "tenants": self.tenant_counts()}
+
+    def tenant_counts(self) -> Dict[str, Dict[str, int]]:
+        """Per-tenant live/terminal split: running, queued and
+        pages_in_use from the bounded live scans, deadline_misses from
+        the metrics counter.  Keys appear once a tenant has ever been
+        seen live, been admitted, or missed a deadline — "default"
+        covers legacy callers that never pass ``tenant=``."""
+        out: Dict[str, Dict[str, int]] = {}
+
+        def _slot(t: str) -> Dict[str, int]:
+            return out.setdefault(t, {"running": 0, "queued": 0,
+                                      "pages_in_use": 0,
+                                      "deadline_misses": 0})
+
+        for req in self.scheduler.running.values():
+            s = _slot(req.tenant)
+            s["running"] += 1
+            s["pages_in_use"] += len(req.pages)
+        for req in self.scheduler.queued_requests():
+            _slot(req.tenant)["queued"] += 1
+        for t, n in self.metrics.tenant_deadline_misses.items():
+            _slot(t)["deadline_misses"] = n
+        # tenants whose work all completed cleanly must still report a
+        # zero-miss row: the admission window remembers everyone admitted
+        for t in self.metrics.tenant_queue_wait_s:
+            _slot(t)
+        return out
 
     def healthz(self) -> Dict[str, object]:
         """One-call liveness snapshot for an external prober.  O(live
@@ -1437,6 +1475,9 @@ class ServingEngine:
             "prefill_backlog_tokens":
                 self.scheduler.prefill_backlog_tokens,
             "role": self.role,
+            # per-tenant counters (round 17) on the full diagnostic
+            # surface, same shape as load()["tenants"]
+            "tenants": self.tenant_counts(),
         }
 
     # ---- internals -------------------------------------------------------

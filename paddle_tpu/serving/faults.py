@@ -205,6 +205,13 @@ class FleetFaultPlan:
       ``migration_fallbacks``) with the exactly-once token stream
       preserved.  Draws come from a SEPARATE ``RandomState(seed + 1)``
       so adding migration faults never perturbs the kill schedule.
+    - **tenant storm** — ``tenant_storm`` ((tenant, start_tick,
+      end_tick, multiplier)): one tenant's arrival rate multiplies by
+      ``multiplier`` (plus seeded 0/+1 jitter) for every tick in the
+      window — the adversarial load swing the control plane's WFQ must
+      isolate.  Jitter draws come from a SEPARATE
+      ``RandomState(seed + 2)`` (same pattern as the migration stream)
+      so adding a storm never perturbs kill or migration schedules.
     """
 
     seed: int = 0
@@ -216,10 +223,14 @@ class FleetFaultPlan:
     # page-migration faults (round 16)
     migration_drop_rate: float = 0.0
     drop_migration_at: Set[int] = field(default_factory=set)
+    # multi-tenant storm (round 17): (tenant, start_tick, end_tick,
+    # multiplier) — None disables
+    tenant_storm: Optional[Tuple[str, int, int, int]] = None
 
     def __post_init__(self):
         self._rng = np.random.RandomState(self.seed)
         self._mig_rng = np.random.RandomState(self.seed + 1)
+        self._storm_rng = np.random.RandomState(self.seed + 2)
 
     def tick_begin(self, tick: int) -> None:
         """Advance the injected clock for this fleet tick (all replicas
@@ -259,3 +270,17 @@ class FleetFaultPlan:
         flavors replay identically when combined."""
         hit = bool(self._mig_rng.random_sample() < self.migration_drop_rate)
         return seq in self.drop_migration_at or hit
+
+    def storm_factor(self, tick: int, tenant: str) -> int:
+        """Arrival-rate multiplier for ``tenant`` at ``tick``: 1 outside
+        the storm window (or for other tenants), ``multiplier`` plus
+        seeded 0/+1 jitter inside it.  One jitter draw per call whenever
+        a storm is configured — window hit or not — so the stream stays
+        aligned across replays regardless of who asks on which tick."""
+        if self.tenant_storm is None:
+            return 1
+        who, start, end, mult = self.tenant_storm
+        jitter = int(self._storm_rng.randint(2))
+        if tenant != who or not (start <= tick < end):
+            return 1
+        return max(1, int(mult) + jitter)

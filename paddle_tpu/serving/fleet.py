@@ -73,6 +73,9 @@ from paddle_tpu.obs.registry import MetricsRegistry
 from paddle_tpu.obs.trace import NULL_TRACER, tracer_for
 from paddle_tpu.platform.enforce import enforce_that
 from paddle_tpu.platform.flags import FLAGS
+from paddle_tpu.serving.control import (AdmissionLedger, Autoscaler,
+                                        AutoscalePolicy, TenantRegistry,
+                                        WeightedFairQueue)
 from paddle_tpu.serving.engine import ServingEngine
 from paddle_tpu.serving.faults import FleetFaultPlan, PageLeakError
 from paddle_tpu.serving.kv_cache import prefix_chain_hashes
@@ -120,6 +123,8 @@ class _FleetRequest:
     submitted_at: Optional[float] = None
     finished_at: Optional[float] = None
     terminal_transitions: int = 0         # conservation: must end at 1
+    tenant: str = "default"               # billing identity; survives
+    #                                       resubmits and migrations
 
     @property
     def finished(self) -> bool:
@@ -205,7 +210,10 @@ class FleetRouter:
                  tracer=None,
                  registry: Optional[MetricsRegistry] = None,
                  roles: Optional[Sequence[str]] = None,
-                 migrate_budget: Optional[int] = None):
+                 migrate_budget: Optional[int] = None,
+                 tenants: Optional[TenantRegistry] = None,
+                 wfq: Optional[bool] = None,
+                 autoscale=None):
         enforce_that(routing in ("affinity", "round_robin"),
                      f"unknown routing policy {routing!r}",
                      context="serving")
@@ -284,6 +292,26 @@ class FleetRouter:
         self._mig_pending: Dict[int, _Transfer] = {}   # frid -> transfer
         self._mig_credit: Dict[int, int] = {}
         self._mig_seq = 0
+        # control plane (round 17): tenant SLO classes, weighted fair
+        # queuing ahead of dispatch, and the autoscaler policy loop.
+        # All three default off via flags, so the classic fleet is
+        # byte-identical; the admission ledger ALWAYS runs (it is free
+        # and the CONTROL-LEAK gate asserts it even with WFQ off).
+        if tenants is None:
+            raw = str(FLAGS.serving_tenant_classes).strip()
+            tenants = TenantRegistry.from_flag(raw) if raw else None
+        self.tenants = tenants
+        if wfq is None:
+            wfq = bool(FLAGS.serving_wfq)
+        self.wfq = WeightedFairQueue() if wfq else None
+        self.ledger = AdmissionLedger()
+        if autoscale is None:
+            autoscale = bool(FLAGS.serving_autoscale)
+        if autoscale is True:
+            autoscale = AutoscalePolicy(
+                cooldown_ticks=int(FLAGS.serving_autoscale_cooldown))
+        self.autoscaler = Autoscaler(self, autoscale) \
+            if isinstance(autoscale, AutoscalePolicy) else None
         for _ in range(num_replicas):
             self.add_replica()
         # initial replicas come up READY before the first submit (their
@@ -325,18 +353,41 @@ class FleetRouter:
 
     # ---- replica lifecycle ------------------------------------------------
 
-    def add_replica(self) -> int:
+    def add_replica(self, role: Optional[str] = None) -> int:
         """Elastic join: build an engine on the shared clock, claim a
         lease, enter JOINING.  Promoted to READY by the next tick's
-        sweep once the lease is live and healthz reports ok."""
+        sweep once the lease is live and healthz reports ok.
+
+        ``role`` pins the new replica's class explicitly (the
+        autoscaler joins where the pressure is); None keeps the
+        classic resolution — the fleet's roles list, then the engine's
+        own role, then "unified"."""
         idx = len(self.replicas)
         engine = self._make_engine(idx, self._time)
-        # role: the fleet's roles list wins (padding with "unified");
-        # an engine built with its own role keeps it when the list is
-        # silent about this index
-        role = self._roles[idx] if idx < len(self._roles) \
-            else getattr(engine, "role", "unified")
+        if role is None:
+            # role: the fleet's roles list wins (padding with
+            # "unified"); an engine built with its own role keeps it
+            # when the list is silent about this index
+            role = self._roles[idx] if idx < len(self._roles) \
+                else getattr(engine, "role", "unified")
+        else:
+            enforce_that(role in ("prefill", "decode", "unified"),
+                         f"unknown replica role {role!r}",
+                         context="serving")
+            # record the explicit role so _disagg and later joins see a
+            # consistent picture
+            while len(self._roles) < idx:
+                self._roles.append("unified")
+            if len(self._roles) == idx:
+                self._roles.append(role)
+            else:
+                self._roles[idx] = role
+            self._disagg = any(r != "unified" for r in self._roles)
         engine.role = role
+        if self.tenants is not None:
+            # preemption precedence: batch-class slots are victimized
+            # before interactive ones, on EVERY replica incl. late joins
+            engine.scheduler.precedence_fn = self.tenants.precedence
         rep = Replica(idx, engine, role=role)
         # one fleet-wide tracer/registry: the engine's instrumentation
         # points report under this replica's identity
@@ -358,6 +409,23 @@ class FleetRouter:
         enforce_that(rep.state in (ReplicaState.READY, ReplicaState.JOINING),
                      f"cannot drain replica in state {rep.state}",
                      context="serving")
+        if self._disagg and rep.role in ("prefill", "unified"):
+            # PINNED behavior (round 17): draining the LAST
+            # prefill-capable replica of a disaggregated fleet is
+            # REFUSED loudly rather than silently stranding every
+            # future prompt — the autoscaler filters its drain
+            # candidates on exactly this predicate, so the policy loop
+            # can never trip it
+            others = [o for o in self.replicas
+                      if o.idx != idx and
+                      o.state in (ReplicaState.READY,
+                                  ReplicaState.JOINING) and
+                      o.role in ("prefill", "unified")]
+            enforce_that(bool(others),
+                         f"refusing to drain replica {idx}: it is the "
+                         "last prefill-capable replica of a "
+                         "disaggregated fleet (prompts would have "
+                         "nowhere to prefill)", context="serving")
         rep.state = ReplicaState.DRAINING
         rep.engine.drain()
         self._forget_owner(idx)
@@ -575,28 +643,60 @@ class FleetRouter:
     def submit(self, prompt: Sequence[int], max_tokens: int,
                on_token: Optional[Callable[[int], None]] = None,
                deadline_s: Optional[float] = None,
-               now: Optional[float] = None) -> int:
+               now: Optional[float] = None,
+               tenant: str = "default") -> int:
         """Route a request into the fleet; returns its fleet rid ALWAYS
         (a refused request carries status REJECTED, mirroring the
         engine's contract).  ``deadline_s`` becomes an absolute deadline
         on the shared clock and carries over death-resubmits — a request
-        does not get a fresh budget because its replica died."""
+        does not get a fresh budget because its replica died.
+
+        ``tenant`` is the billing identity (round 17).  With a tenant
+        registry configured: a submit without its own ``deadline_s``
+        inherits the tenant's SLO-class deadline, and the tenant's
+        token bucket meters admission (an over-quota submit is REJECTED
+        up front and ledgered as quota_deferred).  With WFQ on, the
+        request buffers in the per-tenant virtual-time queue and is
+        released to dispatch at its weighted share on the next tick."""
         now = self._time() if now is None else now
+        tenant = str(tenant)
         freq = _FleetRequest(frid=next(_frid_counter),
                              prompt=[int(t) for t in prompt],
-                             max_tokens=int(max_tokens), on_token=on_token)
+                             max_tokens=int(max_tokens), on_token=on_token,
+                             tenant=tenant)
         freq.submitted_at = now
+        if deadline_s is None and self.tenants is not None:
+            deadline_s = self.tenants.deadline_s(tenant)
         if deadline_s is not None:
             freq.deadline_at = now + float(deadline_s)
         self._requests[freq.frid] = freq
         self._live.add(freq.frid)
         self.metrics.on_submit(now)
+        self.ledger.on_submit(tenant)
         # THE root span: one async begin per fleet rid, ended by the
         # request's single terminal transition in _finish — the
         # exactly-once invariant drawn as exactly one bar per rid
         self.tracer.async_begin("fleet_request", id=freq.frid,
                                 id_space="frid", tokens=len(freq.prompt),
                                 max_tokens=freq.max_tokens)
+        if self.tenants is not None and not self.tenants.admit_quota(
+                tenant, len(freq.prompt) + freq.max_tokens, now):
+            # token-bucket refusal: worst-case token cost (prompt +
+            # max_tokens), terminal REJECTED — the caller retries after
+            # the bucket refills, the fleet never buffers over-quota work
+            self.ledger.on_quota_deferred(tenant)
+            self.tracer.instant("quota_defer", cat="fleet",
+                                frid=freq.frid, tenant=tenant)
+            self._finish(freq, RequestStatus.REJECTED, now)
+            return freq.frid
+        if self.wfq is not None:
+            weight = self.tenants.weight(tenant) \
+                if self.tenants is not None else 1.0
+            self.wfq.push(tenant, len(freq.prompt), weight, freq)
+            self.tracer.instant("wfq_enqueue", cat="fleet",
+                                frid=freq.frid, tenant=tenant)
+            return freq.frid
+        self.ledger.on_admit(tenant)
         self._dispatch(freq, now)
         return freq.frid
 
@@ -616,6 +716,11 @@ class FleetRouter:
         if freq.finished:
             return False
         now = self._time() if now is None else now
+        if self.wfq is not None and self.wfq.remove(freq) is not None:
+            # cancelled while still buffered ahead of dispatch: it left
+            # the WFQ without being admitted — ledger it as shed so the
+            # per-tenant partition stays balanced
+            self.ledger.on_shed(freq.tenant)
         if freq.replica is not None:
             rep = self.replicas[freq.replica]
             rep.rid_map.pop(freq.erid, None)
@@ -653,6 +758,13 @@ class FleetRouter:
             for rep in doomed:
                 self._reap(rep, now)
         self._lease_sweep(tick, now)
+        # control plane (round 17), AFTER the sweep (membership is
+        # current) and BEFORE dispatch: the autoscaler may join/drain
+        # replicas, then the WFQ releases this tick's weighted-fair
+        # share of buffered requests into the normal dispatch path
+        if self.autoscaler is not None:
+            self.autoscaler.on_tick(tick, now)
+        self._drain_wfq(now)
         # apply pending page transfers BEFORE the engines step: a chain
         # (or seed) that clears its destination's per-tick credit lands
         # ahead of that destination's admission/decode this tick
@@ -691,6 +803,41 @@ class FleetRouter:
         return {frid: fr.result for frid, fr in self._requests.items()
                 if fr.result is not None}
 
+    # ---- weighted fair queuing (round 17) ----------------------------------
+
+    def _drain_wfq(self, now: float) -> None:
+        """Release buffered requests to dispatch in virtual-time order,
+        bounded by the READY replicas' admission slack (two decode
+        batches of headroom each, the same depth the affinity overflow
+        tolerates) — so engine queues stay shallow and the WFQ, not
+        FIFO arrival order, decides who runs next.  Buffered requests
+        whose deadline lapsed are shed here: they never reached an
+        engine, so the router is their deadline enforcer."""
+        if self.wfq is None:
+            return
+        for tenant, freq in self.wfq.expire(
+                lambda fr: fr.deadline_at is not None and
+                now >= fr.deadline_at):
+            self.ledger.on_shed(tenant)
+            self._finish(freq, RequestStatus.TIMED_OUT, now)
+        if not len(self.wfq):
+            return
+        budget = 0
+        for rep in self._ready(set()):
+            ld = rep.engine.load()
+            budget += max(0, 2 * rep.engine._max_slots -
+                          (ld["queue_depth"] + ld["running"]))
+        while budget > 0:
+            popped = self.wfq.pop()
+            if popped is None:
+                break
+            tenant, freq = popped
+            if freq.finished:
+                continue       # raced a cancel; already ledgered there
+            self.ledger.on_admit(tenant)
+            budget -= 1
+            self._dispatch(freq, now)
+
     # ---- dispatch / harvest ------------------------------------------------
 
     def _wrap_on_token(self, freq: _FleetRequest):
@@ -701,7 +848,7 @@ class FleetRouter:
             freq.attempt_tokens += 1
             if freq.attempt_tokens > freq.emitted:
                 freq.emitted += 1
-                self.metrics.on_token(self._time())
+                self.metrics.on_token(self._time(), tenant=freq.tenant)
                 if freq.on_token is not None:
                     freq.on_token(tok)
         return cb
@@ -725,7 +872,8 @@ class FleetRouter:
                 #                     engine times it out on its next tick
             erid = rep.engine.submit(freq.prompt, freq.max_tokens,
                                      on_token=self._wrap_on_token(freq),
-                                     deadline_s=remaining, now=now)
+                                     deadline_s=remaining, now=now,
+                                     tenant=freq.tenant)
             if rep.engine.status(erid) is RequestStatus.REJECTED:
                 tried.add(idx)
                 continue
@@ -1001,7 +1149,7 @@ class FleetRouter:
                 erid2 = dest.engine.submit(
                     freq.prompt, freq.max_tokens,
                     on_token=self._wrap_on_token(freq),
-                    deadline_s=remaining, now=now)
+                    deadline_s=remaining, now=now, tenant=freq.tenant)
                 if dest.engine.status(erid2) is RequestStatus.REJECTED:
                     self._dispatch(freq, now)     # full re-route
                 else:
@@ -1133,10 +1281,20 @@ class FleetRouter:
         load signals, and the idempotence counter."""
         reps = {}
         ok = True
+        tenants: Dict[str, Dict[str, int]] = {}
         for rep in self.replicas:
             hz = rep.engine.healthz()
             if rep.state is not ReplicaState.DEAD and not hz["ok"]:
                 ok = False
+            # per-tenant fleet aggregation (round 17): sum each
+            # replica's tenant_counts — dead replicas included, since
+            # their historical deadline misses are still real
+            for t, counts in hz["tenants"].items():
+                agg = tenants.setdefault(
+                    t, {"running": 0, "queued": 0, "pages_in_use": 0,
+                        "deadline_misses": 0, "buffered": 0})
+                for k, v in counts.items():
+                    agg[k] = agg.get(k, 0) + v
             reps[rep.idx] = {
                 "state": rep.state.value,
                 "role": rep.role,
@@ -1151,6 +1309,12 @@ class FleetRouter:
             }
         if self.metrics.duplicate_completions:
             ok = False
+        if self.wfq is not None:
+            for t, n in self.wfq.backlog().items():
+                agg = tenants.setdefault(
+                    t, {"running": 0, "queued": 0, "pages_in_use": 0,
+                        "deadline_misses": 0, "buffered": 0})
+                agg["buffered"] = n
         return {
             "ok": ok,
             "tick": self._tick,
@@ -1161,6 +1325,9 @@ class FleetRouter:
             "duplicate_completions": self.metrics.duplicate_completions,
             "deadline_miss_rate": round(
                 self.metrics.deadline_miss_rate(), 4),
+            # control-plane surfaces (round 17)
+            "tenants": tenants,
+            "admission_ledger": self.ledger.snapshot(),
         }
 
     def snapshot(self) -> Dict[str, object]:
@@ -1177,6 +1344,10 @@ class FleetRouter:
             round(r.engine.metrics.prefix_hit_rate(), 4)
             for r in self.replicas]
         snap["replica_states"] = [r.state.value for r in self.replicas]
+        if self.autoscaler is not None:
+            snap["control_scale_ups"] = self.autoscaler.scale_ups
+            snap["control_scale_downs"] = self.autoscaler.scale_downs
+            snap["control_replica_ticks"] = self.autoscaler.replica_ticks
         # keep the unified registry current: fleet counters land next to
         # the replicas' serving_* series and stage histograms, so one
         # scrape surface (registry.snapshot()/to_text()) has it all

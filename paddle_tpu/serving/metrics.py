@@ -82,6 +82,12 @@ class ServingMetrics:
         self.peak_pages_in_use = 0
         self.ttft_s = deque(maxlen=_WINDOW)
         self.queue_wait_s = deque(maxlen=_WINDOW)
+        # multi-tenant series (round 17): deadline misses (timed_out +
+        # shed) and queue-wait windows keyed by tenant — published as
+        # LABELED series so one scrape surface splits SLO attainment by
+        # tenant without N registries
+        self.tenant_deadline_misses: Dict[str, int] = {}
+        self.tenant_queue_wait_s: Dict[str, deque] = {}
         self._first_event_at: Optional[float] = None
         self._last_token_at: Optional[float] = None
 
@@ -150,6 +156,17 @@ class ServingMetrics:
 
     def on_admit(self, queue_wait_s: float) -> None:
         self.queue_wait_s.append(max(0.0, queue_wait_s))
+
+    def on_tenant_admit(self, tenant: str, queue_wait_s: float) -> None:
+        """Per-tenant half of :meth:`on_admit` (separate hook so legacy
+        callers without tenant identity change nothing)."""
+        self.tenant_queue_wait_s.setdefault(
+            tenant, deque(maxlen=_WINDOW)).append(max(0.0, queue_wait_s))
+
+    def on_tenant_miss(self, tenant: str) -> None:
+        """A deadline miss (TIMED_OUT or shed) billed to ``tenant``."""
+        self.tenant_deadline_misses[tenant] = \
+            self.tenant_deadline_misses.get(tenant, 0) + 1
 
     def on_token(self, now: float, ttft_s: Optional[float] = None) -> None:
         self.tokens_generated += 1
@@ -236,6 +253,19 @@ class ServingMetrics:
         this module stays importable without obs."""
         for k, v in self.snapshot().items():
             registry.gauge("serving_" + k).labels(**labels).set(v)
+        # tenant-labeled series (round 17): the per-tenant SLO split on
+        # the SAME registry — publish is idempotent (gauges), so a
+        # healthz probe and a scraper read identical numbers
+        for t, n in self.tenant_deadline_misses.items():
+            registry.gauge(
+                "serving_deadline_miss_total",
+                "deadline misses (timed_out + shed) by tenant"
+            ).labels(tenant=t, **labels).set(n)
+        for t, w in self.tenant_queue_wait_s.items():
+            registry.gauge(
+                "serving_queue_wait_ms",
+                "p95 admission queue wait by tenant (recent window)"
+            ).labels(tenant=t, **labels).set(round(1000.0 * _p95(w), 3))
 
     def snapshot(self) -> Dict[str, float]:
         return {
@@ -332,6 +362,10 @@ class FleetMetrics:
         self.seed_pages = 0
         self.seed_bytes = 0
         self.migration_resubmits = 0  # death resubmits that re-adopted pages
+        # multi-tenant split (round 17): exactly-once emitted tokens by
+        # tenant — same stream as ``tokens_emitted``, partitioned so the
+        # scrape surface can bill goodput per tenant
+        self.tenant_tokens: Dict[str, int] = {}
         self._first_event_at: Optional[float] = None
         self._last_token_at: Optional[float] = None
 
@@ -372,8 +406,10 @@ class FleetMetrics:
     def on_migration_resubmit(self) -> None:
         self.migration_resubmits += 1
 
-    def on_token(self, now: float) -> None:
+    def on_token(self, now: float, tenant: Optional[str] = None) -> None:
         self.tokens_emitted += 1
+        if tenant is not None:
+            self.tenant_tokens[tenant] = self.tenant_tokens.get(tenant, 0) + 1
         self._last_token_at = now
 
     def on_terminal(self, status, shed: bool = False) -> None:
@@ -412,6 +448,14 @@ class FleetMetrics:
         fleet-level half of the one-scrape-surface contract."""
         for k, v in self.snapshot().items():
             registry.gauge(k).labels(**labels).set(v)
+        # tenant-labeled goodput (round 17): the exactly-once token
+        # stream split by tenant, one labeled gauge per tenant on the
+        # same registry (idempotent re-publish, like every fleet gauge)
+        for t, n in self.tenant_tokens.items():
+            registry.gauge(
+                "fleet_tokens_total",
+                "exactly-once emitted tokens by tenant"
+            ).labels(tenant=t, **labels).set(n)
 
     def snapshot(self) -> Dict[str, float]:
         return {

@@ -81,6 +81,10 @@ class MigrationBlob:
     deadline_at: Optional[float] = None
     chain_blocks: int = 0          # PrefixCache hash cursor at export
     chain_hash: Optional[int] = None
+    # tenant identity (round 17): a migrated chain keeps billing to its
+    # original tenant on the destination — SLO deadlines, quotas and
+    # preemption precedence follow the request across replicas
+    tenant: str = "default"
     # page payload
     k: object = None
     v: object = None
@@ -152,8 +156,8 @@ def export_chain(engine, rid: int) -> MigrationBlob:
         generated=list(req.generated), max_tokens=req.max_tokens,
         cache_len=req.cache_len, sampling=req.sampling,
         deadline_at=req.deadline_at, chain_blocks=req.chain_blocks,
-        chain_hash=req.chain_hash, k=k, v=v, k_scale=k_scale,
-        v_scale=v_scale)
+        chain_hash=req.chain_hash, tenant=req.tenant, k=k, v=v,
+        k_scale=k_scale, v_scale=v_scale)
 
 
 def import_chain(engine, blob: MigrationBlob, *, on_token=None,
@@ -189,7 +193,8 @@ def import_chain(engine, blob: MigrationBlob, *, on_token=None,
     engine.apply_imported_pages(pages[:blob.num_pages], blob.k, blob.v,
                                 blob.k_scale, blob.v_scale)
     req = Request(prompt=list(blob.prompt), max_tokens=blob.max_tokens,
-                  on_token=on_token, sampling=blob.sampling)
+                  on_token=on_token, sampling=blob.sampling,
+                  tenant=blob.tenant)
     req.generated = list(blob.generated)
     req.pages = pages
     req.cache_len = blob.cache_len

@@ -83,6 +83,11 @@ class Request:
     # a SamplingParams from serving.speculate with seeded per-position
     # RNG streams, so replays are bit-identical
     sampling: Optional[object] = None
+    # multi-tenant identity (round 17): who this request bills to.  The
+    # control plane (serving/control.py) keys SLO deadlines, quotas and
+    # preemption precedence on it; it survives preemption, death
+    # resubmission and chain migration unchanged.
+    tenant: str = "default"
     rid: int = field(default_factory=lambda: next(_rid_counter))
     # SLOs (absolute times on the engine's clock; None = unbounded)
     queue_deadline_at: Optional[float] = None   # must be admitted by
@@ -165,6 +170,12 @@ class ContinuousBatchingScheduler:
         self.running: Dict[int, Request] = {}       # slot -> request
         self._free_slots: List[int] = list(range(cfg.max_slots - 1, -1, -1))
         self.preemption_count = 0
+        # tenant preemption precedence (round 17): a callable
+        # ``tenant -> rank`` bound by the control plane (higher rank =
+        # victimized FIRST, so batch-class slots evict before
+        # interactive ones).  None — the default — ranks every tenant
+        # equally and preserves the classic pure-youngest-first policy.
+        self.precedence_fn: Optional[Callable[[str], int]] = None
         # O(1) load probe for class-aware fleet routing (round 16):
         # prompt tokens still to prefill across queued + running
         # requests, maintained incrementally on every cache_len edge
@@ -409,7 +420,13 @@ class ContinuousBatchingScheduler:
                  (budget is None or r.preemptions < budget)]
         if not cands:
             return None
-        return max(cands, key=lambda r: (r.submitted_at, r.rid))
+        # precedence leads the key: with a control plane bound, the
+        # highest-rank tenant class (batch) is victimized before any
+        # lower-rank one (interactive), and only WITHIN a rank does the
+        # classic youngest-first rule pick
+        rank = self.precedence_fn or (lambda tenant: 0)
+        return max(cands, key=lambda r: (rank(r.tenant), r.submitted_at,
+                                         r.rid))
 
     def _preempt(self, req: Request) -> None:
         if self.tracer is not None:

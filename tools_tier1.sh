@@ -172,4 +172,29 @@ elif [ "$mig_rc" -ne 0 ]; then
     print_postmortems
     exit 11
 fi
+# multi-tenant control-plane gate (paddle_tpu.serving.control): replays
+# a seeded tenant-storm + autoscale + replica-kill trace (WFQ on, SLO
+# classes + quotas live, the autoscaler growing then shrinking the
+# fleet across the swing) and checks the admission ledger partitions
+# per tenant (submitted == admitted + quota_deferred + shed), no
+# non-storming tenant missed a deadline, the storming tenant's quota
+# bucket actually deferred work, the WFQ drained empty, every token
+# stream stayed exactly-once through every scaling event, and every
+# replica — killed and drained ones included — conserved pages/refs.
+# Exit 12 extends the ladder (3/4/5/6/7/8/9/10/11); same contract as
+# the other gates: branch on the checker's OWN exit status (findings=1,
+# crash=2), never on a grep of the shared log.  Run via -c, not -m:
+# runpy would execute a second copy of control.py next to the one the
+# serving package already imported.
+env JAX_PLATFORMS=cpu python -c 'import sys; from paddle_tpu.serving.control import main; sys.exit(main(["check"]))' 2>&1 | tee -a /tmp/_t1.log
+ctl_rc=${PIPESTATUS[0]}
+if [ "$ctl_rc" -eq 1 ]; then
+    echo 'CONTROL-LEAK: multi-tenant control-plane invariants violated (see log above)'
+    print_postmortems
+    exit 12
+elif [ "$ctl_rc" -ne 0 ]; then
+    echo "CONTROL-LEAK: control checker itself exited $ctl_rc without running to completion"
+    print_postmortems
+    exit 12
+fi
 exit $rc
