@@ -1942,6 +1942,145 @@ def worker_serving_control():
     print(json.dumps(out), flush=True)
 
 
+def worker_serving_hosttier():
+    """Hierarchical KV cache A/B (round 21): a tenant-count sweep whose
+    per-tenant system prefixes OVERFLOW the device pool — each tenant's
+    cached prefix is evicted before its next request arrives — replayed
+    tier-off vs tier-on on the same injected clock and trace.  Tier-off,
+    every revisit re-prefills the full prefix; tier-on, eviction spills
+    the pages (checksummed) to host RAM and the revisit swaps them back
+    in under the per-tick budget.  Asserts, not just reports:
+    token-identical outputs between the replays at every tenant count,
+    hit rate strictly higher and prefill tokens strictly lower with the
+    tier on, zero HOSTTIER-CORRUPT pages, and clean three-state page
+    conservation at both drains.  Then the crash-warm restart replay: a
+    fleet replica whose host tier holds spilled pages is killed at a
+    tick and ``restart_replica`` rebuilds it; asserts pages_restored >
+    0, token parity on the re-served prompt, and 0 duplicate
+    completions.  Reports hit rate / TTFT p95 / prefill tokens per
+    tenant count, swap traffic, and the restart numbers."""
+    import numpy as np
+
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import (DecoderLM, FaultPlan, FleetFaultPlan,
+                                    FleetRouter, ManualClock,
+                                    RequestStatus, ServingEngine)
+
+    paddle.init()
+    vocab, eos, page = 256, 1, 8
+    model = DecoderLM(vocab_size=vocab, num_layers=1, num_heads=2,
+                      head_dim=16, max_positions=256)
+    params = model.init_params(jax.random.PRNGKey(0))
+    out = {"serving_hosttier_model":
+           "decoderlm_L1_H2_D16_v256_page8_pool28_slots2_sys64_chunk32"}
+
+    def replay(n_tenants, host_bytes, rng_seed=0):
+        rng = np.random.RandomState(rng_seed)
+        systems = [rng.randint(2, vocab, size=64).tolist()   # 8 pages each
+                   for _ in range(n_tenants)]
+        prompts, tenants = [], []
+        for rnd in range(3):                # 3 visits per tenant
+            for t in range(n_tenants):
+                prompts.append(systems[t] +
+                               rng.randint(2, vocab, size=8).tolist())
+                tenants.append(f"t{t}")
+        clock = ManualClock(tick_s=0.02)
+        eng = ServingEngine(model, params, eos_id=eos, page_size=page,
+                            num_pages=28, max_pages_per_seq=12,
+                            max_slots=2, buckets=(16, 32),
+                            prefill_chunk=32,
+                            faults=FaultPlan(seed=0, clock=clock),
+                            host_tier_bytes=host_bytes, swap_in_budget=10)
+        rids = [None] * len(prompts)
+        i = 0
+        # paced arrivals: one request every 2 ticks, so each tenant's
+        # prefix is long evicted (pool 28 pages, working set
+        # n_tenants*9) before its next visit
+        while i < len(prompts) or eng.has_work:
+            if i < len(prompts) and eng.metrics.ticks % 2 == 0:
+                rids[i] = eng.submit(prompts[i], max_tokens=8,
+                                     tenant=tenants[i])
+                i += 1
+            eng.step()
+            assert eng.metrics.ticks < 20000, "hosttier trace stuck"
+        results = eng.run(max_ticks=1)      # drained: conservation check
+        assert all(eng.status(r) is RequestStatus.COMPLETED for r in rids)
+        eng.check_page_conservation()
+        return [results[r] for r in rids], eng.metrics.snapshot()
+
+    for n_tenants in (3, 5):
+        outs_off, off = replay(n_tenants, host_bytes=0)
+        outs_on, on = replay(n_tenants, host_bytes=1 << 22)
+        assert outs_on == outs_off, \
+            f"host tier broke greedy parity at {n_tenants} tenants"
+        assert on["host_corrupt"] == 0
+        assert on["host_swap_ins"] > 0, "tier never swapped in"
+        assert on["prefix_hit_rate"] > off["prefix_hit_rate"], \
+            (on["prefix_hit_rate"], off["prefix_hit_rate"])
+        assert on["prefill_tokens"] < off["prefill_tokens"]
+        tag = f"serving_hosttier_t{n_tenants}"
+        out.update({
+            f"{tag}_hit_rate_on": on["prefix_hit_rate"],
+            f"{tag}_hit_rate_off": off["prefix_hit_rate"],
+            f"{tag}_ttft_ms_p95_on": on["ttft_ms_p95"],
+            f"{tag}_ttft_ms_p95_off": off["ttft_ms_p95"],
+            f"{tag}_prefill_tokens_on": on["prefill_tokens"],
+            f"{tag}_prefill_tokens_off": off["prefill_tokens"],
+            f"{tag}_swap_ins": on["host_swap_ins"],
+            f"{tag}_swap_outs": on["host_swap_outs"],
+            f"{tag}_host_hits": on["host_hits"],
+            f"{tag}_parity_ok": int(outs_on == outs_off),
+        })
+
+    # crash-warm restart replay: spill -> kill at a tick -> restart ->
+    # the successor serves the same prompt from adopted host pages
+    plan = FleetFaultPlan(seed=0, clock=ManualClock(tick_s=0.02))
+
+    def mk(i, time_fn):
+        return ServingEngine(model, params, eos_id=eos, page_size=page,
+                             num_pages=48, max_pages_per_seq=12,
+                             max_slots=4, buckets=(16, 32),
+                             time_fn=time_fn, host_tier_bytes=1 << 22,
+                             swap_in_budget=10)
+
+    fleet = FleetRouter(mk, 2, heartbeat_s=0.1, resubmit_budget=2,
+                        faults=plan)
+    rng = np.random.RandomState(7)
+    prompt = rng.randint(2, vocab, size=64).tolist()
+    f1 = fleet.submit(list(prompt), max_tokens=8)
+    fleet.run(max_ticks=400)
+    cold = fleet.result(f1)
+    victim = next(r.idx for r in fleet.replicas
+                  if r.engine.cache is not None and len(r.engine.cache))
+    fleet.replicas[victim].engine.cache.flush()
+    kill_tick = fleet._tick
+    fleet.kill_replica(victim)
+    new_idx = fleet.restart_replica(victim)
+    fleet.drain_replica(1 - victim)
+    for _ in range(5):
+        fleet.step()
+    f2 = fleet.submit(list(prompt), max_tokens=8)
+    fleet.run(max_ticks=400)
+    warm = fleet.result(f2)
+    assert warm == cold, "warm restart broke greedy parity"
+    assert fleet.metrics.pages_restored > 0, "restart restored 0 pages"
+    assert fleet.metrics.duplicate_completions == 0
+    fleet.check_fleet_conservation()
+    succ = fleet.replicas[new_idx].engine.host_tier.snapshot()
+    out.update({
+        "serving_hosttier_restart_kill_tick": kill_tick,
+        "serving_hosttier_restart_pages_restored":
+            fleet.metrics.pages_restored,
+        "serving_hosttier_restart_swap_ins": succ["host_swap_ins"],
+        "serving_hosttier_restart_parity_ok": int(warm == cold),
+        "serving_hosttier_restart_duplicate_completions":
+            fleet.metrics.duplicate_completions,
+    })
+    print(json.dumps(out), flush=True)
+
+
 def worker_moe():
     """MoE transformer LM vs its dense twin on one chip: single-chip
     Switch-style MoE (top-1 routing, dense dispatch formulation) at the
@@ -2180,6 +2319,7 @@ WORKERS = {
     "serving_fleet": worker_serving_fleet,
     "serving_disagg": worker_serving_disagg,
     "serving_control": worker_serving_control,
+    "serving_hosttier": worker_serving_hosttier,
     "train_chaos": worker_train_chaos,
     "moe": worker_moe,
 }
@@ -2269,7 +2409,7 @@ def main():
                        "serving_prefix", "serving_mixed", "serving_spec",
                        "serving_tp",
                        "serving_fleet", "serving_disagg", "serving_control",
-                       "train_chaos"):
+                       "serving_hosttier", "train_chaos"):
         out, err = _run_worker(cpu_worker, deadline, cpu=True,
                                attempt_timeout=380, max_attempts=1)
         if out:

@@ -173,6 +173,36 @@ FLAGS.define("serving_kv_dtype", "float32",
              "dtypes admit proportionally more pages, which multiplies "
              "prefix-cache capacity and admissible concurrency. "
              "Per-engine override: ServingEngine(kv_dtype=...).")
+FLAGS.define("serving_host_tier_bytes", 0,
+             "hierarchical KV cache: byte budget of the host-RAM spill "
+             "tier under the device page pool. When > 0 (and the prefix "
+             "cache is on), LRU-evicted reclaimable pages demote to host "
+             "memory — checksummed over stored bytes + scales — instead "
+             "of being destroyed, and a prefix lookup that runs off the "
+             "device index swaps the verified continuation back in. "
+             "When the budget is exceeded the tier LRU-drops (the third "
+             "rung of the degradation ladder: device evict -> host "
+             "spill -> host drop -> shed/preempt). 0 disables (prior "
+             "behavior: eviction destroys). Per-engine override: "
+             "ServingEngine(host_tier_bytes=...).", parser=int)
+FLAGS.define("serving_swap_in_budget", 8,
+             "host-tier swap-in charge per engine tick, in pages: at "
+             "most this many verified host pages are promoted back to "
+             "the device pool per tick for the head-of-queue request — "
+             "the chunk-prefill charging model, so a long host-resident "
+             "chain warms over several ticks and never blocks decode. "
+             "0 disables swap-in (spill-only tier). Per-engine "
+             "override: ServingEngine(swap_in_budget=...).", parser=int)
+FLAGS.define("serving_host_kv_dtype", "stored",
+             "host-tier storage format: 'stored' keeps the device "
+             "pool's stored bytes verbatim (swap-in is bit-identical); "
+             "'int8' transcodes float payloads to int8 + per-token "
+             "f32 scales on spill (amax/127, the pool's own "
+             "quantization rule), so the same serving_host_tier_bytes "
+             "holds ~4x the f32 pages at quantization fidelity — "
+             "dequantized on swap-in. An int8 device pool spills "
+             "verbatim either way. Per-engine override: "
+             "ServingEngine(host_kv_dtype=...).")
 FLAGS.define("serving_spec_mode", "off",
              "speculative decoding: off | ngram | draft. 'ngram' drafts "
              "by prompt-lookup (match the last serving_spec_ngram "

@@ -111,11 +111,13 @@ from paddle_tpu.serving.decode_attention import (
     expand_decode_rows, ragged_paged_attention, ragged_paged_attention_tp)
 from paddle_tpu.serving.faults import (FaultPlan, InjectedDeviceError,
                                        PageLeakError)
-from paddle_tpu.serving.kv_cache import (NULL_PAGE, KVPages, PagedKVConfig,
-                                         PagePool, PrefixCache, append_token,
-                                         fork_page, init_kv_pages,
-                                         kv_pool_specs, pages_for_budget,
-                                         pages_spanned, resolve_kv_dtype,
+from paddle_tpu.serving.kv_cache import (NULL_PAGE, _CHAIN_SEED, HostPageTier,
+                                         KVPages, PagedKVConfig, PagePool,
+                                         PrefixCache, append_token,
+                                         dequantize_kv, fork_page,
+                                         init_kv_pages, kv_pool_specs,
+                                         pages_for_budget, pages_spanned,
+                                         read_pages, resolve_kv_dtype,
                                          write_pages, zero_pages)
 from paddle_tpu.serving.metrics import ServingMetrics
 from paddle_tpu.serving.speculate import (DraftProposer, NGramProposer,
@@ -428,7 +430,10 @@ class ServingEngine:
                  xla_peak_bytes: Optional[int] = None,
                  xla_flops: Optional[float] = None,
                  xla_comm_bytes: Optional[float] = None,
-                 role: str = "unified"):
+                 role: str = "unified",
+                 host_tier_bytes: Optional[int] = None,
+                 swap_in_budget: Optional[int] = None,
+                 host_kv_dtype: Optional[str] = None):
         from paddle_tpu.platform.enforce import enforce_that
 
         self.eos_id = int(eos_id)
@@ -551,6 +556,28 @@ class ServingEngine:
         if prefix_cache:
             hash_fn = faults.cache_hash_fn() if faults is not None else None
             self.cache = PrefixCache(self.pool, page_size, hash_fn=hash_fn)
+        # hierarchical host tier (round 21): evicted reclaimable pages
+        # demote to host RAM (checksummed) instead of being destroyed;
+        # lookups that run off the device index swap the continuation
+        # back in, verified, charged like chunk prefill.  Off unless a
+        # byte budget is set (flag default 0 keeps prior behavior).
+        self.host_tier: Optional[HostPageTier] = None
+        self._swap_in_budget = int(
+            swap_in_budget if swap_in_budget is not None
+            else FLAGS.serving_swap_in_budget)
+        self._host_hits = 0   # swap-in events that promoted >= 1 page
+        host_bytes = int(host_tier_bytes if host_tier_bytes is not None
+                         else FLAGS.serving_host_tier_bytes)
+        if self.cache is not None and host_bytes > 0:
+            self.host_tier = HostPageTier(
+                host_bytes,
+                dtype=str(host_kv_dtype if host_kv_dtype is not None
+                          else FLAGS.serving_host_kv_dtype),
+                faults=faults)
+            self.cache.host_tier = self.host_tier
+            # read at call time: self._kv is rebound every step
+            self.cache.page_reader = \
+                lambda pages: read_pages(self._kv, pages)
         self.scheduler = ContinuousBatchingScheduler(
             self.pool, SchedulerConfig(
                 max_slots=max_slots, page_size=page_size,
@@ -791,6 +818,8 @@ class ServingEngine:
         self.scheduler.tracer = hook
         if self.cache is not None:
             self.cache.tracer = hook
+        if self.host_tier is not None:
+            self.host_tier.tracer = hook
         if hook is not None and getattr(FLAGS, "jit_audit", False):
             auditor().attach_tracer(self._tracer.base)
 
@@ -1176,6 +1205,11 @@ class ServingEngine:
                 # starve the draft pool (and the state is stale anyway
                 # — catch-up rebuilds it at the next propose)
                 self._proposer.release(req.rid)
+        # host-tier advance BEFORE admission: commit the staged spill
+        # (depth-one writer) and swap in up to swap_in_budget verified
+        # host pages for the head-of-queue request, so the admission
+        # lookup right below sees them as ordinary device hits
+        self._pump_host_tier(tick)
         admitted = sched.admit()
         for req in admitted:
             if req.admitted_at is None:
@@ -1237,6 +1271,8 @@ class ServingEngine:
         m.on_tick(sched.queue_depth, self.pool.num_live,
                   self.pool.num_cached,
                   self.cache.evictions if self.cache is not None else 0)
+        if self.host_tier is not None:
+            m.on_host_tier(self.host_tier.snapshot(), self._host_hits)
         self._tick = tick + 1
         return self.has_work
 
@@ -1254,6 +1290,10 @@ class ServingEngine:
         if not self.has_work:
             if self.faults is not None:
                 self.faults.release_pressure(self.pool)
+            if self.host_tier is not None:
+                # drain barrier: the staged spill commits (no torn
+                # pending across a quiesce) before conservation runs
+                self.host_tier.flush()
             self.check_page_conservation()
         return dict(self._results)
 
@@ -1305,6 +1345,15 @@ class ServingEngine:
             # the draft-model pool obeys the same conservation law:
             # pages held by live draft states == draft-pool refcounts
             self._proposer.check_conservation()
+        if self.host_tier is not None:
+            # third state (round 21): pages now conserve across device,
+            # host, and dropped — the tier's own ledger must balance
+            # (HOSTTIER-LEAK) at any tick, not just at drain
+            try:
+                self.host_tier.check()
+            except PageLeakError:
+                self._dump_postmortem("HOSTTIER-LEAK")
+                raise
 
     # ---- page-migration plane (round 16) --------------------------------
 
@@ -1346,6 +1395,96 @@ class ServingEngine:
         else:
             self._kv = self._import_fn(self._kv, ids_dev, _pad(k), _pad(v))
 
+    # ---- hierarchical host tier (round 21) -------------------------------
+
+    def _pump_host_tier(self, tick: int) -> None:
+        """One tick of host-tier work, BEFORE admission and never
+        blocking decode: advance the depth-one spill writer, then — for
+        the head-of-queue request only — walk the host index past the
+        device index's longest hit and promote up to ``swap_in_budget``
+        verified pages back into the pool (the chunk-prefill charging
+        model: bounded pages per tick; a longer host chain continues
+        next tick).  Promoted pages are inserted into the device index
+        and parked RECLAIMABLE, so the admission lookup right after
+        treats them exactly like any other cached prefix — the COW /
+        pinning machinery is reused unchanged.  A checksum mismatch
+        pops the record, counts HOSTTIER-CORRUPT, and truncates the
+        swap-in there: corruption degrades to a shorter hit (a miss for
+        that block), never to wrong KV."""
+        tier, cache, sched = self.host_tier, self.cache, self.scheduler
+        if tier is None or cache is None:
+            return
+        tier.pump(tick)
+        if self._swap_in_budget <= 0 or not sched.queue:
+            return
+        req = sched.queue[0]
+        toks = req.cache_tokens
+        page = self.kv_cfg.page_size
+        nblocks = len(toks) // page
+        if nblocks == 0 or len(tier) == 0:
+            return
+        _, hit_len = cache.lookup(toks)       # pure probe, no LRU churn
+        j = hit_len // page
+        if j >= nblocks:
+            return
+        keys = cache.chain_keys(toks)
+        h = _CHAIN_SEED if j == 0 else keys[j - 1]
+        probe: List[Tuple[int, int, Tuple[int, ...]]] = []
+        jj, hh = j, h
+        while jj < nblocks and len(probe) < self._swap_in_budget:
+            block = tuple(toks[jj * page:(jj + 1) * page])
+            if tier.peek(keys[jj], hh, block) is None:
+                break
+            probe.append((keys[jj], hh, block))
+            hh = keys[jj]
+            jj += 1
+        if not probe:
+            return
+        # device pages first (the ladder may evict-and-spill to make
+        # room); under pressure the records simply stay host-resident
+        # and the walk retries next tick
+        new = sched.alloc_pages(len(probe))
+        if new is None:
+            return
+        got = []
+        for key, prev, block in probe:
+            rec = tier.take_verified(key, prev, block)
+            if rec is None:
+                break                  # HOSTTIER-CORRUPT: chain ends here
+            got.append(rec)
+        used, unused = new[:len(got)], new[len(got):]
+        if got:
+            k = np.concatenate([r.k for r in got], axis=1)
+            v = np.concatenate([r.v for r in got], axis=1)
+            ks = vs = None
+            if got[0].k_scale is not None:
+                ks = np.concatenate([r.k_scale for r in got], axis=1)
+                vs = np.concatenate([r.v_scale for r in got], axis=1)
+            if not self.kv_cfg.quantized and ks is not None:
+                # int8-on-host under a float device pool: dequantize on
+                # promotion with the one shared rule
+                k = np.asarray(dequantize_kv(jnp.asarray(k),
+                                             jnp.asarray(ks)))
+                v = np.asarray(dequantize_kv(jnp.asarray(v),
+                                             jnp.asarray(vs)))
+                ks = vs = None
+            self.apply_imported_pages(used, k, v, ks, vs)
+            # pages[] is indexed by block: blocks < j are already
+            # device-resident (insert never touches them — NULL_PAGE
+            # padding keeps the indices aligned)
+            cache.insert(toks, [NULL_PAGE] * j + used,
+                         upto=(j + len(got)) * page, from_block=j,
+                         prev_hash=h, tenant=req.tenant)
+            self._host_hits += 1
+            self._tracer.instant("host_swap_in", rid=req.rid,
+                                 n=len(got), tick=tick)
+        if used:
+            # park the promoted pages reclaimable (insert registered
+            # them cached; dropping our alloc ref leaves refcount 0)
+            self.pool.free(used)
+        if unused:
+            self.pool.free(unused)
+
     def load(self) -> Dict[str, object]:
         """Cheap load probe: the same queue_depth / running /
         free_pages numbers ``healthz`` reports, WITHOUT the
@@ -1364,6 +1503,10 @@ class ServingEngine:
                     self.scheduler.prefill_backlog_tokens,
                 "role": self.role,
                 "draining": self._draining,
+                # host-tier depth (round 21): pages warm in host RAM —
+                # a router's restart/balance decision reads this O(1)
+                "pages_host": (len(self.host_tier)
+                               if self.host_tier is not None else 0),
                 # per-tenant split (round 17): the control plane's WFQ /
                 # autoscaler read this; O(live requests), still cheap at
                 # the bounded slot/queue sizes this probe already scans
@@ -1380,6 +1523,7 @@ class ServingEngine:
         def _slot(t: str) -> Dict[str, int]:
             return out.setdefault(t, {"running": 0, "queued": 0,
                                       "pages_in_use": 0,
+                                      "pages_host": 0,
                                       "deadline_misses": 0})
 
         for req in self.scheduler.running.values():
@@ -1390,6 +1534,11 @@ class ServingEngine:
             _slot(req.tenant)["queued"] += 1
         for t, n in self.metrics.tenant_deadline_misses.items():
             _slot(t)["deadline_misses"] = n
+        # host-tier residency billed to whoever prefilled the page
+        # (round 21): the ledger view splits warm capacity by tenant
+        if self.host_tier is not None:
+            for t, n in self.host_tier.resident_by_tenant.items():
+                _slot(t)["pages_host"] = n
         # tenants whose work all completed cleanly must still report a
         # zero-miss row: the admission window remembers everyone admitted
         for t in self.metrics.tenant_queue_wait_s:
@@ -1419,7 +1568,11 @@ class ServingEngine:
             leak = True
         # the unified-registry surface: publish this engine's counters,
         # then hand back the registry's flat snapshot so one healthz
-        # probe reads the same numbers a scraper would
+        # probe reads the same numbers a scraper would.  Host-tier
+        # gauges are stamped first so a probe between ticks (or before
+        # the first) reads current tier state, not last tick's.
+        if self.host_tier is not None:
+            m.on_host_tier(self.host_tier.snapshot(), self._host_hits)
         self.metrics.publish(self.registry, **self._reg_labels)
         # retrace-auditor compile counts ride the same scrape surface
         # (jit_compiles_total{site=...}): before this they existed only
@@ -1466,6 +1619,20 @@ class ServingEngine:
             "cache_hits": self.cache.hits if self.cache is not None else 0,
             "cache_misses": (self.cache.misses
                              if self.cache is not None else 0),
+            # host-tier gauges (round 21) — same is-not-None rule
+            # (HostPageTier defines __len__ too); zeros with the tier off
+            # so probers read one stable schema
+            "pages_host": (len(self.host_tier)
+                           if self.host_tier is not None else 0),
+            "host_swap_ins": (self.host_tier.swap_ins
+                              if self.host_tier is not None else 0),
+            "host_swap_outs": (self.host_tier.spills
+                               if self.host_tier is not None else 0),
+            "host_hits": self._host_hits,
+            "host_corrupt": (self.host_tier.corrupt
+                             if self.host_tier is not None else 0),
+            "spill_stall_ticks": (self.host_tier.spill_stall_ticks
+                                  if self.host_tier is not None else 0),
             "page_leak": leak,
             "status_counts": counts,
             "deadline_miss_rate": round(self.metrics.deadline_miss_rate(),
@@ -1802,7 +1969,8 @@ class ServingEngine:
             # chunk's insert O(chunk), not O(prefix-so-far).
             req.chain_hash, req.chain_blocks = self.cache.insert(
                 toks, req.pages, req.cache_len,
-                from_block=req.chain_blocks, prev_hash=req.chain_hash)
+                from_block=req.chain_blocks, prev_hash=req.chain_hash,
+                tenant=req.tenant)
         if req.cache_len < len(toks):
             return                            # more chunks, later ticks
         req.prefilling = False

@@ -99,9 +99,24 @@ class FaultPlan:
     # prefix-cache faults (round 9)
     hash_collisions: bool = False
     cache_storm: Optional[Tuple[int, int]] = None
+    # host-tier faults (round 21): keyed by SPILL SEQUENCE number (the
+    # tier's monotonically increasing per-engine counter), not by tick —
+    # a spill's commit slides under slow-I/O windows, its seq doesn't.
+    # ``torn_spill_at`` zeroes the tail half of the staged V bytes at
+    # commit; ``bitflip_spill_at`` XORs one seeded byte of K; both are
+    # taken AFTER the checksum, so verification must catch them.
+    # ``slow_host_io=(start_tick, end_tick)`` stalls the depth-one
+    # writer's pump for the window (counted as spill_stall_ticks).
+    torn_spill_at: Set[int] = field(default_factory=set)
+    bitflip_spill_at: Set[int] = field(default_factory=set)
+    slow_host_io: Optional[Tuple[int, int]] = None
 
     def __post_init__(self):
         self._rng = np.random.RandomState(self.seed)
+        # separate stream for host-tier byte offsets (same pattern as
+        # the fleet plan's migration/storm RNGs): adding host faults
+        # never perturbs the decode-error schedule
+        self._host_rng = np.random.RandomState(self.seed + 3)
         self._rate_fail_tick: int = -1
 
     # ---- hooks the engine calls ------------------------------------------
@@ -162,6 +177,28 @@ class FaultPlan:
         if self.hash_collisions:
             return lambda prev, block: 0xC0111DE
         return None
+
+    def spill_is_torn(self, seq: int) -> bool:
+        """True when host-tier spill number ``seq`` commits torn (its
+        tail bytes never land)."""
+        return seq in self.torn_spill_at
+
+    def spill_bitflip_offset(self, seq: int, nbytes: int) -> Optional[int]:
+        """Byte offset to corrupt in spill ``seq``'s K payload, or None.
+        One draw from the dedicated host RNG per scheduled flip — drawn
+        only for scheduled seqs, so the schedule replays identically
+        regardless of how many clean spills interleave."""
+        if seq not in self.bitflip_spill_at:
+            return None
+        return int(self._host_rng.randint(max(1, int(nbytes))))
+
+    def host_io_stalled(self, tick: int) -> bool:
+        """True inside the slow-host-I/O window: the depth-one writer's
+        pump skips this tick (the staged spill rides along)."""
+        if self.slow_host_io is None:
+            return False
+        start, end = self.slow_host_io
+        return start <= tick < end
 
     def apply_cache_storm(self, tick: int, cache) -> int:
         """Inside the ``cache_storm`` window, flush every reclaimable

@@ -440,6 +440,39 @@ class FleetRouter:
         takes."""
         self._mark_dead(self.replicas[idx], self._time(), reason)
 
+    def restart_replica(self, idx: int) -> int:
+        """Crash-WARM restart (round 21): rebuild a DEAD replica as a
+        fresh engine that re-adopts its predecessor's host-RAM spill
+        tier instead of starting cold.  Crash semantics are honored —
+        device (HBM) pages died with the engine and are NOT salvaged;
+        only pages the old engine had already spilled to host memory
+        survive, and every one of them is checksum-verified during
+        adoption (a corrupt page counts ``HOSTTIER-CORRUPT`` and is
+        dropped, never served).  The successor is a NEW replica index
+        going through the normal JOINING -> READY lifecycle, so the
+        lease/fence/resubmit machinery is untouched: the dead replica's
+        in-flight work was already resubmitted at fence time, and the
+        exactly-once stream fence makes any replay invisible.  Returns
+        the successor's index."""
+        rep = self.replicas[idx]
+        enforce_that(rep.state is ReplicaState.DEAD,
+                     f"cannot warm-restart replica in state {rep.state} "
+                     "(kill or drain it first)", context="serving")
+        old_tier = rep.engine.host_tier
+        new_idx = self.add_replica(role=rep.role)
+        new_rep = self.replicas[new_idx]
+        restored = 0
+        if old_tier is not None and new_rep.engine.host_tier is not None:
+            tier = new_rep.engine.host_tier
+            before = tier.restored
+            tier.adopt(old_tier)
+            restored = tier.restored - before
+        self.metrics.on_warm_restart(restored)
+        self.tracer.instant("replica_warm_restart", cat="fleet",
+                            replica=idx, successor=new_idx,
+                            pages_restored=restored)
+        return new_idx
+
     def replica_state(self, idx: int) -> ReplicaState:
         return self.replicas[idx].state
 
@@ -1181,6 +1214,14 @@ class FleetRouter:
             freq.replica, freq.erid = t.dest, rid2
             freq.status = RequestStatus.RUNNING
             dest.rid_map[rid2] = t.frid
+            if src.engine.host_tier is not None and \
+                    src.engine.cache is not None:
+                # the chain now lives on the destination: drop any host
+                # copies the source spilled for it, so a later warm
+                # restart of the source cannot re-adopt pages the
+                # migration already handed off (double-adopt)
+                src.engine.host_tier.forget(src.engine.cache.chain_keys(
+                    blob.prompt + blob.generated))
             if self.routing == "affinity":
                 # the chain's pages now live on the decode replica: it
                 # is the deepest owner for this prompt's prefix
@@ -1292,7 +1333,8 @@ class FleetRouter:
             for t, counts in hz["tenants"].items():
                 agg = tenants.setdefault(
                     t, {"running": 0, "queued": 0, "pages_in_use": 0,
-                        "deadline_misses": 0, "buffered": 0})
+                        "pages_host": 0, "deadline_misses": 0,
+                        "buffered": 0})
                 for k, v in counts.items():
                     agg[k] = agg.get(k, 0) + v
             reps[rep.idx] = {
@@ -1302,6 +1344,7 @@ class FleetRouter:
                 "queue_depth": hz["queue_depth"],
                 "running": hz["running"],
                 "free_pages": hz["free_pages"],
+                "pages_host": hz.get("pages_host", 0),
                 "prefill_backlog_tokens": hz["prefill_backlog_tokens"],
                 "prefix_hit_rate": round(
                     rep.engine.metrics.prefix_hit_rate(), 4),
@@ -1313,7 +1356,8 @@ class FleetRouter:
             for t, n in self.wfq.backlog().items():
                 agg = tenants.setdefault(
                     t, {"running": 0, "queued": 0, "pages_in_use": 0,
-                        "deadline_misses": 0, "buffered": 0})
+                        "pages_host": 0, "deadline_misses": 0,
+                        "buffered": 0})
                 agg["buffered"] = n
         return {
             "ok": ok,

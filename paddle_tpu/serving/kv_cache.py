@@ -38,6 +38,7 @@ as a reclaimable pool; LRU eviction returns them under pressure.
 
 from __future__ import annotations
 
+import zlib
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import (Callable, Dict, List, NamedTuple, Optional, Sequence,
@@ -45,6 +46,7 @@ from typing import (Callable, Dict, List, NamedTuple, Optional, Sequence,
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from paddle_tpu.platform.enforce import enforce_that
 
@@ -623,6 +625,7 @@ class _CacheEntry:
     page: int                 # the page holding this block's K/V
     tokens: Tuple[int, ...]   # the block itself (collision verification)
     prev: int                 # parent link hash (chain verification)
+    tenant: Optional[str] = None   # who prefilled it (host-tier billing)
 
 
 class PrefixCache:
@@ -661,9 +664,22 @@ class PrefixCache:
         self.hits = 0          # lookups that matched >= 1 page (healthz)
         self.misses = 0        # lookups that matched none (healthz)
         self.evictions = 0     # pages evicted (LRU or storm)
+        # hierarchical spill (round 21): when the engine binds a
+        # HostPageTier plus a page reader (device pages -> stored host
+        # bytes), eviction DEMOTES instead of destroying — the victim's
+        # K/V is staged into the host tier before the device page
+        # returns to the free list
+        self.host_tier: Optional["HostPageTier"] = None
+        self.page_reader: Optional[Callable[[Sequence[int]], tuple]] = None
 
     def __len__(self) -> int:
         return len(self._index)
+
+    def chain_keys(self, tokens: Sequence[int]) -> List[int]:
+        """Every full block's chained key under THIS cache's hash
+        (fault-injected overrides included) — what the host-tier
+        swap-in walks to continue a lookup past the device index."""
+        return prefix_chain_hashes(tokens, self.page_size, self._hash)
 
     def lookup(self, tokens: Sequence[int],
                touch: bool = False) -> Tuple[List[int], int]:
@@ -702,7 +718,8 @@ class PrefixCache:
 
     def insert(self, tokens: Sequence[int], pages: Sequence[int],
                upto: int, from_block: int = 0,
-               prev_hash: Optional[int] = None) -> Tuple[int, int]:
+               prev_hash: Optional[int] = None,
+               tenant: Optional[str] = None) -> Tuple[int, int]:
         """Index the full pages covering ``tokens[:upto]`` (page j of
         the sequence lives in ``pages[j]``).  Idempotent — re-inserting
         a chunk already indexed is a no-op, and an existing entry always
@@ -722,7 +739,8 @@ class PrefixCache:
             e = self._index.get(key)
             if e is None:
                 self._index[key] = _CacheEntry(page=int(pages[j]),
-                                               tokens=block, prev=h)
+                                               tokens=block, prev=h,
+                                               tenant=tenant)
                 self.pool.mark_cached(int(pages[j]))
             h = key
         return h, max(from_block, nblocks)
@@ -750,11 +768,21 @@ class PrefixCache:
         if n <= 0:
             return 0
         freed = 0
+        spill = (self.host_tier is not None and
+                 self.page_reader is not None)
         for key in list(self._index):
             if freed >= n:
                 break
             e = self._index[key]
             if self.pool.refcount(e.page) == 0:
+                if spill:
+                    # demotion, not destruction: stage the victim's
+                    # stored bytes into the host tier before the device
+                    # page is reclaimed (depth-one writer — this commits
+                    # the PREVIOUS pending spill, stages this one)
+                    payload = self.page_reader([e.page])
+                    self.host_tier.spill(key, e.prev, e.tokens, payload,
+                                         tenant=e.tenant)
                 del self._index[key]
                 self.pool.release_cached(e.page)
                 self.evictions += 1
@@ -768,3 +796,494 @@ class PrefixCache:
         storm; also useful for tests).  Entries with live holders
         survive."""
         return self.evict(len(self._index))
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical host tier (round 21): spilled pages live in host RAM,
+# checksummed, until a prefix hit swaps them back in
+# ---------------------------------------------------------------------------
+
+
+def page_checksum(k, v, k_scale=None, v_scale=None) -> int:
+    """CRC32 chained over a page's STORED bytes plus its scale arrays —
+    the one integrity rule the spill writer, the swap-in verifier, and
+    the warm-restart adopter share.  Computed over the bytes the writer
+    INTENDED to store, so a torn commit or a flipped bit can never
+    verify."""
+    c = zlib.crc32(np.ascontiguousarray(k).tobytes())
+    c = zlib.crc32(np.ascontiguousarray(v).tobytes(), c)
+    if k_scale is not None:
+        c = zlib.crc32(np.ascontiguousarray(k_scale).tobytes(), c)
+        c = zlib.crc32(np.ascontiguousarray(v_scale).tobytes(), c)
+    return c
+
+
+@dataclass
+class _HostPage:
+    """One spilled page: the prefix-cache chain identity (key / prev /
+    tokens — so the host index IS the same radix chain, resumable after
+    the device entry is gone) plus the stored payload and its checksum."""
+
+    key: int
+    prev: int
+    tokens: Tuple[int, ...]
+    k: "np.ndarray"                    # [L, 1, page, H_kv, D] stored dtype
+    v: "np.ndarray"
+    k_scale: Optional["np.ndarray"]    # [L, 1, page, H_kv] f32, or None
+    v_scale: Optional["np.ndarray"]
+    checksum: int
+    nbytes: int
+    seq: int                           # spill sequence (fault addressing)
+    tenant: Optional[str] = None
+
+
+class HostPageTier:
+    """The host-RAM spill tier under the device :class:`PagePool`.
+
+    Evicted RECLAIMABLE pages demote here instead of being destroyed
+    (``PrefixCache.evict`` stages them), keyed by the SAME chained block
+    hash the device index uses — so a later lookup that runs off the end
+    of its device hits can continue the walk in host memory and swap the
+    continuation back in, verified, instead of re-prefilling it.
+
+    Write path — the depth-one pipelined writer from
+    ``resilience/checkpointer.py``, tick-deterministic (no threads, no
+    wall clock): ``spill`` first commits the previously staged page
+    (wait-out-previous), then stages the new one; the engine's per-tick
+    ``pump`` commits the staged page unless a fault plan declares a
+    slow-host-I/O window for that tick (counted as
+    ``spill_stall_ticks``); ``flush`` commits unconditionally (drain,
+    handoff).  Fault hooks mutate the payload AT COMMIT — after the
+    checksum was taken over the intended bytes — so a torn write or a
+    seeded bit flip is exactly what the verifier later catches.
+
+    Capacity is a byte budget.  With ``dtype='int8'`` float payloads are
+    transcoded to int8 + per-token scales on spill (the "engine owns the
+    memory format" lever: the host tier holds ~4x the pages of the f32
+    device pool for the same bytes, at quantization fidelity); with the
+    default ``'stored'`` the device bytes are kept verbatim, so swap-in
+    is bit-identical.  When the budget is exceeded the tier LRU-drops —
+    the third rung of the degradation ladder, after device eviction and
+    before shed/preempt.
+
+    Conservation (``HOSTTIER-LEAK``): every page that ever entered the
+    tier ends in exactly one state —
+
+        spills + adopted == resident + swap_ins + dropped + corrupt
+                            + handed_off + pending
+
+    checked by :meth:`check`, which the engine folds into
+    ``check_page_conservation`` (pages conserve across device, host,
+    and dropped)."""
+
+    def __init__(self, capacity_bytes: int, dtype: str = "stored",
+                 faults=None, tracer=None):
+        enforce_that(dtype in ("stored", "int8"),
+                     "serving_host_kv_dtype must be 'stored' or 'int8', "
+                     f"got {dtype!r}", context="serving")
+        self.capacity_bytes = int(capacity_bytes)
+        self.dtype = dtype
+        self.faults = faults
+        self.tracer = tracer
+        self._index: "OrderedDict[int, _HostPage]" = OrderedDict()
+        self._pending: Optional[_HostPage] = None
+        self._seq = 0
+        self.resident_bytes = 0
+        self.resident_by_tenant: Dict[str, int] = {}
+        # ledger counters (see class docstring for the invariant)
+        self.spills = 0            # pages ever staged (swap_outs gauge)
+        self.swap_ins = 0          # verified pages promoted back to device
+        self.dropped = 0           # LRU-dropped / forgotten / displaced
+        self.corrupt = 0           # checksum failures (NEVER served)
+        self.handed_off = 0        # adopted away by a successor tier
+        self.adopted = 0           # records taken FROM predecessors
+        self.restored = 0          # of those, verified + resident here
+        self.spill_stall_ticks = 0  # pump ticks lost to slow host I/O
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    # ---- write path (depth-one pipelined) --------------------------------
+
+    def spill(self, key: int, prev: int, tokens: Sequence[int], payload,
+              tenant: Optional[str] = None) -> None:
+        """Stage one evicted page (``payload`` is ``read_pages`` output
+        for a single page).  Commits any previously staged page first —
+        at most one spill is ever in flight, and the tick path never
+        waits on more than that one commit."""
+        if self._pending is not None:
+            self._commit(self._pending)
+            self._pending = None
+        k, v, ks, vs = payload
+        k = np.array(k)
+        v = np.array(v)
+        ks = None if ks is None else np.array(ks, np.float32)
+        vs = None if vs is None else np.array(vs, np.float32)
+        if self.dtype == "int8" and ks is None:
+            # transcode-on-spill: host holds int8 + f32 scales (~4x the
+            # f32 pages per byte); swap-in dequantizes back
+            kq, ks = quantize_kv(jnp.asarray(k, jnp.float32))
+            vq, vs = quantize_kv(jnp.asarray(v, jnp.float32))
+            k, v = np.array(kq), np.array(vq)
+            ks, vs = np.array(ks, np.float32), np.array(vs, np.float32)
+        nbytes = k.nbytes + v.nbytes
+        if ks is not None:
+            nbytes += ks.nbytes + vs.nbytes
+        seq = self._seq       # 0-based, like the migration drop schedule:
+        self._seq += 1        # the fault plan's Nth spill is seq N
+        self.spills += 1
+        self._pending = _HostPage(
+            key=int(key), prev=int(prev), tokens=tuple(tokens),
+            k=k, v=v, k_scale=ks, v_scale=vs,
+            checksum=page_checksum(k, v, ks, vs),
+            nbytes=int(nbytes), seq=seq, tenant=tenant)
+        if self.tracer is not None:
+            self.tracer.instant("host_spill", cat="pages", seq=seq)
+
+    def pump(self, tick: int) -> int:
+        """Per-tick writer advance: commit the staged page, unless the
+        fault plan has host I/O stalled this tick (the spill then rides
+        along until the window ends — decode never waits on it)."""
+        if self._pending is None:
+            return 0
+        if self.faults is not None and self.faults.host_io_stalled(tick):
+            self.spill_stall_ticks += 1
+            return 0
+        self._commit(self._pending)
+        self._pending = None
+        return 1
+
+    def flush(self) -> None:
+        """Commit unconditionally (drain / handoff barrier)."""
+        if self._pending is not None:
+            self._commit(self._pending)
+            self._pending = None
+
+    def _commit(self, rec: _HostPage) -> None:
+        f = self.faults
+        if f is not None:
+            if f.spill_is_torn(rec.seq):
+                # torn commit: the tail half of V never lands.  The
+                # checksum was taken over the intended bytes at stage
+                # time, so verification catches this as corruption.
+                flat = rec.v.reshape(-1).view(np.uint8)
+                flat[flat.size // 2:] = 0
+            off = f.spill_bitflip_offset(rec.seq, rec.k.nbytes)
+            if off is not None:
+                flat = rec.k.reshape(-1).view(np.uint8)
+                flat[off % flat.size] ^= 0x40
+        self._insert(rec)
+
+    def _insert(self, rec: _HostPage) -> bool:
+        if rec.key in self._index:
+            # existing entry wins (same idempotence rule as the device
+            # index) — the duplicate is accounted as dropped
+            self.dropped += 1
+            return False
+        if rec.nbytes > self.capacity_bytes:
+            self.dropped += 1
+            return False
+        while self.resident_bytes + rec.nbytes > self.capacity_bytes:
+            # ladder rung 3: host tier full -> LRU-drop host pages
+            self._pop_lru()
+        self._index[rec.key] = rec
+        self.resident_bytes += rec.nbytes
+        if rec.tenant is not None:
+            self.resident_by_tenant[rec.tenant] = \
+                self.resident_by_tenant.get(rec.tenant, 0) + 1
+        return True
+
+    def _pop(self, key: int) -> _HostPage:
+        rec = self._index.pop(key)
+        self.resident_bytes -= rec.nbytes
+        if rec.tenant is not None:
+            n = self.resident_by_tenant.get(rec.tenant, 0) - 1
+            if n > 0:
+                self.resident_by_tenant[rec.tenant] = n
+            else:
+                self.resident_by_tenant.pop(rec.tenant, None)
+        return rec
+
+    def _pop_lru(self) -> None:
+        key = next(iter(self._index))
+        self._pop(key)
+        self.dropped += 1
+        if self.tracer is not None:
+            self.tracer.instant("host_drop", cat="pages", key=key)
+
+    # ---- read path (verified swap-in) ------------------------------------
+
+    def peek(self, key: int, prev: int,
+             block: Sequence[int]) -> Optional[_HostPage]:
+        """Pure probe: the record for ``key`` if present AND its chain
+        identity matches (same token/parent verification as the device
+        index — a collision is a miss).  No checksum work, no removal;
+        the scheduler uses this to size a swap-in before charging it."""
+        rec = self._index.get(key)
+        if rec is None or rec.prev != int(prev) or \
+                rec.tokens != tuple(block):
+            return None
+        return rec
+
+    def take_verified(self, key: int, prev: int,
+                      block: Sequence[int]) -> Optional[_HostPage]:
+        """Remove-and-return the record for ``key`` iff its chain
+        identity matches AND its checksum verifies.  A mismatch pops the
+        record, counts ``corrupt`` (the HOSTTIER-CORRUPT counter), and
+        returns None — corruption degrades to a miss, never to a
+        wrong-KV hit."""
+        if self.peek(key, prev, block) is None:
+            return None
+        rec = self._pop(int(key))
+        if page_checksum(rec.k, rec.v, rec.k_scale,
+                         rec.v_scale) != rec.checksum:
+            self.corrupt += 1
+            if self.tracer is not None:
+                self.tracer.instant("HOSTTIER-CORRUPT", cat="pages",
+                                    key=int(key))
+            return None
+        self.swap_ins += 1
+        return rec
+
+    def forget(self, keys: Sequence[int]) -> int:
+        """Drop records (and any matching staged spill) by chain key —
+        the no-double-adopt rule: when a chain migrates to another
+        replica, the source's host copies are forgotten so the pages
+        can never be re-adopted from two places."""
+        n = 0
+        for key in list(keys):
+            key = int(key)
+            if self._pending is not None and self._pending.key == key:
+                self._pending = None
+                self.dropped += 1
+                n += 1
+            if key in self._index:
+                self._pop(key)
+                self.dropped += 1
+                n += 1
+        return n
+
+    # ---- crash-warm restart ----------------------------------------------
+
+    def adopt(self, other: "HostPageTier") -> int:
+        """Take every record from a predecessor tier (warm restart: the
+        host tier outlives the engine).  Each record is re-verified
+        against its checksum before becoming hittable here — a record
+        corrupted while orphaned counts ``corrupt`` on THIS tier and is
+        never served.  The source ledger stays balanced via
+        ``handed_off``.  Returns how many records were restored."""
+        other.flush()
+        restored = 0
+        for key in list(other._index):
+            rec = other._pop(key)
+            other.handed_off += 1
+            self.adopted += 1
+            if page_checksum(rec.k, rec.v, rec.k_scale,
+                             rec.v_scale) != rec.checksum:
+                self.corrupt += 1
+                if self.tracer is not None:
+                    self.tracer.instant("HOSTTIER-CORRUPT", cat="pages",
+                                        key=int(key))
+                continue
+            if self._insert(rec):
+                self.restored += 1
+                restored += 1
+        return restored
+
+    # ---- conservation + scrape -------------------------------------------
+
+    def check(self) -> None:
+        """The HOSTTIER-LEAK invariant (valid at any tick, not just at
+        drain): every page that entered the tier is in exactly one of
+        resident / swapped-in / dropped / corrupt / handed-off /
+        pending, and resident bytes match the index under the budget."""
+        from paddle_tpu.serving.faults import PageLeakError
+
+        pend = 1 if self._pending is not None else 0
+        lhs = self.spills + self.adopted
+        rhs = (len(self._index) + self.swap_ins + self.dropped +
+               self.corrupt + self.handed_off + pend)
+        if lhs != rhs:
+            raise PageLeakError(
+                f"HOSTTIER-LEAK: spills({self.spills}) + "
+                f"adopted({self.adopted}) != resident({len(self._index)})"
+                f" + swap_ins({self.swap_ins}) + dropped({self.dropped})"
+                f" + corrupt({self.corrupt}) + "
+                f"handed_off({self.handed_off}) + pending({pend})")
+        nb = sum(r.nbytes for r in self._index.values())
+        if nb != self.resident_bytes or nb > self.capacity_bytes:
+            raise PageLeakError(
+                f"HOSTTIER-LEAK: resident bytes ledger {self.resident_bytes}"
+                f" vs actual {nb} (capacity {self.capacity_bytes})")
+
+    def snapshot(self) -> Dict[str, int]:
+        """Host-tier gauges, merged into the engine's scrape surface."""
+        return {
+            "pages_host": len(self._index),
+            "host_swap_ins": self.swap_ins,
+            "host_swap_outs": self.spills,
+            "host_corrupt": self.corrupt,
+            "host_dropped": self.dropped,
+            "host_restored": self.restored,
+            "host_resident_bytes": self.resident_bytes,
+            "spill_stall_ticks": self.spill_stall_ticks,
+        }
+
+
+# ---------------------------------------------------------------------------
+# standalone gate: `python -m paddle_tpu.serving.kv_cache check`
+# ---------------------------------------------------------------------------
+
+
+def _selfcheck() -> int:
+    """Replay a seeded hierarchical-tier trace — the tier-1 ladder's
+    HOSTTIER gate (tools_tier1.sh exit 13).  Two phases:
+
+    1. single engine: a clean spill/swap-in round-trip must be
+       token-identical to a cold re-prefill, and an injected torn spill
+       plus a seeded bit-flip must BOTH be caught by the checksum at
+       swap-in (degrading to a miss) — a corrupt page served would show
+       up as a parity break;
+    2. small fleet: kill a replica whose host tier holds spilled pages,
+       ``restart_replica`` it, and the warm successor must re-adopt
+       >= 1 verified page and serve the same prompt token-identically
+       with zero duplicate completions.
+
+    Returns 0 (clean) or 1 (findings); a crash propagates as 2."""
+    import jax
+    import numpy as np
+
+    from paddle_tpu.serving.engine import DecoderLM, ServingEngine
+    from paddle_tpu.serving.faults import (FaultPlan, FleetFaultPlan,
+                                           ManualClock)
+
+    model = DecoderLM(vocab_size=64, num_layers=1, num_heads=2, head_dim=8,
+                      max_positions=64)
+    params = model.init_params(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(2, 64, size=16).tolist()   # 4 full pages
+    problems = []
+
+    def mk_engine(**faults_kw):
+        plan = FaultPlan(seed=0, clock=ManualClock(tick_s=0.01),
+                         **faults_kw)
+        return ServingEngine(model, params, eos_id=1, page_size=4,
+                             num_pages=16, max_pages_per_seq=8,
+                             max_slots=2, buckets=(8, 16), faults=plan,
+                             host_tier_bytes=1 << 20, swap_in_budget=4)
+
+    def roundtrip(eng):
+        """cold serve -> flush (spill) -> warm serve; returns (cold,
+        warm) token lists from the SAME engine (rids are globally
+        numbered, so cross-engine comparison must go by order)."""
+        r1 = eng.submit(list(prompt), max_tokens=6)
+        eng.run()
+        cold = eng.result(r1)
+        eng.cache.flush()
+        r2 = eng.submit(list(prompt), max_tokens=6)
+        eng.run()
+        return cold, eng.result(r2)
+
+    # phase 1a: clean round trip — the tier must actually serve
+    eng = mk_engine()
+    cold, warm = roundtrip(eng)
+    snap = eng.host_tier.snapshot()
+    if warm != cold:
+        problems.append(f"clean swap-in parity break: {warm} != {cold}")
+    if snap["host_swap_ins"] < 1 or eng._host_hits < 1:
+        problems.append(f"clean round trip never hit the host tier: {snap}")
+    clean_swapins = snap["host_swap_ins"]
+    eng.check_page_conservation()
+
+    # phase 1b/1c: torn spill, then seeded bit-flip — each must be
+    # caught at swap-in (miss + HOSTTIER-CORRUPT), never served
+    for kw, name in (({"torn_spill_at": {0}}, "torn"),
+                     ({"bitflip_spill_at": {0}}, "bitflip")):
+        eng = mk_engine(**kw)
+        cold, warm = roundtrip(eng)
+        snap = eng.host_tier.snapshot()
+        if warm != cold:
+            problems.append(f"{name}: corrupt page SERVED "
+                            f"(parity break {warm} != {cold})")
+        if snap["host_corrupt"] < 1:
+            problems.append(f"{name}: checksum missed the corruption "
+                            f"({snap})")
+        eng.check_page_conservation()
+
+    # phase 2: crash-warm restart in a fleet
+    plan = FleetFaultPlan(seed=0, clock=ManualClock(tick_s=0.01))
+
+    def mk(i, time_fn):
+        return ServingEngine(model, params, eos_id=1, page_size=4,
+                             num_pages=32, max_pages_per_seq=8,
+                             max_slots=4, buckets=(8, 16), time_fn=time_fn,
+                             host_tier_bytes=1 << 20, swap_in_budget=4)
+
+    from paddle_tpu.serving.fleet import FleetRouter
+
+    fleet = FleetRouter(mk, 2, heartbeat_s=0.05, resubmit_budget=2,
+                        faults=plan)
+    f1 = fleet.submit(list(prompt), max_tokens=6)
+    fleet.run(max_ticks=200)
+    cold = fleet.result(f1)
+    victim = next(r.idx for r in fleet.replicas
+                  if r.engine.cache is not None and len(r.engine.cache))
+    fleet.replicas[victim].engine.cache.flush()
+    fleet.kill_replica(victim)
+    new_idx = fleet.restart_replica(victim)
+    fleet.drain_replica(1 - victim)
+    for _ in range(5):
+        fleet.step()
+    f2 = fleet.submit(list(prompt), max_tokens=6)
+    fleet.run(max_ticks=200)
+    warm = fleet.result(f2)
+    restored = fleet.metrics.pages_restored
+    if warm != cold:
+        problems.append(f"warm-restart parity break: {warm} != {cold}")
+    if restored < 1:
+        problems.append("warm restart adopted 0 pages")
+    if fleet.metrics.duplicate_completions:
+        problems.append(f"{fleet.metrics.duplicate_completions} duplicate "
+                        "completions after warm restart")
+    fleet.check_fleet_conservation()
+
+    if problems:
+        print("HOSTTIER: " + "; ".join(problems))
+        return 1
+    print(f"kv-cache check ok: clean swap-in x{clean_swapins} "
+          "token-identical to cold prefill, torn + bit-flip spills both "
+          f"caught at swap-in (0 corrupt pages served), warm restart "
+          f"re-adopted {restored} page(s) with 0 duplicate completions, "
+          "0 leaks")
+    return 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """CLI dispatch, importable so callers (tools_tier1.sh) can run the
+    gate via ``python -c "...kv_cache.main(['check'])"`` — ``python -m``
+    would have runpy execute a SECOND copy of this module alongside the
+    one ``paddle_tpu.serving`` already imported."""
+    import sys
+
+    args = list(sys.argv[1:] if argv is None else argv)
+    cmd = args[0] if args else "check"
+    if cmd != "check":
+        print(f"unknown command {cmd!r}; usage: "
+              "python -m paddle_tpu.serving.kv_cache check")
+        return 2
+    from paddle_tpu.serving.faults import PageLeakError
+
+    try:
+        return _selfcheck()
+    except PageLeakError as e:
+        print(str(e))
+        return 1
+    except Exception as e:   # crash != findings: distinct exit code
+        print(f"kv-cache check crashed: {e!r}")
+        return 2
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

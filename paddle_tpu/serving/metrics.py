@@ -76,6 +76,16 @@ class ServingMetrics:
         self.prefill_tokens_saved = 0     # of those, served from the cache
         self.cow_forks = 0            # copy-on-write page forks
         self.cache_evictions = 0      # gauge: cache's cumulative evictions
+        # hierarchical host tier (round 21): gauges stamped from
+        # HostPageTier.snapshot() each tick / healthz — zeros with the
+        # tier off, so the scrape schema is stable either way
+        self.pages_host = 0           # gauge: host-resident spilled pages
+        self.host_swap_ins = 0        # verified pages promoted to device
+        self.host_swap_outs = 0       # pages ever spilled (staged)
+        self.host_hits = 0            # swap-in events serving a request
+        self.host_corrupt = 0         # checksum failures (never served)
+        self.host_dropped = 0         # host-LRU drops / forgets
+        self.spill_stall_ticks = 0    # pump ticks lost to slow host I/O
         self.queue_depth = 0          # gauge: last tick
         self.pages_in_use = 0         # gauge: last tick, LIVE holders only
         self.pages_cached = 0         # gauge: last tick, prefix-cache pages
@@ -195,6 +205,18 @@ class ServingMetrics:
     def on_preempt(self, n: int) -> None:
         self.preemptions += n
 
+    def on_host_tier(self, snap: Dict[str, int], host_hits: int) -> None:
+        """Stamp the host-tier gauges from ``HostPageTier.snapshot()``
+        plus the engine's hit counter (a hit is a swap-in EVENT that
+        served a request; the tier only sees pages)."""
+        self.pages_host = snap.get("pages_host", 0)
+        self.host_swap_ins = snap.get("host_swap_ins", 0)
+        self.host_swap_outs = snap.get("host_swap_outs", 0)
+        self.host_corrupt = snap.get("host_corrupt", 0)
+        self.host_dropped = snap.get("host_dropped", 0)
+        self.spill_stall_ticks = snap.get("spill_stall_ticks", 0)
+        self.host_hits = int(host_hits)
+
     def on_tick(self, queue_depth: int, pages_in_use: int,
                 pages_cached: int = 0, cache_evictions: int = 0) -> None:
         self.ticks += 1
@@ -294,6 +316,13 @@ class ServingMetrics:
             "cow_forks": self.cow_forks,
             "cache_evictions": self.cache_evictions,
             "pages_cached": self.pages_cached,
+            "pages_host": self.pages_host,
+            "host_swap_ins": self.host_swap_ins,
+            "host_swap_outs": self.host_swap_outs,
+            "host_hits": self.host_hits,
+            "host_corrupt": self.host_corrupt,
+            "host_dropped": self.host_dropped,
+            "spill_stall_ticks": self.spill_stall_ticks,
             "requests_submitted": self.submitted,
             "requests_rejected": self.rejected,
             "requests_completed": self.completed,
@@ -362,6 +391,11 @@ class FleetMetrics:
         self.seed_pages = 0
         self.seed_bytes = 0
         self.migration_resubmits = 0  # death resubmits that re-adopted pages
+        # crash-warm restart (round 21): a dead replica's host tier
+        # outlives its engine; restart_replica re-verifies and re-adopts
+        # it instead of starting cold
+        self.warm_restarts = 0        # restart_replica calls that adopted
+        self.pages_restored = 0       # host pages verified + re-adopted
         # multi-tenant split (round 17): exactly-once emitted tokens by
         # tenant — same stream as ``tokens_emitted``, partitioned so the
         # scrape surface can bill goodput per tenant
@@ -405,6 +439,10 @@ class FleetMetrics:
 
     def on_migration_resubmit(self) -> None:
         self.migration_resubmits += 1
+
+    def on_warm_restart(self, pages: int) -> None:
+        self.warm_restarts += 1
+        self.pages_restored += int(pages)
 
     def on_token(self, now: float, tenant: Optional[str] = None) -> None:
         self.tokens_emitted += 1
@@ -486,4 +524,6 @@ class FleetMetrics:
             "fleet_seed_pages": self.seed_pages,
             "fleet_seed_bytes": self.seed_bytes,
             "fleet_migration_resubmits": self.migration_resubmits,
+            "fleet_warm_restarts": self.warm_restarts,
+            "fleet_pages_restored": self.pages_restored,
         }

@@ -197,4 +197,28 @@ elif [ "$ctl_rc" -ne 0 ]; then
     print_postmortems
     exit 12
 fi
+# hierarchical KV-cache gate (paddle_tpu.serving.kv_cache): replays a
+# seeded host-tier trace — a clean spill/swap-in round trip must be
+# token-identical to a cold prefill, an injected torn spill AND a
+# seeded bit-flip must both be caught by the per-page checksum at
+# swap-in (degrading to a miss, never a wrong-KV hit), and a
+# kill + restart_replica warm restart must re-adopt verified host
+# pages with zero duplicate completions — then checks the three-state
+# page ledger (device/host/dropped) balances on every engine.  Exit 13
+# extends the ladder (3..12); same contract as the other gates: branch
+# on the checker's OWN exit status (findings=1, crash=2), never on a
+# grep of the shared log.  Run via -c, not -m: runpy would execute a
+# second copy of kv_cache.py next to the one the serving package
+# already imported.
+env JAX_PLATFORMS=cpu python -c 'import sys; from paddle_tpu.serving.kv_cache import main; sys.exit(main(["check"]))' 2>&1 | tee -a /tmp/_t1.log
+kv_rc=${PIPESTATUS[0]}
+if [ "$kv_rc" -eq 1 ]; then
+    echo 'HOSTTIER-LEAK: hierarchical KV-cache invariants violated (see log above)'
+    print_postmortems
+    exit 13
+elif [ "$kv_rc" -ne 0 ]; then
+    echo "HOSTTIER-LEAK: kv-cache checker itself exited $kv_rc without running to completion"
+    print_postmortems
+    exit 13
+fi
 exit $rc
