@@ -2237,6 +2237,173 @@ def worker_train_chaos():
         shutil.rmtree(root, ignore_errors=True)
 
 
+def worker_train_pipeline():
+    """Pipeline-parallel train step (ISSUE 19, cpu pass) on the
+    virtual-8 host: SGD(pipeline=PipelineConfig) over a 4-stage
+    transformer.  Two probes:
+
+    Parity — the first-2-step loss trajectory vs the sequential DSL
+    baseline (rtol 5e-3: flash kernel vs mha_reference forward delta
+    under Adam), plus tokens/s for both.
+
+    Bubble — the GPipe schedule runs M+S-1 ticks, all of which execute
+    full stage compute (fill/drain ticks chew on masked garbage), so on
+    a SERIALIZED host (the virtual devices share one core; wall time =
+    summed work) the wasted fraction is directly (S-1)/(M+S-1).  The
+    baseline is an S=1 PIPELINE at the same M/batch — identical
+    mha_reference kernels, identical microbatching, zero fill/drain —
+    so measured_bubble = 1 - T(S=1)/T(S=4) isolates the schedule (a
+    dense baseline would smuggle in the flash-vs-reference kernel
+    difference).  The bubble probe uses a longer sequence than the
+    parity probe so per-tick compute dwarfs the M-independent overhead
+    (Adam update + grad psums, ~100ms) that would otherwise dilute the
+    measurement.  ISSUE acceptance pin: within 10% of the closed
+    form."""
+    import jax
+    import numpy as np
+
+    paddle = _init_paddle()
+    from paddle_tpu import optimizer, trainer
+    from paddle_tpu.models import transformer
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.pipeline import PipelineConfig
+
+    devs = jax.devices()
+    assert len(devs) >= 8, f"need 8 virtual devices, have {len(devs)}"
+    vocab, d, layers, heads = 512, 128, 4, 4
+    micro, mb_size = 4, 2
+    bs = micro * mb_size
+    rng = np.random.RandomState(0)
+
+    def _samples(seq):
+        out = []
+        for _ in range(bs):
+            t = rng.randint(0, vocab, size=seq)
+            out.append((t.tolist(), list(range(seq)),
+                        np.roll(t, -1).tolist()))
+        return out
+
+    def build(stages, seq):
+        paddle.topology.reset_name_scope()
+        _, _, _, _, cost = transformer.build(
+            vocab_size=vocab, d_model=d, n_layers=layers, n_heads=heads,
+            max_len=seq)
+        params = paddle.Parameters.from_topology(
+            paddle.topology.Topology([cost]), seed=0)
+        kw = {}
+        if stages:
+            kw["pipeline"] = PipelineConfig(
+                num_stages=stages, microbatches=micro, n_layers=layers,
+                n_heads=heads)
+            kw["mesh"] = make_mesh((stages,), ("stage",), devs[:stages])
+        sgd = trainer.SGD(cost=cost, parameters=params,
+                          update_equation=optimizer.Adam(
+                              learning_rate=1e-2), **kw)
+        feeds = sgd._shard_feeds(sgd._make_feeder(
+            {"tokens": 0, "pos": 1, "target": 2}).feed(_samples(seq)))
+        return sgd, feeds
+
+    def measure(stages, seq, iters=4):
+        sgd, feeds = build(stages, seq)
+        args = _step_args(sgd, feeds)
+        step, _ = _aot_compile(sgd._build_step(), args)
+        # 2-step loss pin alongside the timing
+        p, o, m, key, f = args
+        losses = []
+        for _ in range(2):
+            loss, p, o, m = [x for x in step(p, o, m, key, f)][:4]
+            losses.append(float(loss))
+        return _time_steps(step, args, iters=iters), losses
+
+    parity_seq = 64
+    seq_s, seq_losses = measure(0, parity_seq)
+    pipe_s, pipe_losses = measure(4, parity_seq)
+    out = {
+        "pipeline_config": (f"d{d} L{layers} S4 M{micro} "
+                            f"seq{parity_seq} bs{bs}"),
+        "pipeline_tokens_per_sec": round(bs * parity_seq / pipe_s, 1),
+        "pipeline_dense_tokens_per_sec": round(
+            bs * parity_seq / seq_s, 1),
+        "pipeline_loss_parity_ok": int(bool(np.allclose(
+            pipe_losses, seq_losses, rtol=5e-3))),
+        "pipeline_losses_2step": [round(x, 4) for x in pipe_losses],
+    }
+    print(json.dumps(out), flush=True)  # parity headline before bubble
+    bubble_seq = 192
+    s1_s, _ = measure(1, bubble_seq, iters=3)
+    s4_s, _ = measure(4, bubble_seq, iters=3)
+    closed = (4 - 1) / (micro + 4 - 1)
+    measured = 1.0 - s1_s / max(s4_s, 1e-9)
+    out.update({
+        "pipeline_bubble_config": (f"d{d} L{layers} S4vsS1 M{micro} "
+                                   f"seq{bubble_seq} bs{bs}"),
+        "pipeline_bubble_measured": round(measured, 4),
+        "pipeline_bubble_closed_form": round(closed, 4),
+        "pipeline_bubble_rel_err": round(
+            abs(measured - closed) / closed, 4),
+    })
+    print(json.dumps(out), flush=True)
+
+
+def worker_train_moe():
+    """Expert-parallel MoE dispatch (ISSUE 19, cpu pass) on the
+    virtual-8 expert mesh: parallel.moe.moe_ffn (all_to_all dispatch/
+    combine, top-2 gates renormalized) against moe_ffn_reference at
+    generous capacity — outputs must agree to fp32 tolerance when
+    nothing is dropped — plus the drop-rate stats the metrics registry
+    records and EP tokens/s vs the dense reference formulation."""
+    import jax
+    import numpy as np
+
+    _init_paddle()
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel import moe as pmoe
+
+    devs = jax.devices()
+    assert len(devs) >= 8, f"need 8 virtual devices, have {len(devs)}"
+    n, d, hidden, tokens = 8, 64, 256, 512
+    mesh = make_mesh((n,), ("expert",), devs[:n])
+    params = pmoe.init_moe_params(jax.random.PRNGKey(0), d_model=d,
+                                  hidden=hidden, num_experts=n)
+    x = jax.random.normal(jax.random.PRNGKey(1), (tokens, d))
+
+    yr, _ = pmoe.moe_ffn_reference(x, params, capacity_factor=float(n),
+                                   top_k=2)
+    ye, _, _ = pmoe.moe_ffn(mesh, x, params, capacity_factor=float(n),
+                            top_k=2, return_stats=True)
+    parity = float(np.max(np.abs(np.asarray(ye) - np.asarray(yr))))
+    # drop-rate stats at the PRODUCTION capacity factor, recorded on the
+    # metrics registry the way the zoo layer does
+    _, _, stats = pmoe.moe_ffn(mesh, x, params, capacity_factor=1.25,
+                               top_k=2, return_stats=True)
+    pmoe.record_moe_stats(stats)
+    drop = float(np.asarray(stats["drop_rate"]))
+
+    def time_fn(fn, iters=8):
+        fn()  # warm/compile
+        import time as _t
+        t0 = _t.perf_counter()
+        for _ in range(iters):
+            jax.block_until_ready(fn())
+        return (_t.perf_counter() - t0) / iters
+
+    ep = jax.jit(lambda v: pmoe.moe_ffn(mesh, v, params,
+                                        capacity_factor=1.25, top_k=2)[0])
+    ref = jax.jit(lambda v: pmoe.moe_ffn_reference(
+        v, params, capacity_factor=1.25, top_k=2)[0])
+    ep_s, ref_s = time_fn(lambda: ep(x)), time_fn(lambda: ref(x))
+    out = {
+        "moe_ep_config": f"E{n} d{d} h{hidden} tok{tokens} top2 mesh8",
+        "moe_ep_parity_max_abs": round(parity, 6),
+        "moe_ep_parity_ok": int(parity < 1e-4),
+        "moe_ep_tokens_per_sec": round(tokens / ep_s, 1),
+        "moe_ep_vs_reference_step_ratio": round(ep_s / ref_s, 3),
+    }
+    out["moe_ep_drop_rate_cap1.25"] = round(drop, 4)
+    out["moe_ep_stats_recorded"] = 1
+    print(json.dumps(out), flush=True)
+
+
 def worker_probe():
     """Fast TPU liveness check: init + one tiny matmul."""
     import jax
@@ -2321,6 +2488,8 @@ WORKERS = {
     "serving_control": worker_serving_control,
     "serving_hosttier": worker_serving_hosttier,
     "train_chaos": worker_train_chaos,
+    "train_pipeline": worker_train_pipeline,
+    "train_moe": worker_train_moe,
     "moe": worker_moe,
 }
 
@@ -2409,7 +2578,8 @@ def main():
                        "serving_prefix", "serving_mixed", "serving_spec",
                        "serving_tp",
                        "serving_fleet", "serving_disagg", "serving_control",
-                       "serving_hosttier", "train_chaos"):
+                       "serving_hosttier", "train_chaos",
+                       "train_pipeline", "train_moe"):
         out, err = _run_worker(cpu_worker, deadline, cpu=True,
                                attempt_timeout=380, max_attempts=1)
         if out:

@@ -85,6 +85,7 @@ __all__ = ["audit_sharding_sites", "audit_record_sharding", "ShardReport",
            "RULE_NAMES", "normalize_spec", "apply_spec",
            "all_gather_bytes", "reduce_scatter_bytes", "all_reduce_bytes",
            "drive_zero_placement", "drive_serving_tp_steady_state",
+           "drive_pipeline_moe_train_step",
            "replay_serving_tp", "ensure_virtual_devices",
            "run_sharding_audit"]
 
@@ -1391,14 +1392,110 @@ def replay_serving_tp(eng) -> None:
     eng.run(max_ticks=300)
 
 
-def declare_stub_contracts() -> None:
-    """Register the (trivial) pipeline/MoE sharding contracts so the
-    auditor's 'declared but captured nothing' notice names them — the
-    ROADMAP item-5 build-out starts checkable instead of silent."""
-    from paddle_tpu.parallel import moe, pipeline
+def drive_page_migration(eng):
+    """Exercise ``serving.import_pages``: export one RUNNING request's
+    page chain from ``eng`` and splice it straight back in
+    (migrate.import_chain), so the donated import scatter captures
+    under the KV contract instead of standing as a declared-but-dead
+    site.  Returns the imported rid (or None if the engine never made
+    the request migratable — a scheduler-pressure case the caller
+    surfaces as a coverage notice)."""
+    import numpy as np
 
-    declare_site(pipeline.PIPELINE_SITE, pipeline.stub_contract())
-    declare_site(moe.MOE_SITE, moe.stub_contract())
+    from paddle_tpu.serving.migrate import export_chain, import_chain
+
+    rng = np.random.RandomState(11)
+    rid = eng.submit(rng.randint(2, 50, size=9).tolist(), max_tokens=8)
+    for _ in range(60):
+        if rid in eng.migratable_rids():
+            break
+        eng.step()
+    else:
+        eng.cancel(rid)
+        return None
+    blob = export_chain(eng, rid)
+    rid2 = import_chain(eng, blob)
+    eng.cancel(rid)
+    if rid2 is not None:
+        eng.cancel(rid2)
+    return rid2
+
+
+def drive_pipeline_moe_train_step(stages: int = 4, microbatches: int = 4):
+    """Drive a REAL pipeline-parallel train step plus an expert-parallel
+    MoE forward/backward so ``parallel.pipeline`` and ``parallel.moe``
+    capture under their closed-form contracts (budget == estimate — any
+    extra collective trips the gate):
+
+    - a 4-layer transformer LM on a ``(data=2, stage=4)`` mesh through
+      ``trainer.SGD(pipeline=PipelineConfig(...), zero=1)`` — one
+      guardable jitted step running the GPipe fill+drain schedule with
+      ZeRO-sharded boundary-param optimizer state (the 4D composition);
+    - a top-2-routed ``moe_ffn`` with drop-rate stats (fwd+grad) and a
+      top-1 forward on an 8-way ``expert`` mesh.
+
+    Requires ``FLAGS.jit_audit`` on before the call.  Returns the
+    trainer (None when fewer than ``2 * stages`` devices exist — the
+    CLI's virtual-8 guarantee makes that a test-environment case)."""
+    import jax
+    import numpy as np
+
+    devs = jax.devices()
+    if len(devs) < 2 * stages:
+        return None
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer as popt
+    from paddle_tpu import trainer as ptrainer
+    from paddle_tpu.models import transformer
+    from paddle_tpu.parallel.pipeline import PipelineConfig
+
+    vocab, d, n_layers, n_heads, t = 64, 32, 4, 2, 16
+    paddle.topology.reset_name_scope()
+    tokens, pos, target, logits, cost = transformer.build(
+        vocab_size=vocab, d_model=d, n_layers=n_layers, n_heads=n_heads,
+        max_len=t)
+    params = paddle.Parameters.from_topology(
+        paddle.topology.Topology([cost]), seed=0)
+    sgd = ptrainer.SGD(cost=cost, parameters=params,
+                       update_equation=popt.Adam(learning_rate=1e-3),
+                       pipeline=PipelineConfig(num_stages=stages,
+                                               microbatches=microbatches,
+                                               n_layers=n_layers,
+                                               n_heads=n_heads),
+                       zero=1)
+    step = sgd._build_step()
+    rng = np.random.RandomState(3)
+    samples = []
+    for _ in range(2 * microbatches):
+        toks = rng.randint(0, vocab, size=t)
+        samples.append((toks.tolist(), list(range(t)),
+                        np.roll(toks, -1).tolist()))
+    feeder = sgd._make_feeder({"tokens": 0, "pos": 1, "target": 2})
+    feeds = sgd._shard_feeds(feeder.feed(samples))
+    step(sgd.parameters.as_dict(), sgd.opt_state, sgd.model_state,
+         jax.random.PRNGKey(0), feeds)
+
+    from paddle_tpu.parallel import moe as pmoe
+    from paddle_tpu.parallel.mesh import make_mesh
+
+    n = min(8, len(devs))
+    mesh = make_mesh((n,), ("expert",), devs[:n])
+    mp = pmoe.init_moe_params(jax.random.PRNGKey(5), d_model=16,
+                              hidden=32, num_experts=n)
+    x = jax.random.normal(jax.random.PRNGKey(6), (8 * n, 16))
+
+    def moe_loss(p, xx):
+        y, aux, stats = pmoe.moe_ffn(mesh, xx, p, top_k=2,
+                                     return_stats=True)
+        return (y * y).mean() + 0.01 * aux, stats
+
+    (_, stats), _ = jax.value_and_grad(moe_loss, has_aux=True)(mp, x)
+    pmoe.record_moe_stats(stats)        # the metrics-registry seam
+    # top-1 wrap key too — distinct token count, so the two dispatch
+    # geometries stay distinct signatures at the shared site (the
+    # RETRACE fold would flag same-signature recompiles)
+    pmoe.moe_ffn(mesh, x[:4 * n], mp, top_k=1)
+    return sgd
 
 
 def run_sharding_audit(printer: Callable[[str], None] = print,
@@ -1407,11 +1504,12 @@ def run_sharding_audit(printer: Callable[[str], None] = print,
                                   List[Diagnostic]]:
     """The acceptance run: flip ``FLAGS.jit_audit`` on, drive the same
     serving + trainer steady states as the xla gate PLUS the ZeRO
-    placement jits, declare the pipeline/MoE stub contracts, seal, and
-    replay a steady-state serving burst — then run the sharding rules
-    over every captured site.  Returns (reports, all_diagnostics);
-    RETRACE diagnostics from the sealed replay fold in, same contract
-    as the xla gate."""
+    placement jits, the pipeline-parallel train step and the
+    expert-parallel MoE dispatch (closed-form contracts, budget ==
+    estimate), seal, and replay a steady-state serving burst — then run
+    the sharding rules over every captured site.  Returns (reports,
+    all_diagnostics); RETRACE diagnostics from the sealed replay fold
+    in, same contract as the xla gate."""
     from paddle_tpu.analysis.xla import (drive_serving_steady_state,
                                          drive_trainer_step)
     from paddle_tpu.platform.flags import FLAGS
@@ -1431,7 +1529,8 @@ def run_sharding_audit(printer: Callable[[str], None] = print,
         # on the TP decode hot path fails tier-1 through the SAME
         # ladder exit as any other sharding finding
         tp_eng = drive_serving_tp_steady_state()
-        declare_stub_contracts()
+        pipe_sgd = drive_pipeline_moe_train_step()
+        migrated = drive_page_migration(eng)
         aud.seal()
         import numpy as np
 
@@ -1461,6 +1560,14 @@ def run_sharding_audit(printer: Callable[[str], None] = print,
         printer("== serving tp: <2 devices — the tensor-parallel "
                 "serving contracts were NOT audited (run with virtual "
                 "devices to cover them)")
+    if pipe_sgd is None:
+        printer("== pipeline/moe: <8 devices — the pipeline-parallel "
+                "train step and expert-parallel MoE contracts were NOT "
+                "audited (run with virtual devices to cover them)")
+    if migrated is None:
+        printer("== page migration: the export/import splice never ran "
+                "(request not migratable) — serving.import_pages was "
+                "NOT audited this run")
     # a contract-bearing site the drives never compiled is a coverage
     # hole, not a pass — the pipeline/MoE stubs land here by design
     for name, rec in sorted(aud.sites.items()):

@@ -2066,15 +2066,25 @@ def smooth_l1_cost(input, label, name: Optional[str] = None) -> LayerOutput:
 
 
 @_export
-def moe_ffn(input, num_experts: int, expert_hidden: int,
+def moe_ffn(input, num_experts: int = 0, expert_hidden: int = 0,
             capacity_factor: float = 1.25, aux_weight: float = 0.01,
+            top_k: int = 1, config=None,
             name: Optional[str] = None, param_attr=None):
     """Mixture-of-Experts FFN layer (new-build extension; parallel/moe.py
-    holds the kernels): Switch-style top-1 routing into per-expert
+    holds the kernels): Switch-style top-1 — or, with ``top_k=2``,
+    GShard-style top-2 with renormalized gates — routing into per-expert
     two-layer FFNs. Returns ``(out, aux_cost)`` — add ``aux_cost`` to the
     SGD cost list (multi-cost training, the MultiNetwork path) so routing
     stays load-balanced; its value is ``aux_weight *`` the Switch
     balance loss.
+
+    ``config=`` takes a :class:`paddle_tpu.parallel.moe.MoEConfig` in
+    place of the scalar kwargs (explicit kwargs win where both are
+    given).  The expert weights declare leading-dim sharding over the
+    config's ``expert`` axis (MoEConfig.param_plan through the one
+    placement layer), so on an expert mesh each device holds only its
+    E/N experts — on a mesh WITHOUT that axis the declared dim falls
+    back to replicated and the dense path runs.
 
     Under a mesh with an ``'expert'`` axis the experts shard and dispatch
     rides two all_to_alls (parallel.moe.moe_ffn); otherwise the dense
@@ -2082,18 +2092,46 @@ def moe_ffn(input, num_experts: int, expert_hidden: int,
     zeros (callers add the residual). On packed SequenceBatch inputs the
     padding slots also route (they waste a little capacity; their outputs
     are zeroed)."""
+    import dataclasses
+
     from paddle_tpu.parallel import moe as pmoe
 
     inp = input
+    axis = "expert"
+    if config is not None:
+        num_experts = int(num_experts or config.num_experts)
+        expert_hidden = int(expert_hidden or config.expert_hidden)
+        capacity_factor = float(config.capacity_factor)
+        top_k = int(config.top_k)
+        aux_weight = float(config.aux_weight)
+        axis = str(config.axis)
+        if expert_hidden <= 0:
+            # MoEConfig.expert_hidden == 0: derive from the model width
+            expert_hidden = 4 * int(inp.size)
+    if num_experts <= 0 or expert_hidden <= 0:
+        raise ValueError("moe_ffn needs num_experts/expert_hidden > 0 "
+                         "(directly or via config=MoEConfig(...))")
+
     name = name or unique_name("moe_ffn")
     attr = ParamAttr.to_attr(param_attr)
+
+    def _expert(base, ndim):
+        # stacked [E, ...] expert weights: leading dim over the expert
+        # axis unless the caller pinned a sharding explicitly
+        if base.sharding is not None:
+            return base
+        return dataclasses.replace(
+            base, sharding=(axis,) + (None,) * (ndim - 1))
+
     d = inp.size
     params = {
         "router": ParamSpec((d, num_experts), attr),
-        "w1": ParamSpec((num_experts, d, expert_hidden), attr),
-        "b1": ParamSpec((num_experts, expert_hidden), ParamAttr.to_attr(None)),
-        "w2": ParamSpec((num_experts, expert_hidden, d), attr),
-        "b2": ParamSpec((num_experts, d), ParamAttr.to_attr(None)),
+        "w1": ParamSpec((num_experts, d, expert_hidden), _expert(attr, 3)),
+        "b1": ParamSpec((num_experts, expert_hidden),
+                        _expert(ParamAttr.to_attr(None), 2)),
+        "w2": ParamSpec((num_experts, expert_hidden, d), _expert(attr, 3)),
+        "b2": ParamSpec((num_experts, d),
+                        _expert(ParamAttr.to_attr(None), 2)),
     }
 
     def compute(ctx, p, ins):
@@ -2101,13 +2139,14 @@ def moe_ffn(input, num_experts: int, expert_hidden: int,
         x = _data_of(v)
         mp = pmoe.MoEParams(p["router"], p["w1"], p["b1"], p["w2"], p["b2"])
         mesh = ctx.mesh
-        if mesh is not None and "expert" in tuple(
+        if mesh is not None and axis in tuple(
                 getattr(mesh, "axis_names", ())):
-            y, aux = pmoe.moe_ffn(mesh, x, mp,
-                                  capacity_factor=capacity_factor)
+            y, aux = pmoe.moe_ffn(mesh, x, mp, axis=axis,
+                                  capacity_factor=capacity_factor,
+                                  top_k=top_k)
         else:
             y, aux = pmoe.moe_ffn_reference(
-                x, mp, capacity_factor=capacity_factor)
+                x, mp, capacity_factor=capacity_factor, top_k=top_k)
         if isinstance(v, SequenceBatch):
             y = jnp.where(v.valid_mask[:, None], y, 0)
         out = _like(v, y.astype(pmath.dense_activation_dtype()))

@@ -26,7 +26,7 @@ from paddle_tpu import layer
 
 def block(x, *, n_heads: int, ffn_mult: int = 4, name: str,
           dropout: float = 0.0, causal: bool = True, memory=None,
-          moe_experts: int = 0):
+          moe_experts: int = 0, moe_top_k: int = 1):
     """One pre-LN transformer block: x + drop(MHA(LN(x))) [+ x +
     cross-MHA(LN(x), memory) when ``memory`` is given]; x + drop(FFN(LN(x))).
 
@@ -54,7 +54,7 @@ def block(x, *, n_heads: int, ffn_mult: int = 4, name: str,
     if moe_experts > 0:
         f, aux = layer.moe_ffn(f, num_experts=moe_experts,
                                expert_hidden=x.size * ffn_mult,
-                               name=f"{name}_moe")
+                               top_k=moe_top_k, name=f"{name}_moe")
     else:
         f = layer.fc(input=f, size=x.size * ffn_mult, act="gelu",
                      name=f"{name}_ffn_up")
@@ -68,7 +68,7 @@ def block(x, *, n_heads: int, ffn_mult: int = 4, name: str,
 def build(vocab_size: int = 32768, d_model: int = 512, n_layers: int = 6,
           n_heads: int = 8, max_len: int = 1024, ffn_mult: int = 4,
           dropout: float = 0.0, fused_head: bool = False,
-          moe_experts: int = 0, remat: bool = False):
+          moe_experts: int = 0, moe_top_k: int = 1, remat: bool = False):
     """Returns (tokens, positions, target, logits, cost).
 
     Feeds: ``tokens`` / ``target`` are integer sequences (next-token
@@ -111,7 +111,8 @@ def build(vocab_size: int = 32768, d_model: int = 512, n_layers: int = 6,
             if moe_experts > 0:
                 x, aux = block(x, n_heads=n_heads, ffn_mult=ffn_mult,
                                name=f"blk{i}", dropout=dropout,
-                               moe_experts=moe_experts)
+                               moe_experts=moe_experts,
+                               moe_top_k=moe_top_k)
                 aux_nodes.append(aux)
             else:
                 x = block(x, n_heads=n_heads, ffn_mult=ffn_mult,

@@ -74,7 +74,11 @@ def param_sharding(mesh, params: Dict[str, jax.Array], specs=None,
         if specs is not None and name in specs:
             attr = specs[name].attr
         if attr is not None and attr.sharding is not None:
-            spec = P(*attr.sharding)
+            # dims naming an axis this mesh does not have fall back to
+            # replicated: one spec dict serves every mesh topology (an
+            # expert-sharded FFN trains unsharded on a plain data mesh)
+            spec = P(*(a if (a is None or a in axis_size) else None
+                       for a in attr.sharding))
         elif zero_axis is not None:
             n = axis_size[zero_axis]
             dims = [None] * v.ndim

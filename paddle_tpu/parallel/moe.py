@@ -3,8 +3,9 @@
 New-build extension (the reference predates MoE; its expert-parallel
 machinery is the sparse/pserver row distribution this module's dispatch
 generalizes — SURVEY §2.3 "large model dist train"): a Switch-style
-top-1 MoE FFN whose experts are sharded over a mesh axis, with the
-classic dispatch/combine all_to_all pattern from the scaling-book recipe:
+top-1 / GShard-style top-2 MoE FFN whose experts are sharded over a
+mesh axis, with the classic dispatch/combine all_to_all pattern from
+the scaling-book recipe:
 
   tokens (sharded over the axis) --router--> per-expert capacity buffers
   --all_to_all--> each shard runs ITS experts' FFN on tokens from every
@@ -14,12 +15,25 @@ classic dispatch/combine all_to_all pattern from the scaling-book recipe:
 single-device runs and as the parity oracle; ``moe_ffn`` is the
 shard_map/all_to_all version. Tokens over capacity are DROPPED (pass
 through as zeros — callers add the residual), the Switch convention.
+Top-2 routing renormalizes the two gates to sum to 1 (GShard); per-
+expert capacity is UNCHANGED by ``top_k`` — k token-choices compete for
+the same ``ceil(T/E * capacity_factor)`` slots, so raise the factor
+toward ``k *`` the top-1 value when drops matter.
+
+``MoEConfig`` is the model-zoo surface: it carries the routing
+hyperparameters AND the placement plan that puts every expert weight's
+leading E dim on the ``expert`` mesh axis through
+``parallel.placement.plan_param_attrs`` — the one-placement-layer
+story.  ``record_moe_stats`` lands the drop-rate/load statistics on the
+obs metrics registry after a step.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Optional
+import math
+from dataclasses import dataclass
+from typing import Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -30,26 +44,86 @@ from paddle_tpu.platform.enforce import enforce_that
 from paddle_tpu.parallel.compat import no_rep_check_kw, shard_map
 
 # the audited compiled-path site every expert-parallel dispatch runs
-# through (see parallel/pipeline.py for the stub-contract rationale)
+# through; its contract (below) declares the closed-form collective
+# budget `python -m paddle_tpu.analysis sharding` checks
 MOE_SITE = "parallel.moe"
 
 
-def stub_contract(axis: str = "expert"):
-    """Declared sharding contract for the EP dispatch: tokens shard
-    their leading dim over ``axis``, the router replicates, expert
-    weights shard their leading E dim, outputs come back token-sharded
-    with a replicated aux loss; the two all_to_alls and the stats
-    pmean are the point."""
-    from paddle_tpu.analysis.retrace import SiteContract
+def moe_contract(mesh, axis: str, e: int, cap: int, d: int,
+                 with_stats: bool = False):
+    """The REAL declared sharding contract for one EP dispatch geometry:
+    tokens shard their leading dim over ``axis``, the router replicates,
+    expert weights shard their leading E dim, outputs come back
+    token-sharded with a replicated aux loss.
 
+    The comm budget is the closed form of exactly the collectives the
+    compiled program contains (the arXiv 2112.09017 cost model the
+    auditor prices with — budget == estimate, so ANY extra collective
+    trips the gate):
+
+      - dispatch + combine all_to_all pair: each moves the per-shard
+        [E, C, D] f32 capacity buffer, ``b = e*cap*d*4`` bytes, costed
+        ``b*(n-1)/n`` per hop;
+      - the two aux-stat pmeans ([E] f32 fraction / mean-prob), psum
+        lowered: ``2*4e*(n-1)/n`` each;
+      - the drop-rate pmean (scalar f32) when stats are requested.
+    """
+    from paddle_tpu.analysis.retrace import SiteContract
+    from paddle_tpu.analysis.sharding import (all_reduce_bytes,
+                                              all_to_all_bytes)
+
+    n = int(mesh.shape[axis])
+    comm = 2.0 * all_to_all_bytes(e * cap * d * 4, n)
+    comm += 2.0 * all_reduce_bytes(4 * e, n)
+    out_specs = ((axis,), ())
+    if with_stats:
+        comm += all_reduce_bytes(4, n)       # drop-rate scalar pmean
+        out_specs = ((axis,), (), (), ())
     return SiteContract(
         allow_collectives=True,
+        mesh_axes=tuple((a, int(mesh.shape[a])) for a in mesh.axis_names),
+        comm_bytes=comm,
         in_specs=((axis,), (), (axis,), (axis,), (axis,), (axis,)),
-        out_specs=((axis,), ()))
+        out_specs=out_specs)
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    """Model-zoo MoE block configuration + expert placement.
+
+    ``num_experts``/``expert_hidden`` size the block (``expert_hidden``
+    0 lets the layer derive it from the model width); ``top_k`` selects
+    Switch (1) or GShard (2) routing; ``axis`` names the mesh axis the
+    expert weights' leading E dim shards over.  ``capacity_factor`` is
+    per-expert and top_k-independent (see module docstring).
+    """
+
+    num_experts: int
+    expert_hidden: int = 0
+    capacity_factor: float = 1.25
+    top_k: int = 1
+    axis: str = "expert"
+    aux_weight: float = 0.01
+
+    def param_plan(self, prefix: str = "") -> Dict[str, Tuple]:
+        """{param name: per-dim axis tuple} for the expert weights —
+        the ``plan_param_attrs`` input that resolves this block through
+        the one placement layer (router replicates: no entry)."""
+        ax = self.axis
+        return {f"{prefix}w1": (ax, None, None), f"{prefix}b1": (ax, None),
+                f"{prefix}w2": (ax, None, None), f"{prefix}b2": (ax, None)}
+
+    def param_attrs(self, prefix: str = "") -> Dict[str, object]:
+        """{param name: ParamAttr} with the expert-axis sharding set —
+        ready to attach to the zoo layer's ParamSpecs."""
+        from paddle_tpu.parallel.placement import plan_param_attrs
+
+        return {k: v.attr
+                for k, v in plan_param_attrs(self.param_plan(prefix)).items()}
 
 
 class MoEParams(NamedTuple):
-    """Weights for a top-1 MoE FFN: router [D, E]; experts stacked on the
+    """Weights for a MoE FFN: router [D, E]; experts stacked on the
     leading axis — w1 [E, D, H], b1 [E, H], w2 [E, H, D], b2 [E, D]."""
 
     router: jax.Array
@@ -79,6 +153,22 @@ def _route(x, router_w):
     return expert, gate, probs
 
 
+def _route_topk(x, router_w, k: int):
+    """Top-k routing: (experts [T, k], gates [T, k], probs [T, E]).
+
+    k == 1 keeps the raw Switch gate (softmax prob of the winner);
+    k > 1 renormalizes the k winning gates to sum to 1 (GShard top-2
+    convention) so the combined output stays on the activation scale.
+    """
+    logits = (x.astype(jnp.float32) @ router_w.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)
+    gates, experts = jax.lax.top_k(probs, k)
+    experts = experts.astype(jnp.int32)
+    if k > 1:
+        gates = gates / jnp.sum(gates, axis=-1, keepdims=True)
+    return experts, gates, probs
+
+
 def _aux_stats(probs: jax.Array, expert: jax.Array):
     """Per-batch routing statistics: (fraction routed to e, mean prob e)."""
     e = probs.shape[-1]
@@ -104,6 +194,31 @@ def _dispatch_mask(expert, num_experts: int, capacity: int):
     return disp * keep[..., None].astype(jnp.float32)
 
 
+def _dispatch_mask_topk(experts, num_experts: int, capacity: int):
+    """[T, k, E, C] dispatch tensor for top-k routing.
+
+    Capacity slots are claimed CHOICE-MAJOR: every token's first choice
+    ranks before any token's second choice (the GShard priority — a
+    second choice never evicts a first choice).  k == 1 reduces exactly
+    to :func:`_dispatch_mask`.
+    """
+    t, k = experts.shape
+    onehot = jax.nn.one_hot(experts, num_experts, dtype=jnp.int32)  # [T,k,E]
+    flat = jnp.swapaxes(onehot, 0, 1).reshape(k * t, num_experts)
+    pos = (jnp.cumsum(flat, axis=0) - 1).reshape(k, t, num_experts)
+    pos = jnp.swapaxes(pos, 0, 1)                                   # [T,k,E]
+    keep = (pos < capacity) & (onehot > 0)
+    slot = jnp.clip(pos, 0, capacity - 1)
+    disp = jax.nn.one_hot(slot, capacity, dtype=jnp.float32)       # [T,k,E,C]
+    return disp * keep[..., None].astype(jnp.float32)
+
+
+def _drop_rate(disp, t: int, k: int):
+    """Fraction of (token, choice) dispatch slots that fell past their
+    expert's capacity — 0.0 when nothing drops."""
+    return 1.0 - jnp.sum(disp) / float(t * k)
+
+
 def _expert_ffn(buf, w1, b1, w2, b2, act):
     """buf [E_loc, N, D] through each local expert's two-layer FFN."""
     h = act(jnp.einsum("end,edh->enh", buf, w1) + b1[:, None, :])
@@ -112,30 +227,39 @@ def _expert_ffn(buf, w1, b1, w2, b2, act):
 
 def moe_ffn_reference(x: jax.Array, params: MoEParams,
                       capacity_factor: float = 1.25,
-                      act=jax.nn.gelu):
+                      act=jax.nn.gelu, top_k: int = 1,
+                      return_stats: bool = False):
     """Single-device dense formulation (and the parity oracle).
 
-    x: [T, D] tokens. Returns (y [T, D], aux_loss scalar). Tokens past an
-    expert's capacity pass through as ZEROS (add the residual outside).
+    x: [T, D] tokens. Returns (y [T, D], aux_loss scalar) — plus a
+    ``{"drop_rate", "expert_fraction"}`` stats dict when
+    ``return_stats`` (feed it to :func:`record_moe_stats`).  Tokens
+    past an expert's capacity pass through as ZEROS (add the residual
+    outside).
     """
-    import math
-
     t, d = x.shape
     e = params.router.shape[1]
     cap = max(1, math.ceil(t / e * capacity_factor))
-    expert, gate, probs = _route(x, params.router)
-    disp = _dispatch_mask(expert, e, cap)                  # [T, E, C]
-    buf = jnp.einsum("tec,td->ecd", disp,
+    experts, gates, probs = _route_topk(x, params.router, top_k)
+    disp = _dispatch_mask_topk(experts, e, cap)            # [T, k, E, C]
+    buf = jnp.einsum("tkec,td->ecd", disp,
                      x.astype(jnp.float32))                # [E, C, D]
     out = _expert_ffn(buf, params.w1, params.b1, params.w2, params.b2,
                       act)                                  # [E, C, D]
-    y = jnp.einsum("tec,ecd->td", disp, out)               # undispatch
-    y = y * gate[:, None]
-    return y.astype(x.dtype), aux_load_balance_loss(probs, expert)
+    wdisp = disp * gates[:, :, None, None]
+    y = jnp.einsum("tkec,ecd->td", wdisp, out)             # gated combine
+    aux = aux_load_balance_loss(probs, experts[:, 0])
+    if not return_stats:
+        return y.astype(x.dtype), aux
+    fraction, _ = _aux_stats(probs, experts[:, 0])
+    stats = {"drop_rate": _drop_rate(disp, t, top_k),
+             "expert_fraction": fraction}
+    return y.astype(x.dtype), aux, stats
 
 
 def moe_ffn(mesh, x: jax.Array, params: MoEParams, axis: str = "expert",
-            capacity_factor: float = 1.25, act=jax.nn.gelu):
+            capacity_factor: float = 1.25, act=jax.nn.gelu,
+            top_k: int = 1, return_stats: bool = False):
     """Expert-parallel MoE FFN: tokens AND experts sharded over ``axis``.
 
     x: [T, D] global tokens (T divisible by the axis size); expert weights
@@ -146,7 +270,9 @@ def moe_ffn(mesh, x: jax.Array, params: MoEParams, axis: str = "expert",
     unlucky routing can drop more tokens than the dense oracle; parity
     tests use uniform-ish routing or generous capacity).
 
-    Returns (y [T, D] in token order, aux_loss scalar).
+    Returns (y [T, D] in token order, aux_loss scalar); with
+    ``return_stats``, appends a ``{"drop_rate", "expert_fraction"}``
+    dict of GLOBAL (pmean'd) routing statistics.
     """
     n = mesh.shape[axis]
     t, d = x.shape
@@ -155,28 +281,34 @@ def moe_ffn(mesh, x: jax.Array, params: MoEParams, axis: str = "expert",
                  context="moe")
     enforce_that(e % n == 0, f"experts {e} not divisible by {axis}={n}",
                  context="moe")
-    import math
-
     t_loc = t // n
     cap = max(1, math.ceil(t_loc / e * capacity_factor))
-    fn = _moe_jit(mesh, axis, e, cap, act)
-    return fn(x, params.router, params.w1, params.b1, params.w2,
-              params.b2)
+    fn = _moe_jit(mesh, axis, e, cap, int(d), act, int(top_k),
+                  bool(return_stats))
+    out = fn(x, params.router, params.w1, params.b1, params.w2,
+             params.b2)
+    if not return_stats:
+        return out
+    y, aux, drop, fraction = out
+    return y, aux, {"drop_rate": drop, "expert_fraction": fraction}
 
 
 @functools.lru_cache(maxsize=64)
-def _moe_jit(mesh, axis: str, e: int, cap: int, act):
-    """One audited jit per (mesh, axis, experts, capacity, activation)
-    — the zero.py identity idiom; bounded + stable-callable caveats as
-    ``_pipeline_jit`` (``act`` keys by identity)."""
+def _moe_jit(mesh, axis: str, e: int, cap: int, d: int, act, top_k: int,
+             with_stats: bool):
+    """One audited jit per (mesh, axis, experts, capacity, width,
+    activation, top_k, stats) — the zero.py identity idiom; bounded +
+    stable-callable caveats as ``_pipeline_jit`` (``act`` keys by
+    identity).  The geometry in the key is exactly what the closed-form
+    comm budget needs, so the REAL contract is computed at wrap time."""
     n = mesh.shape[axis]
 
     def local(xl, router_w, w1, b1, w2, b2):
         # xl [T_loc, D]; w1 [E_loc, D, H] (this shard's experts)
-        d = xl.shape[1]
-        expert, gate, probs = _route(xl, router_w)
-        disp = _dispatch_mask(expert, e, cap)              # [T_loc, E, C]
-        buf = jnp.einsum("tec,td->ecd", disp,
+        t_loc = xl.shape[0]
+        experts, gates, probs = _route_topk(xl, router_w, top_k)
+        disp = _dispatch_mask_topk(experts, e, cap)      # [T_loc, k, E, C]
+        buf = jnp.einsum("tkec,td->ecd", disp,
                          xl.astype(jnp.float32))           # [E, C, D]
         # exchange: shard s sends buf rows of shard r's experts to r
         buf = buf.reshape(n, e // n, cap, d)
@@ -191,22 +323,61 @@ def _moe_jit(mesh, axis: str, e: int, cap: int, act):
                                  tiled=False)   # [owner_shard, E_loc, C, D]
         # flat [owner, local] order IS global expert id owner*(E/n)+local
         out = out.reshape(e, cap, d)                       # [E, C, D]
-        y = jnp.einsum("tec,ecd->td", disp, out) * gate[:, None]
+        wdisp = disp * gates[:, :, None, None]
+        y = jnp.einsum("tkec,ecd->td", wdisp, out)
         # GLOBAL routing statistics (pmean the components, THEN combine —
         # a mean of per-shard products is not the global aux loss)
-        fraction, mean_prob = _aux_stats(probs, expert)
+        fraction, mean_prob = _aux_stats(probs, experts[:, 0])
         fraction = jax.lax.pmean(fraction, axis)
         mean_prob = jax.lax.pmean(mean_prob, axis)
         aux = e * jnp.sum(fraction * mean_prob)
-        return y.astype(xl.dtype), aux
+        if not with_stats:
+            return y.astype(xl.dtype), aux
+        drop = jax.lax.pmean(_drop_rate(disp, t_loc, top_k), axis)
+        return y.astype(xl.dtype), aux, drop, fraction
 
+    out_specs = (P(axis, None), P(), P(), P()) if with_stats \
+        else (P(axis, None), P())
     fn = shard_map(
         local, mesh=mesh,
         in_specs=(P(axis, None), P(None, None), P(axis, None, None),
                   P(axis, None), P(axis, None, None), P(axis, None)),
-        out_specs=(P(axis, None), P()),
+        out_specs=out_specs,
         **no_rep_check_kw())
 
     from paddle_tpu.analysis.retrace import audit_jit
 
-    return audit_jit(fn, site=MOE_SITE, xla_contract=stub_contract(axis))
+    return audit_jit(fn, site=MOE_SITE,
+                     xla_contract=moe_contract(mesh, axis, e, cap, d,
+                                               with_stats))
+
+
+def record_moe_stats(stats, registry=None, prefix: str = "moe") -> None:
+    """Land one step's routing statistics on the obs metrics registry
+    (host-side: call OUTSIDE jit, on concrete step outputs):
+
+      - ``{prefix}_drop_rate`` gauge — fraction of (token, choice)
+        dispatch slots past capacity this step;
+      - ``{prefix}_expert_load_imbalance`` gauge — max expert load
+        relative to uniform (1.0 == perfectly balanced routing);
+      - ``{prefix}_dropped_tokens`` counter — cumulative drop mass.
+    """
+    import numpy as np
+
+    from paddle_tpu.obs.registry import default_registry
+
+    reg = registry if registry is not None else default_registry()
+    drop = float(stats["drop_rate"])
+    reg.gauge(f"{prefix}_drop_rate",
+              "fraction of (token, choice) MoE dispatch slots dropped "
+              "past expert capacity in the last recorded step").set(drop)
+    frac = stats.get("expert_fraction")
+    if frac is not None:
+        f = np.asarray(frac, dtype=np.float64)
+        if f.size:
+            reg.gauge(f"{prefix}_expert_load_imbalance",
+                      "max expert routing fraction relative to uniform "
+                      "(1.0 = balanced)").set(float(f.max() * f.size))
+    if drop > 0.0:
+        reg.counter(f"{prefix}_dropped_tokens",
+                    "cumulative dropped MoE dispatch mass").inc(drop)

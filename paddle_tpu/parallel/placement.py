@@ -27,6 +27,17 @@ from typing import Dict, Optional, Sequence, Tuple
 from paddle_tpu.attr import ExtraAttr, ParamAttr
 
 
+#: The mesh-axis taxonomy every placement plan draws from (one axis,
+#: one meaning — MIGRATION.md "Pod-scale training" spells out the
+#: composition rules):
+#:   data   — batch replication; the grad-psum / ZeRO domain
+#:   zero   — alias role of ``data`` when ZeRO shards optimizer state
+#:   stage  — pipeline stages (stacked layer dim, leading-dim sharded)
+#:   expert — MoE experts (stacked expert dim, leading-dim sharded)
+#:   model  — tensor parallelism (megatron col/row feature sharding)
+KNOWN_AXES = ("data", "zero", "stage", "expert", "model")
+
+
 @dataclass(frozen=True)
 class _PlanSpec:
     """Adapter so a serving ``shard_plan`` entry plugs into the
@@ -54,6 +65,42 @@ def plan_param_attrs(plan: Dict[str, Tuple]) -> Dict[str, _PlanSpec]:
         if any(a is not None for a in dims):
             out[name] = _PlanSpec(attr=ParamAttr(sharding=dims))
     return out
+
+
+def leading_axis_plan(params: Dict[str, object],
+                      axis: str) -> Dict[str, Tuple]:
+    """{name: (axis, None, ...)} plan for stacked-leading-dim weights —
+    the layout pipeline stages (``axis="stage"``: [L, ...] layer stacks)
+    and MoE experts (``axis="expert"``: [E, ...] expert stacks) share.
+    ``params`` maps names to arrays (or anything with ``ndim``/``shape``).
+    Feed the result to :func:`plan_param_attrs`; it composes with TP and
+    ZeRO entries in the same plan — the one-placement-layer story."""
+    out: Dict[str, Tuple] = {}
+    for name, v in params.items():
+        nd = getattr(v, "ndim", None)
+        if nd is None:
+            nd = len(getattr(v, "shape", ()))
+        out[name] = (axis,) + (None,) * (int(nd) - 1)
+    return out
+
+
+def pipeline_param_attrs(params: Dict[str, object],
+                         axis: str = "stage") -> Dict[str, _PlanSpec]:
+    """``plan_param_attrs`` of the pipeline leading-dim plan: every
+    stacked body weight [L, ...] shards its layer dim over ``axis`` so
+    each stage's device holds exactly its L/S layers.  The stacked [L,
+    ...] layout itself is LAYOUT-INDEPENDENT: checkpoints save the full
+    gathered stack and reload into any stage count dividing L
+    (gather-on-save / scatter-on-load, same as every sharded param)."""
+    return plan_param_attrs(leading_axis_plan(params, axis))
+
+
+def expert_param_attrs(params: Dict[str, object],
+                       axis: str = "expert") -> Dict[str, _PlanSpec]:
+    """``plan_param_attrs`` of the MoE leading-dim plan ([E, ...] expert
+    stacks over ``axis``) — :meth:`paddle_tpu.parallel.moe.MoEConfig.
+    param_plan` names which weights; this shards any stacked dict."""
+    return plan_param_attrs(leading_axis_plan(params, axis))
 
 
 def stage_attrs(part: str, axis: str = "model"):

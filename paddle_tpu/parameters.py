@@ -97,6 +97,17 @@ class Parameters:
     def get_spec(self, name: str) -> Optional[ParamSpec]:
         return self._specs.get(name)
 
+    def pop(self, name: str) -> jax.Array:
+        """Remove and return a parameter (and its spec) — the
+        repacking seam trainer.SGD's pipeline path uses to swap the
+        per-block ``blk{i}_*`` layout for stacked [L, ...] stage
+        weights without leaving stale entries behind."""
+        if name not in self._values:
+            raise EnforceError(f"no parameter named {name!r}",
+                               context="parameters")
+        self._specs.pop(name, None)
+        return self._values.pop(name)
+
     # ---- pytree bridge ---------------------------------------------------
 
     def as_dict(self) -> Dict[str, jax.Array]:

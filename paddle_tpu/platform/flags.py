@@ -121,6 +121,18 @@ FLAGS.define("zero_stage", 0,
              "reduce-scatter grads, update a 1/N optimizer-state shard "
              "per replica over the 'data' mesh axis, all-gather updated "
              "weights. Per-trainer override: SGD(zero=...).")
+FLAGS.define("pipeline_stages", 0,
+             "pipeline-parallel stage count S for SGD(pipeline=...). 0 = "
+             "derive: the PipelineConfig's num_stages, else the mesh's "
+             "'stage' axis size, else every visible device. The model's "
+             "layer count must divide by S (each stage holds L/S "
+             "consecutive blocks).", parser=int)
+FLAGS.define("pipeline_microbatches", 8,
+             "GPipe microbatch count M per pipeline-parallel train step "
+             "(PipelineConfig(microbatches=0) reads this). The batch "
+             "must divide by M; bubble fraction is (S-1)/(M+S-1), so "
+             "larger M amortizes the fill/drain bubble at the cost of "
+             "smaller per-microbatch matmuls.", parser=int)
 FLAGS.define("serving_page_size", 128,
              "paged-KV cache page size in tokens (serving engine). 128 "
              "matches the TPU lane width so a page's K/V tile feeds the "

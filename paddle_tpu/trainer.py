@@ -71,6 +71,7 @@ class SGD:
                  metrics: Optional[Dict[str, LayerOutput]] = None,
                  zero_axis: Optional[str] = None,
                  zero: Optional[int] = None,
+                 pipeline=None,
                  faults=None, guard=None, tracer=None):
         costs = [cost] if isinstance(cost, LayerOutput) else list(cost)
         self.metrics = dict(metrics or {})
@@ -84,6 +85,18 @@ class SGD:
         self.optimizer = update_equation
         self.optimizer.set_param_specs(self.topology.param_specs())
         self.model_state = self.topology.init_state()
+        # pipeline-parallel training (pipeline=PipelineConfig): repack the
+        # transformer body into stacked [L, ...] stage weights, build (or
+        # validate) a (data, stage) mesh, and swap the compiled step for
+        # the GPipe fill+drain schedule (parallel/pipeline.py). Placement
+        # composes through ONE plan: stage weights shard their stacked
+        # layer dim over 'stage' (placement.pipeline_param_attrs), and the
+        # replicated remainder (embeddings, head) still ZeRO-shards its
+        # optimizer state over 'data' when zero=1.
+        self._pipeline = None
+        self._pipe_specs: Dict[str, Any] = {}
+        if pipeline is not None:
+            mesh = self._setup_pipeline(pipeline, mesh)
         self.mesh = mesh
         self._zero_axis = zero_axis
         # commit params to their declared shardings (ParamAttr.sharding;
@@ -116,9 +129,13 @@ class SGD:
             if usable:
                 from paddle_tpu.parallel.zero import build_zero_plan
 
+                # merged specs: pipeline stage weights carry explicit
+                # stage sharding and are therefore EXCLUDED from ZeRO —
+                # "the ZeRO-sharded remainder" resolves through the same
+                # placement plan as everything else
                 self._zero_plan = build_zero_plan(
                     mesh, parameters.as_dict(),
-                    specs=self.topology.param_specs(),
+                    specs=self._param_specs(),
                     zero_axis=self._zero_axis)
         # unconditional (including None): a reused optimizer instance must
         # not carry a previous trainer's plan into this one
@@ -164,6 +181,189 @@ class SGD:
         self._async_ckpt = None
 
     # ------------------------------------------------------------------
+    # pipeline parallelism (4D composition: stage x data/zero [x model])
+    # ------------------------------------------------------------------
+
+    def _param_specs(self):
+        """Topology specs merged with the pipeline placement plan — the
+        ONE spec dict both ``param_sharding`` and ``build_zero_plan``
+        consume, so stacked stage weights (leading-dim 'stage'), stacked
+        expert weights, TP-sharded weights and the ZeRO-sharded
+        remainder all resolve through the same placement layer
+        (parallel/placement.py)."""
+        specs = dict(self.topology.param_specs())
+        specs.update(self._pipe_specs)
+        return specs
+
+    def _setup_pipeline(self, cfg, mesh):
+        """Resolve the pipeline geometry, build/validate the (data,
+        stage) mesh, and repack the transformer body ``blk{i}_*`` params
+        into stacked ``pipe_body.*`` [L, ...] stage weights.
+
+        The stacked layout is LAYOUT-INDEPENDENT: checkpoints carry the
+        full [L, ...] stack (gather-on-save), which reloads into any
+        stage count dividing L (scatter-on-load happens in
+        ``_place_on_mesh``) — the cross-layout resume contract."""
+        import re
+
+        from paddle_tpu.parallel import placement
+        from paddle_tpu.parallel.pipeline import PipelineConfig
+
+        enforce_that(isinstance(cfg, PipelineConfig),
+                     "pipeline= takes a parallel.PipelineConfig, got "
+                     f"{type(cfg).__name__}", context="trainer")
+        enforce_that(not self.metrics and self._n_costs == 1,
+                     "pipeline= supports a single cost and no metric "
+                     "layers (the loss rides the last-stage boundary "
+                     "hook, not topology.forward)", context="trainer")
+        axis = str(cfg.axis)
+        pat = re.compile(r"^blk(\d+)_(.+)$")
+        groups: Dict[str, Dict[int, str]] = {}
+        for name in self.parameters.names():
+            mt = pat.match(name)
+            if mt:
+                groups.setdefault(mt.group(2), {})[int(mt.group(1))] = name
+        enforce_that(bool(groups),
+                     "pipeline= found no blk{i}_* body parameters — the "
+                     "pipeline trainer partitions the model-zoo "
+                     "transformer naming convention "
+                     "(models/transformer.build)", context="trainer")
+        n_layers = int(cfg.n_layers) or (
+            max(i for d in groups.values() for i in d) + 1)
+        for suffix, d in groups.items():
+            enforce_that(sorted(d) == list(range(n_layers)),
+                         f"blk*_{suffix} layer ids {sorted(d)} do not "
+                         f"cover 0..{n_layers - 1}", context="trainer")
+        # stage count: config > flag > the mesh's stage axis > all devices
+        s = int(cfg.num_stages) or int(FLAGS.pipeline_stages)
+        if not s:
+            s = (int(mesh.shape[axis])
+                 if mesh is not None and axis in mesh.axis_names
+                 else jax.device_count())
+        m = int(cfg.microbatches) or int(FLAGS.pipeline_microbatches)
+        enforce_that(m >= 1, f"pipeline_microbatches={m} must be >= 1",
+                     context="trainer")
+        enforce_that(n_layers % s == 0,
+                     f"n_layers={n_layers} does not divide into "
+                     f"num_stages={s}", context="trainer")
+        if mesh is None:
+            from paddle_tpu.parallel.mesh import make_mesh
+
+            ndev = jax.device_count()
+            enforce_that(ndev % s == 0,
+                         f"{ndev} devices do not divide into "
+                         f"num_stages={s}", context="trainer")
+            # the (data, stage) mesh: 'data' is the ZeRO/optimizer-state
+            # sharding domain (feeds stay replicated — SequenceBatch)
+            mesh = make_mesh((ndev // s, s), ("data", axis))
+        enforce_that(axis in mesh.axis_names
+                     and int(mesh.shape[axis]) == s,
+                     f"mesh axes {dict(mesh.shape)} lack {axis!r}={s}",
+                     context="trainer")
+        # repack blk{i}_<suffix> -> pipe_body.<suffix> [L, ...] stacks;
+        # their placement plan shards the stacked layer dim over 'stage'
+        stacked = {}
+        for suffix, d in sorted(groups.items()):
+            vals = [self.parameters.pop(d[i]) for i in range(n_layers)]
+            stacked[f"pipe_body.{suffix}"] = jnp.stack(vals)
+        for k, v in stacked.items():
+            self.parameters[k] = v
+        self._pipe_specs = placement.pipeline_param_attrs(stacked, axis=axis)
+        self._pipeline = cfg
+        self._pipe_axis = axis
+        self._pipe_stages = s
+        self._pipe_m = m
+        self._pipe_layers = n_layers
+        self._pipe_heads = int(cfg.n_heads)
+        self._pipe_remat = bool(cfg.remat)
+        return mesh
+
+    def _pipeline_forward_backward(self):
+        """The pipeline replacement for the topology forward/backward:
+        pad the packed feeds, split them into M microbatches, and run
+        the GPipe fill+drain schedule (parallel.pipeline.pipeline_apply)
+        with the embed as the first-stage hook and final-LN + vocab head
+        + xent as the last-stage hook.  ``jax.grad`` differentiates
+        through scan + ppermute, so the backward schedule is free.
+
+        Loss semantics match ``_reduce_cost`` on a SequenceBatch cost
+        exactly: each microbatch emits the SUM of its valid-token
+        cross-entropies and the step divides by the global sequence
+        count (per-SEQUENCE mean) — the loss-trajectory parity pin.
+        With causal attention, trailing pad positions cannot leak into
+        valid positions, so parity holds for ragged batches too."""
+        from paddle_tpu.models import transformer as _tf
+        from paddle_tpu.ops.losses import softmax_cross_entropy
+        from paddle_tpu.parallel.pipeline import pipeline_apply
+
+        mesh = self.mesh
+        axis = self._pipe_axis
+        s, m = self._pipe_stages, self._pipe_m
+        n_heads = self._pipe_heads
+        per_stage = self._pipe_layers // s
+        remat = self._pipe_remat
+
+        def stage_fn(stk, x):
+            # stk: this stage's [L/S, ...] stacks — scan its blocks;
+            # vmap the per-sequence block over the microbatch rows
+            def one_block(h, blk):
+                h = jax.vmap(
+                    lambda seq: _tf.block_apply(blk, seq, n_heads=n_heads))(h)
+                return h, None
+
+            h, _ = jax.lax.scan(one_block, x, stk)
+            return h
+
+        def first_fn(fp, mb):
+            return (fp["tok_embed.w"][mb["tokens"]]
+                    + fp["pos_embed.w"][mb["pos"]])
+
+        def last_fn(lp, y, mb):
+            h = _tf._ln(y, lp["final_ln.gamma"], lp["final_ln.beta"])
+            logits = h @ lp["lm_head.w0"] + lp["lm_head.b"]
+            xe = softmax_cross_entropy(logits, mb["target"])
+            return jnp.sum(jnp.where(mb["mask"], xe, 0.0))
+
+        def microbatch_split(feeds):
+            tok, mask = feeds["tokens"].to_padded()
+            pos, _ = feeds["pos"].to_padded()
+            tgt, _ = feeds["target"].to_padded()
+            b = int(tok.shape[0])
+            enforce_that(b % m == 0,
+                         f"batch of {b} sequences does not divide into "
+                         f"pipeline_microbatches={m}", context="trainer")
+
+            def split(a):
+                return a.reshape((m, b // m) + a.shape[1:])
+
+            return {"tokens": split(tok), "pos": split(pos),
+                    "target": split(tgt), "mask": split(mask)}, b
+
+        def forward_backward(params, model_state, rng, feeds):
+            mbs, b = microbatch_split(feeds)
+
+            def loss_fn(p):
+                body = {k[len("pipe_body."):]: v for k, v in p.items()
+                        if k.startswith("pipe_body.")}
+                # [L, ...] -> [S, L/S, ...]: a leading-dim split, so the
+                # stage sharding carries over without resharding comm
+                stk = {k: v.reshape((s, per_stage) + v.shape[1:])
+                       for k, v in body.items()}
+                first_p = {k: p[k] for k in ("tok_embed.w", "pos_embed.w")}
+                last_p = {k: p[k] for k in ("final_ln.gamma",
+                                            "final_ln.beta",
+                                            "lm_head.w0", "lm_head.b")}
+                sums = pipeline_apply(mesh, stage_fn, stk, mbs, axis=axis,
+                                      first_fn=first_fn, first_params=first_p,
+                                      last_fn=last_fn, last_params=last_p,
+                                      remat=remat)
+                return jnp.sum(sums) / float(b), (model_state, {})
+
+            return jax.value_and_grad(loss_fn, has_aux=True)(params)
+
+        return forward_backward
+
+    # ------------------------------------------------------------------
     # compiled steps
     # ------------------------------------------------------------------
 
@@ -193,6 +393,11 @@ class SGD:
                 return total, (new_state, metric_vals)
 
             return jax.value_and_grad(loss_fn, has_aux=True)(params)
+
+        if self._pipeline is not None:
+            # same step/guard/stats wrapper, different forward/backward:
+            # the GPipe schedule replaces topology.forward wholesale
+            forward_backward = self._pipeline_forward_backward()
 
         def grad_stats(metric_vals, grads):
             if not stats_on:
@@ -282,7 +487,10 @@ class SGD:
             mesh_axes = tuple(
                 (str(a), int(s))
                 for a, s in zip(mesh.axis_names, mesh.devices.shape))
-            feed = ("data",) if "data" in mesh.axis_names else ()
+            # pipeline feeds are SequenceBatches (replicated); otherwise
+            # dense feeds shard their batch dim over 'data'
+            feed = (("data",) if "data" in mesh.axis_names
+                    and self._pipeline is None else ())
             plan = getattr(self, "_zero_plan", None)
             opt = (plan.axis,) if plan is not None else ()
             if test:
@@ -295,15 +503,26 @@ class SGD:
                     in_specs = in_specs + ((),)
                 if plan is not None:
                     expect = (1,)
+        # Under pipeline the step's comm scales with ticks x activation
+        # bytes — batch-shaped, invisible at build time — so the
+        # trainer-level budget stays unset (INFO); the inner
+        # parallel.pipeline site carries the EXACT closed-form budget.
+        comm = (None if self._pipeline is not None
+                else 6.0 * param_bytes + (1 << 20))
         return SiteContract(
             donate=tuple(donate), allow_collectives=True,
             allow_upcast=("bfloat16",),
             peak_bytes=16 * param_bytes + (1 << 28),
             in_specs=in_specs, mesh_axes=mesh_axes,
             expect_sharded=expect,
-            comm_bytes=6.0 * param_bytes + (1 << 20))
+            comm_bytes=comm)
 
     def _build_test(self):
+        enforce_that(self._pipeline is None,
+                     "test() is not supported under pipeline= (the "
+                     "repacked body has no topology.forward view) — "
+                     "evaluate with a sequential trainer sharing the "
+                     "checkpoint", context="trainer")
         topo = self.topology
         n_costs = self._n_costs
         metric_names = list(self.metrics.keys())
@@ -333,7 +552,7 @@ class SGD:
         from paddle_tpu.parallel.api import param_sharding
 
         shardings = param_sharding(self.mesh, self.parameters.as_dict(),
-                                   specs=self.topology.param_specs(),
+                                   specs=self._param_specs(),
                                    zero_axis=self._zero_axis)
         self.parameters.update_from(
             {k: _put_global(v, shardings[k])

@@ -417,22 +417,40 @@ def test_serving_step_audits_clean_f32(audit):
 
 
 # ---------------------------------------------------------------------------
-# pipeline / MoE stubs: contracts + loud notice + real capture
+# pipeline / MoE: REAL closed-form contracts + audited capture
 # ---------------------------------------------------------------------------
 
 
-def test_stub_contracts_declared_and_noticed(audit, capsys):
-    S.declare_stub_contracts()
-    for site in ("parallel.pipeline", "parallel.moe"):
-        rec = auditor().sites[site]
-        assert rec.contract is not None
-        assert rec.contract.allow_collectives
-        assert not rec.captured
-    # the gate prints the loud notice for exactly these sites (the
-    # notice logic lives in run_sharding_audit; replicate its scan)
-    uncaptured = [name for name, rec in auditor().sites.items()
-                  if rec.contract is not None and not rec.captured]
-    assert set(uncaptured) == {"parallel.pipeline", "parallel.moe"}
+def test_real_contracts_budget_equals_estimate(audit, mesh8):
+    """The stub contracts are gone: pipeline and MoE declare closed-form
+    comm budgets computed at wrap time from the dispatch geometry, and
+    the budget EQUALS the audited estimate — any extra collective that
+    sneaks into either program trips the comm-budget rule."""
+    from paddle_tpu.parallel import moe as pmoe
+    from paddle_tpu.parallel import pipeline as ppipe
+    from paddle_tpu.parallel.mesh import make_mesh
+
+    assert not hasattr(ppipe, "stub_contract")
+    assert not hasattr(pmoe, "stub_contract")
+
+    mesh = make_mesh((4,), ("stage",), jax.devices()[:4])
+    p = [{"w": jnp.eye(4) * (i + 1)} for i in range(4)]
+    stacked = ppipe.stack_stage_params(p, mesh, "stage")
+    ppipe.pipeline_apply(mesh, lambda prm, x: x @ prm["w"], stacked,
+                         jnp.ones((3, 2, 4)))
+    rep = _report("parallel.pipeline")
+    assert _errors(rep) == []
+    contract = auditor().sites["parallel.pipeline"].contract
+    assert contract.comm_bytes == rep.comm_bytes > 0
+
+    x = jnp.asarray(np.random.RandomState(0).randn(16, 8), jnp.float32)
+    params = pmoe.init_moe_params(jax.random.PRNGKey(0), 8, 16, 8)
+    pmoe.moe_ffn(mesh8, x, params, axis="data", capacity_factor=8.0,
+                 top_k=2, return_stats=True)
+    rep = _report("parallel.moe")
+    assert _errors(rep) == []
+    contract = auditor().sites["parallel.moe"].contract
+    assert contract.comm_bytes == rep.comm_bytes > 0
 
 
 def test_pipeline_capture_audits_with_collective_costs(audit, mesh8):

@@ -12,14 +12,26 @@ if ! timeout 120 python bench.py --worker probe >> "$OUT" 2>/tmp/onchip_err.txt;
   echo "probe failed -- relay still down" | tee -a "$OUT"; exit 1
 fi
 # order = what's missing or stale first: the transformer re-measures the
-# streaming-kernel bs8 tier, attention re-measures at auto-512 tiles, moe
-# has never produced a row; the already-fresh tables go last. Workers
-# with full-table sweeps get a bigger budget (every row prints
-# incrementally, so a timeout only loses not-yet-measured rows).
+# streaming-kernel bs8 tier (BENCH_FULL_SWEEP covers the bs8 best-combo
+# the ~0.40-MFU headline needs), attention re-measures at auto-512
+# tiles, moe has never produced a row; the already-fresh tables go
+# last. Workers with full-table sweeps get a bigger budget (every row
+# prints incrementally, so a timeout only loses not-yet-measured rows).
 for spec in transformer:900 matmul:300 attention:600 moe:600 resnet50:600 lstm:900 convnets:900 alexnet:900; do
   w="${spec%%:*}"; t="${spec##*:}"
   echo "== $w ==" >> "$OUT"
   BENCH_FULL_SWEEP=1 timeout "$t" python bench.py --worker "$w" >> "$OUT" 2>>/tmp/onchip_err.txt
+  echo "rc=$? for $w" >> "$OUT"
+done
+# pipeline + MoE EP train workers (ISSUE 19): mesh-shape workers that
+# want exactly 8 devices — run them on the virtual-8 host mesh so the
+# capture works on any chip count (same numbers the cpu bench pass
+# reports; the on-chip tokens/s rows come from the workers above).
+for spec in train_pipeline:600 train_moe:300; do
+  w="${spec%%:*}"; t="${spec##*:}"
+  echo "== $w (virtual-8) ==" >> "$OUT"
+  JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    timeout "$t" python bench.py --worker "$w" >> "$OUT" 2>>/tmp/onchip_err.txt
   echo "rc=$? for $w" >> "$OUT"
 done
 echo "done; results in $OUT"

@@ -19,7 +19,7 @@ import time
 KEEP_PREFIXES = (
     "transformer_", "resnet50_", "lstm_", "googlenet_", "smallnet_",
     "alexnet_", "attention_", "moe_", "matmul_", "batch", "device_kind",
-    "peak_tflops_assumed", "flops_source",
+    "peak_tflops_assumed", "flops_source", "pipeline_",
 )
 
 
