@@ -31,6 +31,18 @@
     all-gathers, accidental replication, axis collisions, and the
     collective-bytes budget (``SHARD-AUDIT`` findings).  Exit 0 =
     clean, 1 = findings, 2 = crash — ladder exit 9.
+
+``concurrency [--rule NAME ...] [--strict]``
+    The concurrency auditor: ``guarded-by`` (CONC-AUDIT lock-discipline
+    checker over the ``# guarded_by(...)`` annotations), ``state-table``
+    (PROTO-AUDIT static check of every literal assignment site against
+    the declared lifecycle state machines), ``transition-runtime`` (the
+    same machines checked dynamically through the transition recorder
+    while the seeded chaos drives run), and ``schedule-permute``
+    (SCHED-AUDIT: replay each chaos drive under permuted intra-tick
+    schedules and fail on any terminal-fingerprint divergence, dumping
+    an OBS-POSTMORTEM for the minimal divergent prefix).  Exit 0 =
+    clean, 1 = findings, 2 = crash — ladder exit 14.
 """
 
 from __future__ import annotations
@@ -169,6 +181,38 @@ def cmd_sharding(args) -> int:
     return 0
 
 
+def cmd_concurrency(args) -> int:
+    from paddle_tpu.analysis.concurrency import (RULE_NAMES,
+                                                 run_concurrency_audit)
+    from paddle_tpu.analysis.diagnostics import Severity
+
+    unknown = [r for r in (args.rule or []) if r not in RULE_NAMES]
+    if unknown:
+        print(f"unknown rule(s) {unknown}; known: {sorted(RULE_NAMES)}",
+              file=sys.stderr)
+        return 2
+    try:
+        diags = run_concurrency_audit(rules=args.rule or None)
+    except Exception as e:      # crash != findings: distinct exit code
+        print(f"concurrency audit crashed: {e!r}")
+        return 2
+    for d in diags:
+        print(d)
+    errs = [d for d in diags if d.severity is Severity.ERROR]
+    if errs or (args.strict and diags):
+        strict_note = ""
+        if args.strict and len(diags) > len(errs):
+            strict_note = (f" + {len(diags) - len(errs)} non-ERROR "
+                           "finding(s) failing under --strict")
+        print(f"CONC-AUDIT: {len(errs)} ERROR finding(s){strict_note} — "
+              "fix the access/transition/order, or annotate the "
+              "justified exception")
+        return 1
+    print(f"concurrency audit ok: 0 ERROR findings "
+          f"({len(diags)} informational)")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
@@ -220,6 +264,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--strict", action="store_true",
                    help="exit 1 on ANY diagnostic, not just ERRORs")
     p.set_defaults(fn=cmd_sharding)
+
+    p = sub.add_parser(
+        "concurrency",
+        help="lock-discipline checker + lifecycle state machines + "
+             "schedule-permutation model checker over the seeded chaos "
+             "drives")
+    p.add_argument("--rule", action="append", default=[],
+                   help="restrict the audit to the named rule(s); "
+                        "repeatable")
+    p.add_argument("--strict", action="store_true",
+                   help="exit 1 on ANY diagnostic, not just ERRORs")
+    p.set_defaults(fn=cmd_concurrency)
 
     args = parser.parse_args(argv)
     return args.fn(args)

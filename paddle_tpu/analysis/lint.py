@@ -4,7 +4,8 @@ actually had to engineer away.
 Every rule encodes a repo contract that tests cannot easily enforce:
 
 - ``wall-clock``       — ``time.time()`` / ``time.monotonic()`` /
-  ``time.perf_counter()`` called in serving/, master/ or obs/ code.
+  ``time.perf_counter()`` called in serving/, master/, obs/ or
+  resilience/ code.
   Those layers run on an injectable clock (``time_fn=`` / ``FaultPlan``
   ``ManualClock``) so SLO, fault AND tracing paths are testable without
   sleeps — the obs tracer stamping events off the injected clock is
@@ -17,7 +18,8 @@ Every rule encodes a repo contract that tests cannot easily enforce:
 - ``host-sync``        — ``.item()``, ``np.asarray``/``np.array``/
   ``jnp.asarray``/``jax.device_get`` calls — and ``float()``/``int()``
   over a jax expression — lexically inside a ``for``/``while`` loop in
-  serving, obs or platform code: a per-tick loop that syncs per
+  serving, obs, platform or resilience code: a per-tick loop that
+  syncs per
   element serializes the device pipeline (one sync per *tick* is the
   engine's documented budget, and instrumentation must add ZERO to it
   — obs is covered so a tracer hook can never smuggle a readback into
@@ -276,8 +278,9 @@ def _in_dirs(*names):
 RULES: Dict[str, Rule] = {
     "wall-clock": Rule(
         "wall-clock",
-        "direct clock calls in serving/master/obs code (injectable-"
-        "clock layers)", _in_dirs("serving", "master", "obs"),
+        "direct clock calls in serving/master/obs/resilience code "
+        "(injectable-clock layers)",
+        _in_dirs("serving", "master", "obs", "resilience"),
         _check_wall_clock),
     "unseeded-random": Rule(
         "unseeded-random",
@@ -285,9 +288,11 @@ RULES: Dict[str, Rule] = {
         lambda parts: True, _check_unseeded_random),
     "host-sync": Rule(
         "host-sync",
-        "per-element device syncs inside serving/obs/platform loops "
-        "(+ block_until_ready anywhere in those layers)",
-        _in_dirs("serving", "obs", "platform"), _check_host_sync),
+        "per-element device syncs inside serving/obs/platform/"
+        "resilience loops (+ block_until_ready anywhere in those "
+        "layers)",
+        _in_dirs("serving", "obs", "platform", "resilience"),
+        _check_host_sync),
     "mutable-default": Rule(
         "mutable-default", "mutable default argument values",
         lambda parts: True, _check_mutable_default),

@@ -214,14 +214,16 @@ class RetraceAuditor:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self.sites: Dict[str, SiteRecord] = {}
-        self.diagnostics: List[Diagnostic] = []
-        self._sealed_all = False
+        self.sites: Dict[str, SiteRecord] = {}          # guarded_by(_lock)
+        self.diagnostics: List[Diagnostic] = []         # guarded_by(_lock)
+        self._sealed_all = False                        # guarded_by(_lock)
         # obs hook: when attached (ServingEngine.set_tracer does it for
         # an enabled tracer under FLAGS.jit_audit), every compile lands
         # on the trace timeline as a `jit_compile` instant — so a chaos
         # replay shows WHERE the compile spikes sit between the request
-        # spans.  None = no tracing, zero overhead.
+        # spans.  None = no tracing, zero overhead.  Rebinding a single
+        # reference is atomic and readers tolerate either value, so the
+        # tracer hook stays lock-free by design (unlike the counters).
         self.tracer = None
 
     def attach_tracer(self, tracer) -> None:
@@ -294,12 +296,17 @@ class RetraceAuditor:
                 rec.sealed = True
 
     def compile_count(self, name: str) -> int:
-        rec = self.sites.get(name)
-        return rec.compiles if rec is not None else 0
+        # under the lock like every other sites reader: a budget assert
+        # racing a lazily-created site (per-bucket jit on another
+        # thread) must never read the dict mid-insert
+        with self._lock:
+            rec = self.sites.get(name)
+            return rec.compiles if rec is not None else 0
 
     def call_count(self, name: str) -> int:
-        rec = self.sites.get(name)
-        return rec.calls if rec is not None else 0
+        with self._lock:
+            rec = self.sites.get(name)
+            return rec.calls if rec is not None else 0
 
     def assert_budget(self, name: str, max_compiles: int) -> None:
         """Raise :class:`RetraceError` if ``name`` compiled more than
@@ -311,7 +318,9 @@ class RetraceAuditor:
                 f"{max_compiles} ({self.call_count(name)} calls)")
 
     def assert_no_retraces(self) -> None:
-        retraces = [d for d in self.diagnostics if d.code == "RETRACE"]
+        with self._lock:
+            retraces = [d for d in self.diagnostics
+                        if d.code == "RETRACE"]
         if retraces:
             raise RetraceError(
                 "RETRACE: " + "; ".join(d.message for d in retraces))

@@ -87,8 +87,15 @@ class MasterServer:
     def __init__(self, service: Optional[Service] = None,
                  host: str = "127.0.0.1", port: int = 0):
         self.service = service or Service()
+        # the ThreadingTCPServer does its own internal locking; the
+        # REFERENCE to it (and to the acceptor thread below) is only
+        # rebound by the owner thread that calls start()/stop() —
+        # handler threads reach the server through their own argument,
+        # never through these fields
+        # guarded_by(serialized: owner thread drives start()/stop())
         self._srv = _Server((host, port), _Handler)
         self._srv.service = self.service  # type: ignore[attr-defined]
+        # guarded_by(serialized: owner thread drives start()/stop())
         self._thread: Optional[threading.Thread] = None
 
     @property

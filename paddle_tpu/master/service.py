@@ -65,7 +65,10 @@ class LeaseTable:
         # setting) costs one is-None check per transition.  Tokens are
         # NEVER recorded: slots identify members on the timeline.
         self.tracer = tracer
-        # slot -> (lease deadline, lease token)
+        # slot -> (lease deadline, lease token); callers serialize:
+        # Service wraps every call in its RLock, and the serving fleet
+        # drives its own table from the single engine tick thread
+        # guarded_by(serialized: callers hold Service RLock / tick loop)
         self._members: Dict[int, Tuple[float, str]] = {}
 
     def register(self, ttl_s: Optional[float] = None) -> Tuple[int, str]:
@@ -171,16 +174,17 @@ class Service:
         self._time = time_fn
         self._lock = threading.RLock()
 
-        self._todo: List[Task] = []
+        self._todo: List[Task] = []   # guarded_by(_lock)
         # task id -> (task, deadline)
+        # guarded_by(_lock)
         self._pending: Dict[int, Tuple[Task, float]] = {}
-        self._done: List[Task] = []
-        self._dataset_set = False
-        self._dataset_paths: List[str] = []
-        self._next_id = 0
-        self._pass_no = 0
+        self._done: List[Task] = []   # guarded_by(_lock)
+        self._dataset_set = False   # guarded_by(_lock)
+        self._dataset_paths: List[str] = []   # guarded_by(_lock)
+        self._next_id = 0   # guarded_by(_lock)
+        self._pass_no = 0   # guarded_by(_lock)
         # save-model dedup: time until which save requests are "taken"
-        self._save_until = 0.0
+        self._save_until = 0.0   # guarded_by(_lock)
         # trainer membership: the etcd Register/lease analog
         # (go/pserver/etcd_client.go:67-166 — each trainer holds an index
         # slot under a TTL lease; a missed heartbeat frees the slot and
@@ -190,10 +194,11 @@ class Service:
         # slots are REUSED after expiry, so a zombie trainer renewing by
         # slot number alone could hijack the slot's new owner —
         # heartbeats must present the token they registered with
+        # guarded_by(_lock)
         self._leases = LeaseTable(self.lease_ttl_s, time_fn=time_fn,
                                   on_expire=self._requeue_dead_member)
         # task id -> owner slot (for prompt requeue on lease expiry)
-        self._owners: Dict[int, Optional[int]] = {}
+        self._owners: Dict[int, Optional[int]] = {}   # guarded_by(_lock)
 
         if snapshot_path and os.path.exists(snapshot_path):
             self._recover(snapshot_path)
@@ -256,9 +261,11 @@ class Service:
         with self._lock:
             return self._leases.members()
 
+    # guarded_by(caller: _lock)
     def _expire_members(self) -> None:
         self._leases.expire()
 
+    # guarded_by(caller: _lock)
     def _requeue_dead_member(self, slot: int) -> None:
         """on_expire hook: runs for every freed slot on EVERY lease
         sweep — including the ones LeaseTable does internally inside
@@ -355,6 +362,7 @@ class Service:
 
     # ---- internals ---------------------------------------------------------
 
+    # guarded_by(caller: _lock)
     def _check_timeouts(self) -> None:
         now = self._time()
         expired = [tid for tid, (_, dl) in self._pending.items() if dl <= now]
@@ -369,11 +377,13 @@ class Service:
         if expired:
             self._snapshot()
 
+    # guarded_by(caller: _lock)
     def _maybe_new_pass(self) -> None:
         if self._dataset_set and not self._todo and not self._pending:
             # pass complete; tasks stay in done until new_pass() recycles
             self._pass_no += 1
 
+    # guarded_by(caller: _lock)
     def _start_new_pass(self) -> None:
         for t in self._done:
             t.epoch += 1
@@ -383,6 +393,7 @@ class Service:
 
     # ---- snapshot / recover ------------------------------------------------
 
+    # guarded_by(caller: _lock)
     def _state(self) -> dict:
         return {
             "todo": [asdict(t) for t in self._todo],
@@ -394,6 +405,7 @@ class Service:
             "pass_no": self._pass_no,
         }
 
+    # guarded_by(caller: _lock)
     def _snapshot(self) -> None:
         """Persist the queue state atomically (etcd_client.go:96-129).
 
@@ -421,6 +433,7 @@ class Service:
                 os.unlink(tmp)
             raise
 
+    # guarded_by(caller: _lock)  (also run from __init__, pre-publication)
     def _recover(self, path: str) -> None:
         """Rebuild the queue from a snapshot; a corrupt/torn snapshot
         (pre-hardening truncation, disk damage) starts CLEAN instead of

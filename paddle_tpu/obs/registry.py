@@ -62,8 +62,15 @@ class _Metric:
     def __init__(self, name: str, help: str = ""):
         self.name = name
         self.help = help
-        self._series: Dict[LabelKey, object] = {}
         self._lock = threading.Lock()
+        # one lock per metric, SHARED with every series it creates: a
+        # scrape (snapshot/to_text) and a writer thread (checkpoint
+        # writer bumping a counter, a master handler observing a
+        # latency) race on the same series fields, and `value += n` /
+        # the histogram's count-then-sum-then-bucket walk are not
+        # atomic — the CONC-AUDIT fix that replaced the old unlocked
+        # series (lost increments, torn count/sum pairs under scrape).
+        self._series: Dict[LabelKey, object] = {}   # guarded_by(_lock)
 
     def _new_series(self):
         raise NotImplementedError
@@ -82,13 +89,15 @@ class _Metric:
 
 
 class _CounterSeries:
-    __slots__ = ("value",)
+    __slots__ = ("_lock", "value")
 
-    def __init__(self):
-        self.value = 0.0
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock                 # the owning metric's lock
+        self.value = 0.0                  # guarded_by(_lock)
 
     def inc(self, n: float = 1.0) -> None:
-        self.value += n
+        with self._lock:
+            self.value += n
 
 
 class Counter(_Metric):
@@ -97,27 +106,32 @@ class Counter(_Metric):
     kind = "counter"
 
     def _new_series(self):
-        return _CounterSeries()
+        return _CounterSeries(self._lock)
 
     def inc(self, n: float = 1.0) -> None:
         self.labels().inc(n)
 
     @property
     def value(self) -> float:
-        return self.labels().value
+        s = self.labels()
+        with s._lock:
+            return s.value
 
 
 class _GaugeSeries:
-    __slots__ = ("value",)
+    __slots__ = ("_lock", "value")
 
-    def __init__(self):
-        self.value = 0.0
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock                 # the owning metric's lock
+        self.value = 0.0                  # guarded_by(_lock)
 
     def set(self, v: float) -> None:
-        self.value = float(v)
+        with self._lock:
+            self.value = float(v)
 
     def inc(self, n: float = 1.0) -> None:
-        self.value += n
+        with self._lock:
+            self.value += n
 
 
 class Gauge(_Metric):
@@ -126,40 +140,45 @@ class Gauge(_Metric):
     kind = "gauge"
 
     def _new_series(self):
-        return _GaugeSeries()
+        return _GaugeSeries(self._lock)
 
     def set(self, v: float) -> None:
         self.labels().set(v)
 
     @property
     def value(self) -> float:
-        return self.labels().value
+        s = self.labels()
+        with s._lock:
+            return s.value
 
 
 class _HistogramSeries:
-    __slots__ = ("buckets", "counts", "count", "sum", "max")
+    __slots__ = ("_lock", "buckets", "counts", "count", "sum", "max")
 
-    def __init__(self, buckets: Sequence[float]):
-        self.buckets = tuple(buckets)
-        self.counts = [0] * (len(self.buckets) + 1)   # +inf tail
-        self.count = 0
-        self.sum = 0.0
-        self.max = 0.0
+    def __init__(self, buckets: Sequence[float], lock: threading.Lock):
+        self._lock = lock                 # the owning metric's lock
+        self.buckets = tuple(buckets)     # immutable after init
+        self.counts = [0] * (len(self.buckets) + 1)  # guarded_by(_lock)
+        self.count = 0                    # guarded_by(_lock)
+        self.sum = 0.0                    # guarded_by(_lock)
+        self.max = 0.0                    # guarded_by(_lock)
 
     def observe(self, v: float) -> None:
         v = float(v)
-        self.count += 1
-        self.sum += v
-        self.max = max(self.max, v)
-        for i, edge in enumerate(self.buckets):
-            if v <= edge:
-                self.counts[i] += 1
-                return
-        self.counts[-1] += 1
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self.max = max(self.max, v)
+            for i, edge in enumerate(self.buckets):
+                if v <= edge:
+                    self.counts[i] += 1
+                    return
+            self.counts[-1] += 1
 
     @property
     def mean(self) -> float:
-        return self.sum / self.count if self.count else 0.0
+        with self._lock:
+            return self.sum / self.count if self.count else 0.0
 
 
 class Histogram(_Metric):
@@ -173,7 +192,7 @@ class Histogram(_Metric):
         self.buckets = tuple(buckets)
 
     def _new_series(self):
-        return _HistogramSeries(self.buckets)
+        return _HistogramSeries(self.buckets, self._lock)
 
     def observe(self, v: float) -> None:
         self.labels().observe(v)
@@ -185,8 +204,8 @@ class MetricsRegistry:
     kind is a programming error and raises."""
 
     def __init__(self):
-        self._metrics: Dict[str, _Metric] = {}
         self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}   # guarded_by(_lock)
 
     def _get(self, name: str, cls, help: str, **kw):
         with self._lock:
@@ -229,11 +248,13 @@ class MetricsRegistry:
             for key, s in m.series():
                 tag = f"{m.name}{{{_label_str(key)}}}" if key else m.name
                 if m.kind == "histogram":
-                    out[tag + "_count"] = s.count
-                    out[tag + "_sum"] = s.sum
-                    out[tag + "_max"] = s.max
+                    with s._lock:     # count/sum/max read as one unit
+                        out[tag + "_count"] = s.count
+                        out[tag + "_sum"] = s.sum
+                        out[tag + "_max"] = s.max
                 else:
-                    out[tag] = s.value
+                    with s._lock:
+                        out[tag] = s.value
         return out
 
     def to_text(self) -> str:
@@ -248,17 +269,21 @@ class MetricsRegistry:
                 lbl = "{" + _label_str_quoted(key) + "}" if key else ""
                 extra = "," + _label_str_quoted(key) if key else ""
                 if m.kind == "histogram":
+                    with s._lock:     # one consistent bucket/count/sum view
+                        counts = list(s.counts)
+                        count, total = s.count, s.sum
                     acc = 0
-                    for edge, c in zip(s.buckets, s.counts):
+                    for edge, c in zip(s.buckets, counts):
                         acc += c
                         lines.append(f'{m.name}_bucket{{le="{edge}"'
                                      f"{extra}}} {acc}")
                     lines.append(f'{m.name}_bucket{{le="+Inf"'
-                                 f"{extra}}} {s.count}")
-                    lines.append(f"{m.name}_count{lbl} {s.count}")
-                    lines.append(f"{m.name}_sum{lbl} {s.sum}")
+                                 f"{extra}}} {count}")
+                    lines.append(f"{m.name}_count{lbl} {count}")
+                    lines.append(f"{m.name}_sum{lbl} {total}")
                 else:
-                    lines.append(f"{m.name}{lbl} {s.value}")
+                    with s._lock:
+                        lines.append(f"{m.name}{lbl} {s.value}")
         return "\n".join(lines) + ("\n" if lines else "")
 
 

@@ -160,16 +160,20 @@ class Tracer:
         if ring_size is None:
             ring_size = int(FLAGS.obs_ring_size)
         self.ring_size = max(1, int(ring_size))
-        self.ring: Deque[Event] = deque(maxlen=self.ring_size)
-        self.events: List[Event] = []
         self._keep_all = bool(keep_all)
         self.registry = registry
         self._lock = threading.Lock()
+        # one tracer is shared by every replica's engine AND the master
+        # handler threads (the fleet hands out scoped() views of the
+        # same base), so the event stores only move under the lock
+        self.ring: Deque[Event] = deque(maxlen=self.ring_size)  # guarded_by(_lock)
+        self.events: List[Event] = []                # guarded_by(_lock)
         self._open: Dict[Tuple, Tuple[float, Dict[str, object],
                                       Optional[int], Optional[int],
-                                      str]] = {}
-        self.dropped = 0           # events past ring_size, keep_all=False
-        self.last_postmortem: Optional[str] = None
+                                      str]] = {}    # guarded_by(_lock)
+        # events past ring_size (keep_all=False)
+        self.dropped = 0                             # guarded_by(_lock)
+        self.last_postmortem: Optional[str] = None   # guarded_by(_lock)
 
     # ---- recording --------------------------------------------------------
 
@@ -290,7 +294,8 @@ class Tracer:
                        "events": [ev.to_dict() for ev in self.ring]}
         with open(path, "w") as f:
             json.dump(payload, f, sort_keys=True, separators=(",", ":"))
-        self.last_postmortem = path
+        with self._lock:
+            self.last_postmortem = path
         print(f"OBS-POSTMORTEM: {path}", flush=True)
         return path
 

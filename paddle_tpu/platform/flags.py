@@ -401,6 +401,16 @@ FLAGS.define("xla_audit_big_arg_bytes", 1048576,
              "with the result (the repo's step idiom), donating saves "
              "a full copy. Per-site override: "
              "SiteContract(big_arg_bytes=...).", parser=int)
+FLAGS.define("conc_audit_max_schedules", 64,
+             "per-drive schedule budget for the concurrency auditor's "
+             "schedule-permutation explorer (python -m "
+             "paddle_tpu.analysis concurrency): each chaos drive "
+             "replays at most this many permuted intra-tick schedules "
+             "(single-tick deltas first, then depth-2 combinations) "
+             "against its canonical fingerprint. The default explores "
+             "well past the >=50-interleavings-per-drive bar the audit "
+             "documents; raise it for deeper soak runs, lower it only "
+             "for quick smoke iterations.", parser=int)
 FLAGS.define("shard_audit_virtual_devices", 8,
              "virtual CPU device count the sharding-audit CLI (python "
              "-m paddle_tpu.analysis sharding) forces before backend "

@@ -40,8 +40,11 @@ class StatEntry:
 
 class StatSet:
     def __init__(self):
-        self._entries: Dict[str, StatEntry] = {}
         self._lock = threading.Lock()
+        # entries AND the StatEntry counters inside them: get()/
+        # snapshot() copy under the lock precisely because a timer on
+        # another thread mutates (count, total) as a pair
+        self._entries: Dict[str, StatEntry] = {}   # guarded_by(_lock)
 
     @contextlib.contextmanager
     def timer(self, name: str, block=None):

@@ -36,6 +36,30 @@ def device_put_feeds(feeds, sharding=None):
     return out
 
 
+class _ErrorBox:
+    """Producer-to-consumer exception hand-off.
+
+    The producer thread stores at most one exception; the consumer takes
+    it after seeing the end sentinel.  The queue's own internal lock
+    orders ``set`` (before ``put(end)``) against ``take`` (after
+    ``get()`` returns ``end``), but the box keeps its own lock so the
+    hand-off doesn't depend on that implementation detail."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._err: Optional[BaseException] = None   # guarded_by(_lock)
+
+    def set(self, exc: BaseException) -> None:
+        with self._lock:
+            if self._err is None:  # first error wins
+                self._err = exc
+
+    def take(self) -> Optional[BaseException]:
+        with self._lock:
+            err, self._err = self._err, None
+            return err
+
+
 def device_prefetch(feed_iter: Iterable, size: int = 2,
                     transform: Optional[Callable] = None,
                     place: Optional[Callable] = None):
@@ -49,7 +73,7 @@ def device_prefetch(feed_iter: Iterable, size: int = 2,
     """
     q: "queue.Queue" = queue.Queue(maxsize=max(1, size))
     end = object()
-    err_box = []
+    err_box = _ErrorBox()
     stop = threading.Event()
     place = place or device_put_feeds
 
@@ -72,7 +96,7 @@ def device_prefetch(feed_iter: Iterable, size: int = 2,
                 if not put(place(item)):
                     return
         except BaseException as e:  # surfaced on the consumer side
-            err_box.append(e)
+            err_box.set(e)
         finally:
             put(end)
 
@@ -82,8 +106,9 @@ def device_prefetch(feed_iter: Iterable, size: int = 2,
         while True:
             item = q.get()
             if item is end:
-                if err_box:
-                    raise err_box[0]
+                err = err_box.take()
+                if err is not None:
+                    raise err
                 return
             yield item
     finally:

@@ -38,6 +38,7 @@ import time
 from typing import Any, Callable, Dict, Optional
 
 from paddle_tpu import checkpoint as ckpt
+from paddle_tpu.analysis.concurrency.lifecycle import record_transition
 
 __all__ = ["AsyncCheckpointer"]
 
@@ -52,19 +53,25 @@ class AsyncCheckpointer:
 
     def __init__(self, keep: int = 2):
         self.keep = int(keep)
-        self._thread: Optional[threading.Thread] = None
-        self._error: Optional[BaseException] = None
         # no lock: with ONE writer in flight at a time, the join() in
         # wait()/drain() is the happens-before edge for everything the
         # writer thread touches (_error, commits, write_s, last_path);
         # a concurrent scrape of the counters may read a stale value,
         # never a torn one (they are plain ints/floats)
+        # guarded_by(serialized: depth-one writer; join happens-before)
+        self._thread: Optional[threading.Thread] = None
+        # guarded_by(serialized: depth-one writer; join happens-before)
+        self._error: Optional[BaseException] = None
         # counters (host-side bookkeeping, read by bench/tests)
-        self.saves = 0
+        self.saves = 0   # guarded_by(serialized: training thread only)
+        # guarded_by(serialized: writer thread, join() happens-before)
         self.commits = 0
-        self.stall_s = 0.0
+        self.stall_s = 0.0   # guarded_by(serialized: training thread only)
+        # guarded_by(serialized: training thread only)
         self.snapshot_s = 0.0
+        # guarded_by(serialized: writer thread, join() happens-before)
         self.write_s = 0.0
+        # guarded_by(serialized: writer thread, join() happens-before)
         self.last_path: Optional[str] = None
 
     # ---- durability barrier ----------------------------------------------
@@ -76,9 +83,11 @@ class AsyncCheckpointer:
         only past it."""
         t = self._thread
         if t is not None:
-            t0 = time.perf_counter()
+            # stall accounting measures real elapsed time, never drives
+            # scheduling — the injectable clock would hide true stalls
+            t0 = time.perf_counter()     # lint: allow(wall-clock)
             t.join()
-            self.stall_s += time.perf_counter() - t0
+            self.stall_s += time.perf_counter() - t0  # lint: allow(wall-clock)
             self._thread = None
         err = self._error
         if err is not None:
@@ -123,29 +132,43 @@ class AsyncCheckpointer:
         out the previous write first, so callers get depth-one
         pipelining and in-order commits for free."""
         self.wait()
-        t0 = time.perf_counter()
+        record_transition("checkpoint_commit", "idle", "snapshot")
+        # snapshot/write timers measure real elapsed time for perf
+        # accounting, never drive scheduling
+        t0 = time.perf_counter()         # lint: allow(wall-clock)
         host = ckpt.snapshot_checkpoint(parameters, opt_state=opt_state,
                                         model_state=model_state,
                                         shard_plan=shard_plan)
-        dt = time.perf_counter() - t0
+        dt = time.perf_counter() - t0    # lint: allow(wall-clock)
         self.snapshot_s += dt
         self.stall_s += dt
         self.saves += 1
+        record_transition("checkpoint_commit", "snapshot", "write")
 
         def _write() -> None:
-            w0 = time.perf_counter()
+            w0 = time.perf_counter()     # lint: allow(wall-clock)
             try:
                 path = ckpt.write_checkpoint(root, pass_id, host,
                                              extra_meta=extra_meta,
                                              commit_hook=commit_hook)
+                record_transition("checkpoint_commit", "write", "commit")
                 if self.keep > 0:
+                    record_transition("checkpoint_commit", "commit",
+                                      "prune")
                     ckpt.prune_checkpoints(root, keep=self.keep)
+                    record_transition("checkpoint_commit", "prune",
+                                      "idle")
+                else:
+                    record_transition("checkpoint_commit", "commit",
+                                      "idle")
                 self.commits += 1
                 self.last_path = path
             except BaseException as e:   # surfaces at the next wait()
+                record_transition("checkpoint_commit", "write", "failed")
+                record_transition("checkpoint_commit", "failed", "idle")
                 self._error = e
             finally:
-                self.write_s += time.perf_counter() - w0
+                self.write_s += time.perf_counter() - w0  # lint: allow(wall-clock)
 
         t = threading.Thread(target=_write, name="ckpt-writer", daemon=True)
         self._thread = t

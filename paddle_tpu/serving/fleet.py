@@ -68,6 +68,7 @@ from enum import Enum
 from typing import (Callable, Deque, Dict, List, Optional, Sequence, Set,
                     Tuple)
 
+from paddle_tpu.analysis.concurrency.lifecycle import record_transition
 from paddle_tpu.master.service import LeaseTable
 from paddle_tpu.obs.registry import MetricsRegistry
 from paddle_tpu.obs.trace import NULL_TRACER, tracer_for
@@ -426,6 +427,8 @@ class FleetRouter:
                          "last prefill-capable replica of a "
                          "disaggregated fleet (prompts would have "
                          "nowhere to prefill)", context="serving")
+        record_transition("replica_lifecycle", str(rep.state), "draining",
+                          registry=self.registry)
         rep.state = ReplicaState.DRAINING
         rep.engine.drain()
         self._forget_owner(idx)
@@ -459,6 +462,10 @@ class FleetRouter:
                      f"cannot warm-restart replica in state {rep.state} "
                      "(kill or drain it first)", context="serving")
         old_tier = rep.engine.host_tier
+        # the successor re-enters through JOINING: record the warm
+        # restart as the dead replica's declared dead -> joining edge
+        record_transition("replica_lifecycle", "dead", "joining",
+                          registry=self.registry)
         new_idx = self.add_replica(role=rep.role)
         new_rep = self.replicas[new_idx]
         restored = 0
@@ -482,6 +489,8 @@ class FleetRouter:
                 continue
             if self._lease.alive(rep.slot, rep.token) and \
                     rep.engine.healthz()["ok"]:
+                record_transition("replica_lifecycle", "joining", "ready",
+                                  registry=self.registry)
                 rep.state = ReplicaState.READY
                 self.tracer.instant("replica_ready", cat="fleet",
                                     replica=rep.idx)
@@ -554,6 +563,8 @@ class FleetRouter:
         """DEAD, lease dropped, chain ownership forgotten: from this
         line on the replica is unroutable and its zombie token can
         never ack.  Resubmission of its work is _reap's job."""
+        record_transition("replica_lifecycle", str(rep.state), "dead",
+                          registry=self.registry)
         rep.state = ReplicaState.DEAD
         rep.dead_reason = reason
         self.metrics.replicas_dead += 1
@@ -591,6 +602,8 @@ class FleetRouter:
     def _retire_replica(self, rep: Replica, now: float) -> None:
         """Clean end of a drain: engine empty, lease handed back."""
         self._lease.drop(rep.slot, rep.token)
+        record_transition("replica_lifecycle", str(rep.state), "dead",
+                          registry=self.registry)
         rep.state = ReplicaState.DEAD
         rep.dead_reason = "drained"
         self.metrics.replicas_drained += 1
@@ -790,19 +803,64 @@ class FleetRouter:
                         doomed.append(rep)
             for rep in doomed:
                 self._reap(rep, now)
-        self._lease_sweep(tick, now)
-        # control plane (round 17), AFTER the sweep (membership is
-        # current) and BEFORE dispatch: the autoscaler may join/drain
-        # replicas, then the WFQ releases this tick's weighted-fair
-        # share of buffered requests into the normal dispatch path
-        if self.autoscaler is not None:
-            self.autoscaler.on_tick(tick, now)
-        self._drain_wfq(now)
-        # apply pending page transfers BEFORE the engines step: a chain
-        # (or seed) that clears its destination's per-tick credit lands
-        # ahead of that destination's admission/decode this tick
-        self._pump_migrations(now)
-        for rep in self.replicas:
+        # the permutable mid-tick section.  Canonical order: lease sweep
+        # (membership is current for everything after), autoscaler
+        # (may join/drain replicas), WFQ drain (releases this tick's
+        # weighted-fair share into dispatch), migration pump (a chain
+        # or seed that clears its destination's per-tick credit lands
+        # ahead of that destination's admission/decode this tick).
+        # These four phases are CLAIMED commutable w.r.t. terminal
+        # outcomes — the SCHED-AUDIT explorer replays chaos drives
+        # under every permutation the hook asks for and holds the
+        # fleet to that claim; the kill prologue above and the
+        # engine-step/scan epilogue below are fixed, not permutable.
+        for phase in self._schedule(tick, "phases", self._PHASES):
+            if phase == "lease_sweep":
+                self._lease_sweep(tick, now)
+            elif phase == "autoscale":
+                if self.autoscaler is not None:
+                    self.autoscaler.on_tick(tick, now)
+            elif phase == "wfq_drain":
+                self._drain_wfq(now)
+            else:                             # mig_pump
+                self._pump_migrations(now)
+        self._step_replicas(tick, now)
+        # AFTER the engines step: prefill-class replicas whose requests
+        # just finished prefilling (first token this tick) enqueue their
+        # chain handoffs; the transfers clear next tick's pump
+        self._scan_migratable()
+        self._tick = tick + 1
+        return self.has_work
+
+    # canonical phase order for the permutable mid-tick section
+    _PHASES = ("lease_sweep", "autoscale", "wfq_drain", "mig_pump")
+
+    # SCHED-AUDIT ordering point: None (production) keeps canonical
+    # order at zero cost; the schedule explorer installs a callable
+    # ``hook(tick, kind, names) -> permutation`` with kind "phases"
+    # (the four mid-tick phases) or "replicas" (engine step order)
+    schedule_hook: Optional[Callable[[int, str, List], List]] = None
+
+    def _schedule(self, tick: int, kind: str, names: List) -> List:
+        """Ask the installed schedule hook (if any) for this tick's
+        order of ``names``; the hook must return a permutation — the
+        explorer probes orderings, it may not drop or invent work."""
+        hook = self.schedule_hook
+        if hook is None:
+            return list(names)
+        order = list(hook(tick, kind, list(names)))
+        enforce_that(sorted(order, key=repr) == sorted(names, key=repr),
+                     f"schedule_hook returned {order!r}, not a "
+                     f"permutation of {names!r}", context="serving")
+        return order
+
+    def _step_replicas(self, tick: int, now: float) -> None:
+        """Step every live replica (slow replicas skip their off
+        ticks), harvest terminal engine statuses into fleet statuses,
+        retire drained replicas — in hook-chosen order."""
+        idxs = [rep.idx for rep in self.replicas]
+        for idx in self._schedule(tick, "replicas", idxs):
+            rep = self.replicas[idx]
             if rep.state is ReplicaState.DEAD:
                 continue
             if self.faults is not None and \
@@ -814,12 +872,6 @@ class FleetRouter:
             if rep.state is ReplicaState.DRAINING and \
                     not rep.engine.has_work:
                 self._retire_replica(rep, now)
-        # AFTER the engines step: prefill-class replicas whose requests
-        # just finished prefilling (first token this tick) enqueue their
-        # chain handoffs; the transfers clear next tick's pump
-        self._scan_migratable()
-        self._tick = tick + 1
-        return self.has_work
 
     def run(self, max_ticks: Optional[int] = None) -> Dict[int, List[int]]:
         """Tick until the fleet drains (or ``max_ticks``); returns
@@ -911,6 +963,8 @@ class FleetRouter:
                 tried.add(idx)
                 continue
             freq.replica, freq.erid = idx, erid
+            record_transition("request_status", str(freq.status), "queued",
+                              registry=self.registry)
             freq.status = RequestStatus.QUEUED
             rep.rid_map[erid] = freq.frid
             if self.routing == "affinity":
@@ -965,7 +1019,11 @@ class FleetRouter:
             if st.terminal:
                 done.append((erid, frid, st))
             else:
-                self._requests[frid].status = st
+                freq = self._requests[frid]
+                if freq.status is not st:
+                    record_transition("request_status", str(freq.status),
+                                      str(st), registry=self.registry)
+                freq.status = st
         for erid, frid, st in done:
             del rep.rid_map[erid]
             freq = self._requests[frid]
@@ -999,8 +1057,12 @@ class FleetRouter:
         # later, and the migration ledger must balance at ANY drain
         if self._mig_pending.pop(freq.frid, None) is not None:
             self.metrics.on_migration_aborted()
+            record_transition("migration_transfer", "started", "aborted",
+                              registry=self.registry)
             self.tracer.instant("migrate_abort", cat="fleet",
                                 frid=freq.frid, reason="terminal")
+        record_transition("request_status", str(freq.status), str(status),
+                          registry=self.registry)
         freq.status = status
         freq.terminal_transitions += 1
         freq.finished_at = now
@@ -1148,6 +1210,8 @@ class FleetRouter:
             return                    # seeds drop silently
         if self._mig_pending.pop(t.frid, None) is not None:
             self.metrics.on_migration_aborted()
+            record_transition("migration_transfer", "started", "aborted",
+                              registry=self.registry)
             self.tracer.instant("migrate_abort", cat="fleet",
                                 frid=t.frid, reason=reason)
 
@@ -1187,9 +1251,13 @@ class FleetRouter:
                     self._dispatch(freq, now)     # full re-route
                 else:
                     freq.replica, freq.erid = t.dest, erid2
+                    record_transition("request_status", str(freq.status),
+                                      "queued", registry=self.registry)
                     freq.status = RequestStatus.QUEUED
                     dest.rid_map[erid2] = t.frid
                 self.metrics.on_migration_fallback()
+                record_transition("migration_transfer", "started",
+                                  "fallback", registry=self.registry)
                 self.tracer.instant("migrate_fallback", cat="fleet",
                                     frid=t.frid, seq=t.seq)
                 return "done"
@@ -1212,6 +1280,8 @@ class FleetRouter:
                 # PrefixCache (RECLAIMABLE) — still exportable as seeds
                 src.engine.cancel(t.erid, now=now)
             freq.replica, freq.erid = t.dest, rid2
+            record_transition("request_status", str(freq.status), "running",
+                              registry=self.registry)
             freq.status = RequestStatus.RUNNING
             dest.rid_map[rid2] = t.frid
             if src.engine.host_tier is not None and \
@@ -1229,6 +1299,8 @@ class FleetRouter:
                     prefix_chain_hashes(freq.prompt, self._page_size()),
                     t.dest)
             self.metrics.on_migration_applied(blob.num_pages, blob.nbytes)
+            record_transition("migration_transfer", "started", "applied",
+                              registry=self.registry)
             self.tracer.instant("migrate_apply", cat="fleet", frid=t.frid,
                                 src=t.src, dest=t.dest,
                                 pages=blob.num_pages, bytes=blob.nbytes)

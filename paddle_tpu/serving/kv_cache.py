@@ -885,10 +885,19 @@ class HostPageTier:
         self.dtype = dtype
         self.faults = faults
         self.tracer = tracer
+        # single-threaded by design: the engine tick loop is the only
+        # writer (spill/pump/flush/swap-in), and warm-restart adopt()
+        # runs before the successor engine starts ticking — the tier
+        # needs no lock, just confinement to its owning engine
+        # guarded_by(serialized: engine tick loop owns the tier)
         self._index: "OrderedDict[int, _HostPage]" = OrderedDict()
+        # guarded_by(serialized: engine tick loop owns the tier)
         self._pending: Optional[_HostPage] = None
+        # guarded_by(serialized: engine tick loop owns the tier)
         self._seq = 0
+        # guarded_by(serialized: engine tick loop owns the tier)
         self.resident_bytes = 0
+        # guarded_by(serialized: engine tick loop owns the tier)
         self.resident_by_tenant: Dict[str, int] = {}
         # ledger counters (see class docstring for the invariant)
         self.spills = 0            # pages ever staged (swap_outs gauge)
@@ -1074,6 +1083,10 @@ class HostPageTier:
         ``handed_off``.  Returns how many records were restored."""
         other.flush()
         restored = 0
+        # reaching into the predecessor's confined state is the POINT
+        # of adopt(): the old engine is already stopped at handoff, so
+        # its tier has no concurrent owner left
+        # lint: allow(guarded-by)
         for key in list(other._index):
             rec = other._pop(key)
             other.handed_off += 1

@@ -221,4 +221,27 @@ elif [ "$kv_rc" -ne 0 ]; then
     print_postmortems
     exit 13
 fi
+# concurrency-auditor gate (paddle_tpu.analysis.concurrency): the
+# guarded_by lock-discipline checker over every annotated threaded
+# module, the declared lifecycle state machines checked statically
+# (assignment-site extraction) and dynamically (transition recorder
+# during the chaos drives), and the schedule-permutation model checker
+# replaying each seeded chaos drive under permuted intra-tick schedules
+# — any terminal-fingerprint divergence is a reproducible interleaving
+# bug and dumps an OBS-POSTMORTEM for its minimal schedule prefix.
+# Exit 14 extends the ladder (3..13); same contract as the other
+# gates: branch on the auditor's OWN exit status (findings=1,
+# crash=2), never on a grep of the shared log — the conc tests
+# intentionally print CONC-AUDIT/PROTO-AUDIT/SCHED-AUDIT lines.
+env JAX_PLATFORMS=cpu python -m paddle_tpu.analysis concurrency 2>&1 | tee -a /tmp/_t1.log
+conc_rc=${PIPESTATUS[0]}
+if [ "$conc_rc" -eq 1 ]; then
+    echo 'CONC-AUDIT: concurrency invariants violated (see log above)'
+    print_postmortems
+    exit 14
+elif [ "$conc_rc" -ne 0 ]; then
+    echo "CONC-AUDIT: concurrency auditor itself exited $conc_rc without running to completion"
+    print_postmortems
+    exit 14
+fi
 exit $rc
